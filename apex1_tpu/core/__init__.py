@@ -1,0 +1,22 @@
+"""Core substrate: device mesh construction, precision policy, loss scaling,
+pytree/flattening utilities, RNG plumbing.
+
+Reference counterparts: ``apex/amp/frontend.py :: Properties`` (policy),
+``apex/amp/scaler.py :: LossScaler`` (loss scaling),
+``apex/transformer/parallel_state.py`` (topology — here a ``jax.sharding.Mesh``).
+"""
+
+from apex1_tpu.core.mesh import (  # noqa: F401
+    MeshConfig,
+    MeshResource,
+    make_mesh,
+    local_mesh,
+)
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy  # noqa: F401
+from apex1_tpu.core.loss_scale import (  # noqa: F401
+    LossScaleState,
+    NoOpLossScale,
+    StaticLossScale,
+    DynamicLossScale,
+    all_finite,
+)

@@ -1,0 +1,180 @@
+"""Functional loss scaling — reference ``apex/amp/scaler.py :: LossScaler``.
+
+The reference mutates a host-side scaler object and uses a device-side
+``noop_flag`` (written by the fused ``amp_C`` kernels) so an overflow aborts
+the optimizer kernel without a host sync. Here the whole step is one XLA
+program, so the same property falls out naturally: the scale is a traced
+``LossScaleState`` threaded through the step, the finite-check is a fused
+reduction, and the skip is a ``jax.lax.cond``/``jnp.where`` — no host sync,
+ever.
+
+Semantics replicated exactly from the reference:
+  - dynamic: init 2**16, double every ``growth_interval`` (2000) consecutive
+    clean steps, halve on inf/nan, skip the optimizer step on overflow
+    (``scaler.py :: LossScaler.update_scale``).
+  - ``min_loss_scale`` / ``max_loss_scale`` clamps
+    (``frontend.py :: initialize`` kwargs).
+  - TP/PP interaction: the finite flag must agree across the model-parallel
+    mesh (``apex/transformer/amp/grad_scaler.py :: GradScaler`` all-reduces
+    found_inf) — ``all_finite`` reduces over ALL leaves; under ``shard_map``
+    callers psum it over mesh axes via ``axis_names``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+import chex
+
+
+@chex.dataclass(frozen=True)
+class LossScaleState:
+    """Carried through the train step; a pytree (jit-friendly)."""
+
+    scale: jnp.ndarray            # f32 scalar
+    growth_count: jnp.ndarray     # i32 scalar: consecutive clean steps
+    overflow_count: jnp.ndarray   # i32 scalar: total skipped steps (metrics)
+
+
+def all_finite(tree, axis_names: tuple[str, ...] = ()) -> jnp.ndarray:
+    """Fused global finite check over a pytree of grads.
+
+    Reference: ``amp_C.multi_tensor_l2norm``'s in-kernel inf/nan detection
+    writing ``noop_flag``; python fallback ``scaler.py :: _has_inf_or_nan``.
+    XLA fuses the per-leaf reductions into the backward epilogue.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        finite = jnp.bool_(True)
+    else:
+        finite = jnp.stack(
+            [jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+    for ax in axis_names:
+        finite = jax.lax.pmin(finite.astype(jnp.int32), ax).astype(jnp.bool_)
+    return finite
+
+
+class _LossScaleBase:
+    def init(self) -> LossScaleState:
+        raise NotImplementedError
+
+    def scale(self, loss, state: LossScaleState):
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale(self, grads, state: LossScaleState):
+        inv = (1.0 / state.scale)
+
+        def unscale_leaf(g):
+            g = jnp.asarray(g)
+            if not jnp.issubdtype(g.dtype, jnp.floating):
+                return g
+            return (g.astype(jnp.float32) * inv).astype(g.dtype)
+
+        return jax.tree_util.tree_map(unscale_leaf, grads)
+
+    def adjust(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        raise NotImplementedError
+
+
+class NoOpLossScale(_LossScaleBase):
+    """scale==1; used by O0 and bf16 paths (bf16 range ≈ fp32, no scaling)."""
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(1.0),
+                              growth_count=jnp.int32(0),
+                              overflow_count=jnp.int32(0))
+
+    def scale(self, loss, state):
+        return loss
+
+    def unscale(self, grads, state):
+        return grads
+
+    def adjust(self, state, grads_finite):
+        return state
+
+
+class StaticLossScale(_LossScaleBase):
+    """``loss_scale=<float>`` in ``amp.initialize``; never adjusts."""
+
+    def __init__(self, scale: float):
+        self._scale = float(scale)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self._scale),
+                              growth_count=jnp.int32(0),
+                              overflow_count=jnp.int32(0))
+
+    def adjust(self, state, grads_finite):
+        return dataclasses.replace(
+            state,
+            overflow_count=state.overflow_count
+            + jnp.where(grads_finite, 0, 1).astype(jnp.int32))
+
+
+class DynamicLossScale(_LossScaleBase):
+    """Reference dynamic scaling state machine
+    (``scaler.py :: LossScaler`` with ``dynamic`` init + the on-device
+    hysteresis variant ``csrc/update_scale_hysteresis.cu``)."""
+
+    def __init__(self,
+                 init_scale: float = 2.0 ** 16,
+                 growth_factor: float = 2.0,
+                 backoff_factor: float = 0.5,
+                 growth_interval: int = 2000,
+                 min_loss_scale: float = 1.0,
+                 max_loss_scale: float = 2.0 ** 24):
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.min_loss_scale = float(min_loss_scale)
+        self.max_loss_scale = float(max_loss_scale)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.float32(self.init_scale),
+                              growth_count=jnp.int32(0),
+                              overflow_count=jnp.int32(0))
+
+    def adjust(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        grads_finite = jnp.asarray(grads_finite)
+        grew = state.growth_count + 1 >= self.growth_interval
+        clean_scale = jnp.where(
+            grew, state.scale * self.growth_factor, state.scale)
+        clean_count = jnp.where(grew, 0, state.growth_count + 1)
+        new_scale = jnp.where(
+            grads_finite, clean_scale, state.scale * self.backoff_factor)
+        new_scale = jnp.clip(new_scale, self.min_loss_scale,
+                             self.max_loss_scale)
+        return LossScaleState(
+            scale=new_scale.astype(jnp.float32),
+            growth_count=jnp.where(grads_finite, clean_count, 0)
+            .astype(jnp.int32),
+            overflow_count=(state.overflow_count
+                            + jnp.where(grads_finite, 0, 1)).astype(jnp.int32),
+        )
+
+
+def make_loss_scale(spec: Any) -> _LossScaleBase:
+    """Resolve the ``loss_scale`` policy property:
+    None → no-op, "dynamic" → DynamicLossScale, number → StaticLossScale."""
+    if spec is None:
+        return NoOpLossScale()
+    if isinstance(spec, _LossScaleBase):
+        return spec
+    if spec == "dynamic":
+        return DynamicLossScale()
+    return StaticLossScale(float(spec))
+
+
+def select_tree(pred, on_true, on_false):
+    """Per-leaf ``jnp.where`` used for skip-on-overflow: keep old params/opt
+    state when the step overflowed (reference: wrapped ``optimizer.step``
+    early-return in ``_process_optimizer.py``, in-kernel ``noop_flag``)."""
+    return jax.tree_util.tree_map(
+        lambda t, f: jnp.where(pred, t, f), on_true, on_false)

@@ -1,0 +1,135 @@
+"""Precision policy — the TPU-idiomatic equivalent of amp opt levels.
+
+Reference: ``apex/amp/frontend.py :: Properties, O0, O1, O2, O3``. Each opt
+level there bundles five properties (``cast_model_type``,
+``patch_torch_functions``, ``keep_batchnorm_fp32``, ``master_weights``,
+``loss_scale``) and O1 is implemented by monkey-patching torch ops
+(``apex/amp/lists/{functional_overrides,torch_overrides}.py``).
+
+JAX is functionally traced, so there is nothing to monkey-patch: the policy is
+a frozen dataclass applied at module/param boundaries (jmp-style). The O1
+"op lists" survive as *semantics*: compute runs in ``compute_dtype`` while the
+numerically fragile ops the reference blacklists (softmax, norms, losses,
+exp/pow reductions) run in fp32 — our kernels (`apex1_tpu.ops`) upcast
+internally exactly where the reference's FP32_FUNCS list did.
+
+Opt-level mapping (bf16 is the TPU-native half type; fp16 kept for parity):
+
+    O0  — fp32 everything (debug/gold)
+    O1  — params fp32, compute bf16/fp16, fragile ops fp32, dynamic loss
+          scaling for fp16 (bf16 needs none)
+    O2  — params stored fp32 ("master weights" ARE the params), model applied
+          in half via cast-on-use inside the jitted step, norms fp32
+    O3  — half everything (speed ceiling / debugging)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+_FLOATS = (jnp.float32, jnp.bfloat16, jnp.float16, jnp.float64)
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Frozen bundle of dtypes + flags, mirroring amp ``Properties``.
+
+    - ``param_dtype``: storage dtype of parameters (fp32 ⇒ params are the
+      fp32 master weights of reference O2 — no separate copy needed).
+    - ``compute_dtype``: dtype activations/matmuls run in.
+    - ``output_dtype``: dtype of model outputs (``cast_model_outputs``).
+    - ``keep_norms_fp32``: reference ``keep_batchnorm_fp32`` generalized to
+      all normalization layers (TPU kernels accumulate stats in fp32 anyway).
+    - ``fp32_fragile_ops``: the O1-vs-O2 distinction, made explicit. O1's
+      monkey-patch lists run FP32_FUNCS (softmax/losses/exp/pow) in fp32;
+      O2 casts the whole model and does NOT patch functions, so those ops run
+      in half. Our kernels (`apex1_tpu.ops`) consult this flag for their
+      input/output dtypes (accumulation is always fp32 on the MXU/VPU).
+    - ``loss_scale``: "dynamic", None, or a static float — consumed by
+      ``apex1_tpu.core.loss_scale``.
+    """
+
+    name: str = "O1"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+    keep_norms_fp32: bool = True
+    fp32_fragile_ops: bool = True
+    loss_scale: Any = None  # None | "dynamic" | float
+
+    # ---- casts (jmp-style) -------------------------------------------------
+    def cast_to_compute(self, tree):
+        return _cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floats(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floats(tree, self.output_dtype)
+
+    def with_overrides(self, **kw) -> "PrecisionPolicy":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def uses_loss_scaling(self) -> bool:
+        return self.loss_scale is not None
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.compute_dtype != self.param_dtype
+
+
+def _cast_floats(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float(x) else x, tree)
+
+
+def _mk(name, **kw) -> PrecisionPolicy:
+    return PrecisionPolicy(name=name, **kw)
+
+
+# Named presets. ``apex/amp/frontend.py :: opt_levels`` dict equivalent.
+# "half" resolves per-target: bf16 presets are the TPU-native defaults;
+# explicit fp16 variants replicate the reference's loss-scaled path bit-for-
+# spirit (dynamic scale init 2^16, ×2/2000 steps, ÷2 on overflow — see
+# core/loss_scale.py).
+POLICIES = {
+    "O0": _mk("O0", compute_dtype=jnp.float32, loss_scale=None),
+    "O1": _mk("O1", compute_dtype=jnp.bfloat16),
+    "O2": _mk("O2", compute_dtype=jnp.bfloat16, fp32_fragile_ops=False),
+    "O3": _mk("O3", param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
+              output_dtype=jnp.bfloat16, keep_norms_fp32=False,
+              fp32_fragile_ops=False),
+    "O1_fp16": _mk("O1_fp16", compute_dtype=jnp.float16, loss_scale="dynamic"),
+    "O2_fp16": _mk("O2_fp16", compute_dtype=jnp.float16,
+                   fp32_fragile_ops=False, loss_scale="dynamic"),
+    "O3_fp16": _mk("O3_fp16", param_dtype=jnp.float16,
+                   compute_dtype=jnp.float16, output_dtype=jnp.float16,
+                   keep_norms_fp32=False, fp32_fragile_ops=False,
+                   loss_scale=None),
+}
+
+
+def get_policy(spec: str | PrecisionPolicy, **overrides) -> PrecisionPolicy:
+    """Resolve a policy by name with per-property overrides — the equivalent
+    of ``amp.initialize(..., opt_level="O2", keep_batchnorm_fp32=True)``
+    kwarg-override semantics (``frontend.py :: Properties`` setattr path)."""
+    if isinstance(spec, PrecisionPolicy):
+        pol = spec
+    else:
+        try:
+            pol = POLICIES[spec]
+        except KeyError:
+            raise ValueError(
+                f"unknown opt level {spec!r}; valid: {sorted(POLICIES)}")
+    if overrides:
+        pol = pol.with_overrides(**overrides)
+    return pol

@@ -1,0 +1,132 @@
+"""Pytree/flat-buffer utilities — the ``multi_tensor_apply`` substrate.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py :: MultiTensorApply``
+packs lists of tensors into chunked kernel launches; ``csrc/
+flatten_unflatten.cpp :: flatten/unflatten`` (``apex_C``) flattens DDP buckets.
+
+On TPU the XLA compiler already fuses elementwise updates across parameters
+into a few loops, so the *performance* role of multi_tensor_apply is covered
+by compilation. What remains useful — and is provided here — is the *shape*
+of the API: treating a whole pytree as one logical flat buffer (for fused
+global norms, one-kernel optimizer updates over the concatenated buffer, DDP
+bucket views, and checkpoint packing). A C++ host-side packer lives in
+``apex1_tpu.runtime`` for host RAM staging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_float_leaves(tree):
+    leaves = [jnp.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+    return [x for x in leaves if jnp.issubdtype(x.dtype, jnp.floating)]
+
+
+def flatten_tree(tree, dtype=None):
+    """Concatenate the *floating* leaves into ONE 1-D buffer; non-float
+    leaves (step counters, token ids, bools) are carried through untouched.
+
+    Returns ``(flat, unflatten)`` where ``unflatten(flat) -> tree``.
+    Equivalent of ``apex_C.flatten`` + bucket bookkeeping, but done once at
+    trace time; XLA turns the concatenation into layout assignment, not a
+    copy, when the consumer is elementwise.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [jnp.asarray(x) for x in leaves]
+    is_float = [jnp.issubdtype(x.dtype, jnp.floating) for x in leaves]
+    floats = [x for x, f in zip(leaves, is_float) if f]
+    shapes = [x.shape for x in floats]
+    dtypes = [x.dtype for x in floats]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    flat = jnp.concatenate(
+        [jnp.ravel(x).astype(dtype or dtypes[i])
+         for i, x in enumerate(floats)]) if floats else jnp.zeros((0,))
+
+    offsets = np.cumsum([0] + sizes)
+
+    def unflatten(buf):
+        outs, j = [], 0
+        for leaf, f in zip(leaves, is_float):
+            if f:
+                piece = buf[offsets[j]:offsets[j + 1]]
+                outs.append(piece.reshape(shapes[j]).astype(dtypes[j]))
+                j += 1
+            else:
+                outs.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    return flat, unflatten
+
+
+def global_norm(tree, *, per_leaf: bool = False):
+    """Fused global L2 norm (and optionally per-leaf norms, as LAMB needs).
+
+    Reference: ``amp_C.multi_tensor_l2norm`` two-stage grid reduction with
+    optional ``per_tensor`` output (``csrc/multi_tensor_l2norm_kernel.cu``).
+    """
+    leaves = tree_float_leaves(tree)
+    if not leaves:
+        z = jnp.float32(0)
+        return (z, []) if per_leaf else z
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    gnorm = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+    if per_leaf:
+        return gnorm, [jnp.sqrt(s) for s in sq]
+    return gnorm
+
+
+def tree_scale(tree, factor):
+    """``amp_C.multi_tensor_scale`` — one fused scale over all tensors."""
+    def scale(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    return jax.tree_util.tree_map(scale, tree)
+
+
+def tree_axpby(a, x_tree, b, y_tree, out_dtype=None):
+    """``amp_C.multi_tensor_axpby``: out = a*x + b*y, fused across the tree.
+
+    Accumulates in fp32; result keeps x's dtype (or ``out_dtype``), matching
+    the kernel's explicit out-tensor dtype. Non-float leaves pass through
+    from ``y_tree`` unchanged.
+    """
+    def axpby(x, y):
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return y
+        acc = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return acc.astype(out_dtype or x.dtype)
+    return jax.tree_util.tree_map(axpby, x_tree, y_tree)
+
+
+def tree_cast_like(tree, like):
+    return jax.tree_util.tree_map(
+        lambda x, l: x.astype(jnp.asarray(l).dtype), tree, like)
+
+
+def named_tree_map(f: Callable[[str, Any], Any], tree, sep: str = "/"):
+    """tree_map with a "path/to/leaf" first argument — used by the regex →
+    PartitionSpec sharding rules (SNIPPETS.md [1] pattern)."""
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in paths_and_leaves:
+        name = sep.join(_path_element_str(p) for p in path)
+        out.append(f(name, leaf))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _path_element_str(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)

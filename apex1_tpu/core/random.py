@@ -1,0 +1,64 @@
+"""RNG plumbing — reference ``apex/transformer/tensor_parallel/random.py``.
+
+The reference keeps a ``CudaRNGStatesTracker`` of named CUDA RNG streams so
+that dropout differs across TP ranks ("model-parallel-rng", seeded
+``seed + 2718 + tp_rank``) while the default stream matches across them, and
+its activation ``checkpoint`` snapshots/restores RNG state to replay dropout
+exactly on recompute.
+
+JAX's counter-based threefry makes all of that structural:
+
+- per-rank divergence = ``fold_in`` of the mesh axis index;
+- recompute replay is free — ``jax.checkpoint`` replays the same key;
+- no mutable state to snapshot.
+
+We keep the tracker API shape for parity (named domains → folded keys).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+# Stable fold constants per named domain (2718 mirrors the reference's
+# model-parallel seed offset in ``model_parallel_cuda_manual_seed``).
+_DOMAIN_SALT = {
+    "default": 0,
+    "model-parallel-rng": 2718,
+    "data-parallel-rng": 1042,
+}
+
+
+def domain_key(key: jax.Array, domain: str = "default") -> jax.Array:
+    salt = _DOMAIN_SALT.get(domain)
+    if salt is None:
+        # crc32, not hash(): stable across processes so checkpoint-resume
+        # replays identical keys regardless of PYTHONHASHSEED.
+        salt = zlib.crc32(domain.encode()) & 0x7FFFFFFF
+    return jax.random.fold_in(key, salt)
+
+
+def model_parallel_key(key: jax.Array, tp_axis: str = "tp") -> jax.Array:
+    """Inside ``shard_map``: per-TP-rank dropout key
+    (≙ ``model_parallel_cuda_manual_seed``'s ``seed + 2718 + tp_rank``)."""
+    idx = jax.lax.axis_index(tp_axis)
+    return jax.random.fold_in(domain_key(key, "model-parallel-rng"), idx)
+
+
+class RNGKeychain:
+    """Host-side convenience: split a root seed into named, step-folded keys.
+
+    Usage::
+
+        chain = RNGKeychain(seed)
+        dropout_key = chain.key("dropout", step)
+    """
+
+    def __init__(self, seed: int):
+        self._root = jax.random.PRNGKey(seed)
+
+    def key(self, name: str, step: int | jnp.ndarray = 0) -> jax.Array:
+        return jax.random.fold_in(domain_key(self._root, name),
+                                  jnp.asarray(step, jnp.uint32))

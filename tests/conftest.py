@@ -1,0 +1,39 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Reference analogue: ``apex/transformer/testing/distributed_test_base.py``
+spawns N NCCL processes; on JAX a single process with
+``--xla_force_host_platform_device_count=8`` provides 8 CPU devices for full
+mesh/pjit/shard_map/collective coverage (SURVEY.md §4.2.4).
+
+NOTE: the container's sitecustomize registers the 'axon' TPU platform and
+pins ``jax_platforms=axon,cpu`` via jax.config, so env vars alone don't
+switch backends — we must override through jax.config before any backend
+client is instantiated.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)

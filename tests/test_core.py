@@ -1,0 +1,184 @@
+"""M0 tests: mesh construction (≙ tests/L0/run_transformer/test_parallel_state.py
+group math), precision policy (≙ tests/L0/run_amp cast tests), loss scaling
+(≙ run_amp loss-scale tests), pytree/flat utilities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.core import mesh as mesh_lib
+from apex1_tpu.core import policy as policy_lib
+from apex1_tpu.core import loss_scale as ls
+from apex1_tpu.core import pytree as pt
+from apex1_tpu.core.mesh import MeshConfig, make_mesh
+
+
+class TestMesh:
+    def test_resolve_wildcard(self):
+        cfg = MeshConfig(dp=-1, tp=2).resolve(8)
+        assert cfg.dp == 4 and cfg.tp == 2 and cfg.pp == 1
+        assert cfg.shape == (4, 1, 1, 1, 2)
+
+    def test_resolve_exact(self):
+        cfg = MeshConfig(dp=2, pp=2, tp=2).resolve(8)
+        assert cfg.shape == (2, 1, 2, 1, 2)
+
+    def test_resolve_errors(self):
+        with pytest.raises(ValueError):
+            MeshConfig(dp=3, tp=2).resolve(8)
+        with pytest.raises(ValueError):
+            MeshConfig(dp=-1, tp=-1).resolve(8)
+
+    def test_make_mesh_axes(self, devices):
+        m = make_mesh(dp=2, tp=4)
+        assert m.shape == {"dp": 2, "fsdp": 1, "pp": 1, "cp": 1, "tp": 4}
+        assert mesh_lib.data_parallel_size(m) == 2
+
+    def test_tp_ranks_contiguous(self, devices):
+        # Megatron invariant: TP group = contiguous device ids (innermost
+        # axis). parallel_state.initialize_model_parallel docstring contract.
+        m = make_mesh(dp=2, tp=4)
+        arr = np.asarray(m.devices).reshape(2, 4)
+        ids = [[d.id for d in row] for row in arr]
+        for row in ids:
+            assert row == sorted(row)
+            assert row[-1] - row[0] == 3
+
+    def test_resource_spec(self):
+        res = mesh_lib.MeshResource()
+        spec = res.spec("batch", None, "heads")
+        assert spec == jax.sharding.PartitionSpec(("dp", "fsdp"), None, "tp")
+
+    def test_shard_batch(self, devices):
+        m = make_mesh(dp=8)
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        y = mesh_lib.shard_batch(m, {"x": x})["x"]
+        assert y.sharding.spec == jax.sharding.PartitionSpec(("dp", "fsdp"))
+        np.testing.assert_array_equal(np.asarray(y), x)
+
+
+class TestPolicy:
+    def test_presets(self):
+        o2 = policy_lib.get_policy("O2")
+        assert o2.param_dtype == jnp.float32
+        assert o2.compute_dtype == jnp.bfloat16
+        assert o2.is_mixed and not o2.uses_loss_scaling
+        o0 = policy_lib.get_policy("O0")
+        assert not o0.is_mixed
+        fp16 = policy_lib.get_policy("O2_fp16")
+        assert fp16.loss_scale == "dynamic"
+
+    def test_overrides(self):
+        p = policy_lib.get_policy("O1", loss_scale=128.0,
+                                  keep_norms_fp32=False)
+        assert p.loss_scale == 128.0 and not p.keep_norms_fp32
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            policy_lib.get_policy("O9")
+
+    def test_casts_skip_ints(self):
+        p = policy_lib.get_policy("O1")
+        tree = {"w": jnp.ones((2,), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+
+    def test_cast_dtype_under_jit(self):
+        # ≙ run_amp/test_basic_casts.py, but asserted on the traced program.
+        p = policy_lib.get_policy("O1")
+
+        def f(w, x):
+            return x @ p.cast_to_compute(w)
+
+        out = jax.eval_shape(f, jnp.ones((4, 4)), jnp.ones((2, 4), jnp.bfloat16))
+        assert out.dtype == jnp.bfloat16
+
+
+class TestLossScale:
+    def test_dynamic_state_machine(self):
+        # ≙ scaler.py semantics: ÷2 on overflow, ×2 after growth_interval.
+        d = ls.DynamicLossScale(init_scale=2.0 ** 8, growth_interval=4)
+        s = d.init()
+        assert float(s.scale) == 256.0
+        s = d.adjust(s, jnp.bool_(False))
+        assert float(s.scale) == 128.0 and int(s.overflow_count) == 1
+        assert int(s.growth_count) == 0
+        for i in range(3):
+            s = d.adjust(s, jnp.bool_(True))
+            assert float(s.scale) == 128.0
+        s = d.adjust(s, jnp.bool_(True))  # 4th clean step → grow
+        assert float(s.scale) == 256.0 and int(s.growth_count) == 0
+
+    def test_clamps(self):
+        d = ls.DynamicLossScale(init_scale=2.0, min_loss_scale=1.0,
+                                growth_interval=1, max_loss_scale=4.0)
+        s = d.init()
+        s = d.adjust(s, jnp.bool_(False))
+        s = d.adjust(s, jnp.bool_(False))
+        assert float(s.scale) == 1.0  # clamped at min
+        for _ in range(5):
+            s = d.adjust(s, jnp.bool_(True))
+        assert float(s.scale) == 4.0  # clamped at max
+
+    def test_all_finite(self):
+        good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+        bad = {"a": jnp.ones(3), "b": jnp.array([1.0, jnp.inf])}
+        nan = {"a": jnp.array([jnp.nan]), "b": jnp.zeros(2)}
+        assert bool(ls.all_finite(good))
+        assert not bool(ls.all_finite(bad))
+        assert not bool(ls.all_finite(nan))
+
+    def test_scale_unscale_roundtrip(self):
+        st = ls.StaticLossScale(1024.0)
+        s = st.init()
+        g = {"w": jnp.full((4,), 2.0, jnp.float32)}
+        scaled = st.scale(jnp.float32(3.0), s)
+        assert float(scaled) == 3.0 * 1024.0
+        back = st.unscale({"w": g["w"] * 1024.0}, s)
+        np.testing.assert_allclose(np.asarray(back["w"]), 2.0, rtol=1e-6)
+
+    def test_select_tree_skip(self):
+        old = {"w": jnp.zeros(2)}
+        new = {"w": jnp.ones(2)}
+        kept = ls.select_tree(jnp.bool_(False), new, old)
+        np.testing.assert_array_equal(np.asarray(kept["w"]), 0.0)
+
+    def test_jittable(self):
+        d = ls.DynamicLossScale(growth_interval=2)
+
+        @jax.jit
+        def step(state, finite):
+            return d.adjust(state, finite)
+
+        s = d.init()
+        s = step(s, jnp.bool_(True))
+        s = step(s, jnp.bool_(False))
+        assert float(s.scale) == 2.0 ** 15
+
+
+class TestPytree:
+    def test_flatten_roundtrip(self):
+        tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                "b": {"c": jnp.ones((4,), jnp.bfloat16)}}
+        flat, unflatten = pt.flatten_tree(tree)
+        assert flat.shape == (10,)
+        back = unflatten(flat)
+        np.testing.assert_array_equal(np.asarray(back["a"]),
+                                      np.asarray(tree["a"]))
+        assert back["b"]["c"].dtype == jnp.bfloat16
+
+    def test_global_norm(self):
+        tree = {"a": jnp.full((3,), 2.0), "b": jnp.full((4,), 1.0)}
+        g = pt.global_norm(tree)
+        np.testing.assert_allclose(float(g), np.sqrt(3 * 4 + 4), rtol=1e-6)
+        g2, per = pt.global_norm(tree, per_leaf=True)
+        assert len(per) == 2
+        np.testing.assert_allclose(float(per[1]), 2.0, rtol=1e-6)
+
+    def test_named_tree_map(self):
+        tree = {"layer": {"w": jnp.ones(2), "b": jnp.ones(1)}}
+        names = []
+        pt.named_tree_map(lambda n, x: names.append(n) or x, tree)
+        assert names == ["layer/b", "layer/w"] or names == ["layer/w", "layer/b"]
