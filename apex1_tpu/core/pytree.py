@@ -111,6 +111,19 @@ def tree_cast_like(tree, like):
         lambda x, l: x.astype(jnp.asarray(l).dtype), tree, like)
 
 
+def tree_map_unzip(f: Callable[..., tuple], n_out: int, *trees):
+    """Map ``f`` (returning an ``n_out``-tuple) over leaves of ``trees`` and
+    return ``n_out`` trees. Safe for pytrees whose containers are themselves
+    tuples (a naive ``tree_map`` + ``is_leaf=isinstance(tuple)`` unzip is
+    not)."""
+    leaves0, treedef = jax.tree_util.tree_flatten(trees[0])
+    rest = [treedef.flatten_up_to(t) for t in trees[1:]]
+    outs = [f(*args) for args in zip(leaves0, *rest)]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs])
+        for i in range(n_out))
+
+
 def named_tree_map(f: Callable[[str, Any], Any], tree, sep: str = "/"):
     """tree_map with a "path/to/leaf" first argument — used by the regex →
     PartitionSpec sharding rules (SNIPPETS.md [1] pattern)."""

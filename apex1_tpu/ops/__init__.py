@@ -1,0 +1,17 @@
+"""Pallas TPU kernels + XLA composites — the ``csrc/`` of this framework.
+
+Each op has a Pallas kernel (TPU) and an XLA-composite fallback/gold; see
+`apex1_tpu.ops._common` for dispatch. Decisions of the form "XLA already
+fuses this" (fused_dense, MLP epilogues) are documented in `ops.fused_dense`.
+"""
+
+from apex1_tpu.ops._common import (  # noqa: F401
+    NEG_INF, force_impl, get_impl, set_impl, use_pallas)
+from apex1_tpu.ops.layer_norm import (  # noqa: F401
+    FusedLayerNorm, FusedRMSNorm, layer_norm, rms_norm)
+from apex1_tpu.ops.softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax, scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax)
+from apex1_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+from apex1_tpu.ops.rope import (  # noqa: F401
+    apply_rotary_pos_emb, rope_tables)

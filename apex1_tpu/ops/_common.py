@@ -1,0 +1,96 @@
+"""Shared kernel-dispatch machinery for `apex1_tpu.ops`.
+
+Every op ships two implementations:
+
+- a **Pallas TPU kernel** (the ``csrc/`` equivalent), used on TPU backends;
+- an **XLA composite** (pure jnp; also the parity "gold"), used on CPU/GPU
+  and wherever profiling shows XLA's fusion already wins (the reference's
+  ``is_kernel_available`` fallback pattern,
+  ``apex/transformer/functional/fused_softmax.py :: FusedScaleMaskSoftmax``).
+
+Dispatch is controllable for tests/benchmarks via ``set_impl`` /
+``force_impl`` ("auto" | "pallas" | "xla"). On non-TPU backends "pallas"
+runs the kernel in interpreter mode so kernel logic is testable on the CPU
+mesh harness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_IMPL = "auto"  # "auto" | "pallas" | "xla"
+
+
+def set_impl(mode: str) -> None:
+    global _IMPL
+    if mode not in ("auto", "pallas", "xla"):
+        raise ValueError(f"impl must be auto|pallas|xla, got {mode!r}")
+    _IMPL = mode
+
+
+def get_impl() -> str:
+    return _IMPL
+
+
+@contextlib.contextmanager
+def force_impl(mode: str):
+    prev = _IMPL
+    set_impl(mode)
+    try:
+        yield
+    finally:
+        set_impl(prev)
+
+
+@functools.cache
+def _default_backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def on_tpu() -> bool:
+    # the axon PJRT plugin reports platform "axon" but is a TPU
+    return _default_backend() in ("tpu", "axon")
+
+
+def use_pallas() -> bool:
+    if _IMPL == "pallas":
+        return True
+    if _IMPL == "xla":
+        return False
+    return on_tpu()
+
+
+def interpret_mode() -> bool:
+    """Interpret Pallas kernels when not on a real TPU."""
+    return not on_tpu()
+
+
+def pad_to(x: jnp.ndarray, axis: int, multiple: int, value=0.0):
+    """Pad ``axis`` up to a multiple; returns (padded, original_size).
+
+    Client-side neutral-element padding keeps kernels free of ragged-edge
+    masking (XLA fuses the pad/slice into the surrounding program).
+    """
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x, size
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads, constant_values=value), size
+
+
+def as_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, tuple[int, ...]]:
+    """Collapse leading dims: (..., H) -> (R, H)."""
+    shape = x.shape
+    return x.reshape(-1, shape[-1]), shape
+
+
+NEG_INF = -1e30  # finite mask value, reference kernels use -10000/-inf

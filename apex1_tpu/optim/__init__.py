@@ -1,0 +1,82 @@
+"""Fused optimizers — reference ``apex/optimizers`` + ``apex/contrib/clip_grad``
++ ``apex/parallel/LARC.py``.
+
+Each optimizer is an ``optax.GradientTransformation`` whose update math is
+bit-faithful to the corresponding ``csrc/multi_tensor_*.cu`` functor (moments
+in fp32, same weight-decay modes and flags). The multi-tensor "one kernel for
+all params" property is XLA's job here: the jitted update over the whole
+pytree compiles to a few fused loops.
+
+A thin class facade (`Optimizer`) provides the torch-like
+``opt.step(grads, params)`` shape for users porting from the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import optax
+
+from apex1_tpu.optim.fused_adam import fused_adam, FusedAdamState  # noqa: F401
+from apex1_tpu.optim.fused_lamb import fused_lamb, FusedLAMBState  # noqa: F401
+from apex1_tpu.optim.fused_sgd import fused_sgd, FusedSGDState  # noqa: F401
+from apex1_tpu.optim.fused_novograd import (  # noqa: F401
+    fused_novograd, FusedNovoGradState)
+from apex1_tpu.optim.fused_adagrad import (  # noqa: F401
+    fused_adagrad, FusedAdagradState)
+from apex1_tpu.optim.larc import larc  # noqa: F401
+from apex1_tpu.optim.clip_grad import clip_grad_norm  # noqa: F401
+
+
+class Optimizer:
+    """Torch-shaped facade over a GradientTransformation.
+
+    ``opt = FusedAdam(lr=1e-3); state = opt.init(params);
+    params, state = opt.step(grads, state, params)``
+    """
+
+    def __init__(self, tx: optax.GradientTransformation):
+        self.tx = tx
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, state, params):
+        return self.tx.update(grads, state, params)
+
+    def step(self, grads, state, params):
+        updates, new_state = self.tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
+
+def FusedAdam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+              adam_w_mode=True, bias_correction=True, **_ignored: Any):
+    """Reference-signature constructor (``fused_adam.py :: FusedAdam``)."""
+    return Optimizer(fused_adam(lr, betas[0], betas[1], eps, weight_decay,
+                                adam_w_mode, bias_correction))
+
+
+def FusedLAMB(lr=1e-3, betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+              bias_correction=True, max_grad_norm=1.0, use_nvlamb=False,
+              **_ignored: Any):
+    return Optimizer(fused_lamb(lr, betas[0], betas[1], eps, weight_decay,
+                                bias_correction, max_grad_norm, use_nvlamb))
+
+
+def FusedSGD(lr=1e-3, momentum=0.0, dampening=0.0, weight_decay=0.0,
+             nesterov=False, wd_after_momentum=False, **_ignored: Any):
+    return Optimizer(fused_sgd(lr, momentum, dampening, weight_decay,
+                               nesterov, wd_after_momentum))
+
+
+def FusedNovoGrad(lr=1e-3, betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
+                  grad_averaging=True, init_zero=False, norm_type=2,
+                  bias_correction=True, **_ignored: Any):
+    return Optimizer(fused_novograd(lr, betas[0], betas[1], eps, weight_decay,
+                                    grad_averaging, init_zero, norm_type,
+                                    bias_correction))
+
+
+def FusedAdagrad(lr=1e-2, eps=1e-10, weight_decay=0.0, adagrad_w_mode=False,
+                 **_ignored: Any):
+    return Optimizer(fused_adagrad(lr, eps, weight_decay, adagrad_w_mode))

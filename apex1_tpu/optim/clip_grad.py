@@ -1,0 +1,26 @@
+"""Fused gradient clipping — reference ``apex/contrib/clip_grad/clip_grad.py
+:: clip_grad_norm_`` (drop-in ``torch.nn.utils.clip_grad_norm_`` built on
+``multi_tensor_l2norm`` + ``multi_tensor_scale``).
+
+Functional form: returns (clipped_grads, total_norm). The norm reduction and
+the scale are fused by XLA into the surrounding step, matching the two fused
+kernels of the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.pytree import global_norm, tree_scale
+
+
+def clip_grad_norm(grads, max_norm: float, *, eps: float = 1e-6):
+    """Clip the global L2 norm of ``grads`` to ``max_norm``.
+
+    Unlike the torch API this cannot mutate in place; use the returned tree.
+    ``total_norm`` is returned unclipped (reference return value).
+    """
+    total_norm = global_norm(grads)
+    scale = jnp.minimum(jnp.float32(1.0), max_norm / (total_norm + eps))
+    return tree_scale(grads, scale), total_norm

@@ -1,0 +1,61 @@
+"""Fused Adagrad — reference ``apex/optimizers/fused_adagrad.py ::
+FusedAdagrad`` (kernel ``csrc/multi_tensor_adagrad.cu``).
+
+    h += g²
+    p -= lr * g / (sqrt(h) + eps)
+
+``adagrad_w_mode``: decoupled weight decay (p -= lr*wd*p) instead of L2
+(g += wd*p), mirroring the reference flag.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex1_tpu.core.pytree import tree_map_unzip
+
+
+class FusedAdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: optax.Updates
+
+
+def fused_adagrad(
+    learning_rate: optax.ScalarOrSchedule = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+
+    def init(params):
+        return FusedAdagradState(
+            step=jnp.zeros([], jnp.int32),
+            sum_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+
+        def per_param(g, p, h):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay and not adagrad_w_mode:
+                g32 = g32 + weight_decay * p32
+            h = h + jnp.square(g32)
+            upd = g32 / (jnp.sqrt(h) + eps)
+            if weight_decay and adagrad_w_mode:
+                upd = upd + weight_decay * p32
+            return (-lr * upd).astype(p.dtype), h
+
+        updates, new_h = tree_map_unzip(
+            per_param, 2, grads, params, state.sum_sq)
+        return updates, FusedAdagradState(step=step, sum_sq=new_h)
+
+    return optax.GradientTransformation(init, update)

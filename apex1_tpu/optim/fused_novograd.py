@@ -1,0 +1,93 @@
+"""Fused NovoGrad — reference ``apex/optimizers/fused_novograd.py ::
+FusedNovoGrad`` (kernel ``csrc/multi_tensor_novograd.cu``).
+
+NovoGrad = Adam with a PER-TENSOR (layer-wise) second moment:
+
+    v_t   = β2 * v + (1-β2) * ||g||²        (scalar per tensor;
+                                             init ||g||² on first step, or 0
+                                             with ``init_zero``)
+    g'    = g / (sqrt(v_t) + eps) + wd * p  (``reg_inside_moment``)
+    m_t   = β1 * m + c * g'                 (c = 1-β1 if grad_averaging else 1)
+    p    -= lr * m_hat
+
+``norm_type`` 2 (L2) supported; the reference also allows inf-norm.
+Bias correction follows the reference's ``bias_correction`` flag applied to
+both moments.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex1_tpu.core.pytree import tree_map_unzip
+
+
+class FusedNovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: optax.Updates        # m, per-element fp32
+    exp_avg_sq: optax.Updates     # v, ONE fp32 scalar per tensor
+
+
+def fused_novograd(
+    learning_rate: optax.ScalarOrSchedule = 1e-3,
+    b1: float = 0.95,
+    b2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+    init_zero: bool = False,
+    norm_type: int = 2,
+    bias_correction: bool = True,
+) -> optax.GradientTransformation:
+    if norm_type not in (2, float("inf")):
+        raise ValueError("norm_type must be 2 or inf")
+
+    def tensor_norm_sq(g):
+        if norm_type == 2:
+            return jnp.sum(jnp.square(g))
+        return jnp.square(jnp.max(jnp.abs(g)))
+
+    def init(params):
+        return FusedNovoGradState(
+            step=jnp.zeros([], jnp.int32),
+            exp_avg=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+            exp_avg_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros([], jnp.float32), params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+        first = state.step == 0
+        if bias_correction:
+            bc1 = 1.0 - jnp.power(jnp.float32(b1), step.astype(jnp.float32))
+            bc2 = 1.0 - jnp.power(jnp.float32(b2), step.astype(jnp.float32))
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        c = (1.0 - b1) if grad_averaging else 1.0
+
+        def per_param(g, p, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            nsq = tensor_norm_sq(g32)
+            v_init = jnp.float32(0.0) if init_zero else nsq
+            new_v = jnp.where(first, v_init, b2 * v + (1.0 - b2) * nsq)
+            denom = jnp.sqrt(new_v / bc2) + eps
+            gp = g32 / denom
+            if weight_decay:
+                gp = gp + weight_decay * p32
+            new_m = b1 * m + c * gp
+            return (-lr * (new_m / bc1)).astype(p.dtype), new_m, new_v
+
+        updates, new_m, new_v = tree_map_unzip(
+            per_param, 3, grads, params, state.exp_avg, state.exp_avg_sq)
+        return updates, FusedNovoGradState(step=step, exp_avg=new_m,
+                                           exp_avg_sq=new_v)
+
+    return optax.GradientTransformation(init, update)

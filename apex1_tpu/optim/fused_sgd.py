@@ -1,0 +1,75 @@
+"""Fused momentum SGD — reference ``apex/optimizers/fused_sgd.py :: FusedSGD``
+(kernel ``csrc/multi_tensor_sgd_kernel.cu :: SGDFunctor``).
+
+torch-SGD semantics preserved (the reference is a drop-in ``torch.optim.SGD``):
+
+    g = g + wd * p
+    buf = momentum * buf + (1 - dampening) * g     (buf := g on first step)
+    g = g + momentum * buf   if nesterov else buf
+    p -= lr * g
+
+``wd_after_momentum`` (reference ctor flag) applies weight decay to the
+post-momentum update instead.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex1_tpu.core.pytree import tree_map_unzip
+
+
+class FusedSGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: optax.Updates
+
+
+def fused_sgd(
+    learning_rate: optax.ScalarOrSchedule = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    wd_after_momentum: bool = False,
+) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("nesterov requires momentum > 0 and dampening == 0")
+
+    def init(params):
+        return FusedSGDState(
+            step=jnp.zeros([], jnp.int32),
+            momentum_buf=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+        first = state.step == 0
+
+        def per_param(g, p, buf):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay and not wd_after_momentum:
+                g32 = g32 + weight_decay * p32
+            if momentum:
+                new_buf = jnp.where(first, g32,
+                                    momentum * buf + (1.0 - dampening) * g32)
+                d = g32 + momentum * new_buf if nesterov else new_buf
+            else:
+                new_buf = buf
+                d = g32
+            if weight_decay and wd_after_momentum:
+                d = d + weight_decay * p32
+            return (-lr * d).astype(p.dtype), new_buf
+
+        updates, bufs = tree_map_unzip(
+            per_param, 2, grads, params, state.momentum_buf)
+        return updates, FusedSGDState(step=step, momentum_buf=bufs)
+
+    return optax.GradientTransformation(init, update)

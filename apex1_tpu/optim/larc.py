@@ -1,0 +1,74 @@
+"""LARC — reference ``apex/parallel/LARC.py :: LARC``.
+
+Layer-wise Adaptive Rate Clipping: wraps any optimizer; before the wrapped
+step, per-parameter gradients are rescaled by an adaptive local LR
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + wd * ||p|| + eps)
+
+- ``clip=True`` (LARC): effective lr = min(local_lr / global_lr, 1) — the
+  adaptive rate CLIPS the global schedule. Implemented, as in the reference,
+  by scaling the gradient so the wrapped optimizer's lr*g gives the clipped
+  step.
+- ``clip=False`` (LARS): gradient scaled by local_lr directly.
+
+The reference mutates ``p.grad`` in-place then restores weight-decay
+bookkeeping; functionally this is an ``optax``-style gradient pre-transform
+chained before the inner optimizer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc(
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    learning_rate: optax.ScalarOrSchedule | None = None,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Gradient pre-transform; chain as
+    ``optax.chain(larc(..., learning_rate=lr, weight_decay=wd),
+    fused_sgd(lr, weight_decay=wd))``.
+    ``learning_rate`` is needed only for ``clip=True`` (to form the ratio
+    against the global schedule, as the reference divides by ``group['lr']``);
+    ``weight_decay`` must match the wrapped optimizer's so the denominator
+    ``||g|| + wd*||p||`` matches the reference (which reads it from the
+    param group)."""
+
+    def init(params):
+        del params
+        return jnp.zeros([], jnp.int32)  # step count (for lr schedules)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+        step = state + 1
+        if clip:
+            if learning_rate is None:
+                raise ValueError("clip=True requires learning_rate")
+            lr = (learning_rate(step) if callable(learning_rate)
+                  else learning_rate)
+
+        def per_param(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            local_lr = trust_coefficient * p_norm / (
+                g_norm + weight_decay * p_norm + eps)
+            # reference guards: only adapt when both norms are nonzero
+            ok = (p_norm > 0) & (g_norm > 0)
+            if clip:
+                factor = jnp.minimum(local_lr / lr, 1.0)
+            else:
+                factor = local_lr
+            factor = jnp.where(ok, factor, 1.0)
+            return (g32 * factor).astype(g.dtype)
+
+        return (jax.tree_util.tree_map(per_param, grads, params), step)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,237 @@
+"""Kernel parity tests — ≙ ``tests/L0/run_fused_layer_norm``,
+``apex/contrib/test/{xentropy,layer_norm,multihead_attn}``: each Pallas
+kernel (interpret mode on CPU) vs the pure-jnp gold, fwd values AND grads,
+at fp32/bf16 tolerances."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu import ops
+from apex1_tpu.ops import _common
+
+FP32_TOL = dict(rtol=1e-5, atol=1e-5)
+BF16_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def check_fwd_bwd(fn_pallas, fn_gold, args, diff_argnums=(0,), tol=FP32_TOL):
+    """Compare primal and grads (summed-output scalar) across impls."""
+    out_p = fn_pallas(*args)
+    out_g = fn_gold(*args)
+    np.testing.assert_allclose(np.asarray(out_p, np.float32),
+                               np.asarray(out_g, np.float32), **tol)
+
+    def scalar_p(*a):
+        return jnp.sum(fn_pallas(*a).astype(jnp.float32) ** 2)
+
+    def scalar_g(*a):
+        return jnp.sum(fn_gold(*a).astype(jnp.float32) ** 2)
+
+    gp = jax.grad(scalar_p, argnums=diff_argnums)(*args)
+    gg = jax.grad(scalar_g, argnums=diff_argnums)(*args)
+    for a, b in zip(gp, gg):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **tol)
+
+
+class TestLayerNorm:
+    @pytest.mark.parametrize("shape", [(4, 8, 256), (3, 384), (16, 130)])
+    def test_parity_fp32(self, rng, shape):
+        x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        g = jnp.asarray(rng.normal(size=shape[-1:]) + 1.0, jnp.float32)
+        b = jnp.asarray(rng.normal(size=shape[-1:]), jnp.float32)
+
+        def pallas_fn(x, g, b):
+            with _common.force_impl("pallas"):
+                return ops.layer_norm(x, g, b)
+
+        def gold_fn(x, g, b):
+            with _common.force_impl("xla"):
+                return ops.layer_norm(x, g, b)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x, g, b), diff_argnums=(0, 1, 2))
+
+    def test_mixed_dtype_bf16(self, rng):
+        x = jnp.asarray(rng.normal(size=(6, 256)), jnp.bfloat16)
+        g = jnp.asarray(rng.normal(size=(256,)) + 1.0, jnp.float32)
+        b = jnp.zeros((256,), jnp.float32)
+        with _common.force_impl("pallas"):
+            y = ops.layer_norm(x, g, b)
+        assert y.dtype == jnp.bfloat16
+        with _common.force_impl("xla"):
+            y_gold = ops.layer_norm(x, g, b)
+        np.testing.assert_allclose(np.asarray(y, np.float32),
+                                   np.asarray(y_gold, np.float32), **BF16_TOL)
+
+    def test_normalization_property(self, rng):
+        # unit-affine LN output has ~zero mean, ~unit var per row
+        x = jnp.asarray(rng.normal(size=(4, 512)) * 7 + 3, jnp.float32)
+        with _common.force_impl("pallas"):
+            y = ops.layer_norm(x, jnp.ones(512), jnp.zeros(512))
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.var(y, -1)), 1.0,
+                                   rtol=1e-3)
+
+
+class TestRMSNorm:
+    @pytest.mark.parametrize("shape", [(4, 256), (2, 5, 384)])
+    def test_parity(self, rng, shape):
+        x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        g = jnp.asarray(rng.normal(size=shape[-1:]) + 1.0, jnp.float32)
+
+        def pallas_fn(x, g):
+            with _common.force_impl("pallas"):
+                return ops.rms_norm(x, g)
+
+        def gold_fn(x, g):
+            with _common.force_impl("xla"):
+                return ops.rms_norm(x, g)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x, g), diff_argnums=(0, 1))
+
+    def test_module(self, rng):
+        m = ops.FusedRMSNorm(256)
+        x = jnp.asarray(rng.normal(size=(3, 256)), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == x.shape
+
+
+class TestSoftmax:
+    def test_causal_parity(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 4, 16, 16)), jnp.float32)
+
+        def pallas_fn(x):
+            with _common.force_impl("pallas"):
+                return ops.scaled_upper_triang_masked_softmax(x, scale=0.5)
+
+        def gold_fn(x):
+            with _common.force_impl("xla"):
+                return ops.scaled_upper_triang_masked_softmax(x, scale=0.5)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x,))
+        # causal property: strictly-upper entries are 0
+        y = pallas_fn(x)
+        up = np.triu(np.ones((16, 16)), k=1).astype(bool)
+        assert np.all(np.asarray(y)[..., up] < 1e-7)
+
+    def test_masked_parity(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 2, 8, 24)), jnp.float32)
+        mask = jnp.where(
+            jnp.asarray(rng.random((2, 1, 8, 24)) < 0.3), ops.NEG_INF, 0.0)
+
+        def pallas_fn(x, m):
+            with _common.force_impl("pallas"):
+                return ops.scaled_masked_softmax(x, m, scale=2.0)
+
+        def gold_fn(x, m):
+            with _common.force_impl("xla"):
+                return ops.scaled_masked_softmax(x, m, scale=2.0)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x, mask))
+
+    def test_rows_sum_to_one(self, rng):
+        x = jnp.asarray(rng.normal(size=(3, 2, 8, 40)), jnp.float32)
+        with _common.force_impl("pallas"):
+            y = ops.scaled_masked_softmax(x, None, scale=1.0)
+        np.testing.assert_allclose(np.asarray(jnp.sum(y, -1)), 1.0,
+                                   rtol=1e-5)
+
+    def test_adapter(self, rng):
+        x = jnp.asarray(rng.normal(size=(1, 2, 8, 8)), jnp.float32)
+        sm = ops.FusedScaleMaskSoftmax(attn_mask_type="causal", scale=1.0)
+        y = sm(x)
+        assert y.shape == x.shape
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_parity(self, rng, smoothing):
+        V = 307  # non-multiple of 128 exercises padding
+        logits = jnp.asarray(rng.normal(size=(10, V)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, size=(10,)), jnp.int32)
+
+        def pallas_fn(lg):
+            with _common.force_impl("pallas"):
+                return ops.softmax_cross_entropy_loss(
+                    lg, labels, smoothing=smoothing)
+
+        def gold_fn(lg):
+            with _common.force_impl("xla"):
+                return ops.softmax_cross_entropy_loss(
+                    lg, labels, smoothing=smoothing)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (logits,))
+
+    def test_padding_idx(self, rng):
+        V = 128
+        logits = jnp.asarray(rng.normal(size=(6, V)), jnp.float32)
+        labels = jnp.asarray([1, 2, 0, 3, 0, 5], jnp.int32)
+
+        def loss_sum(lg):
+            with _common.force_impl("pallas"):
+                return jnp.sum(ops.softmax_cross_entropy_loss(
+                    lg, labels, padding_idx=0))
+
+        loss = ops.softmax_cross_entropy_loss(logits, labels, padding_idx=0)
+        assert float(loss[2]) == 0.0 and float(loss[4]) == 0.0
+        g = jax.grad(loss_sum)(logits)
+        np.testing.assert_allclose(np.asarray(g[2]), 0.0, atol=1e-7)
+        assert np.abs(np.asarray(g[0])).max() > 0
+
+    def test_vs_manual_ce(self, rng):
+        # plain CE (no smoothing) vs -log_softmax[target]
+        V = 256
+        logits = jnp.asarray(rng.normal(size=(8, V)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, size=(8,)), jnp.int32)
+        with _common.force_impl("pallas"):
+            loss = ops.softmax_cross_entropy_loss(logits, labels)
+        manual = -jax.nn.log_softmax(logits)[jnp.arange(8), labels]
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(manual),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestRoPE:
+    @pytest.mark.parametrize("interleaved", [False, True])
+    def test_parity(self, rng, interleaved):
+        B, S, H, D = 2, 16, 4, 256  # half=128 → pallas path
+        x = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+        cos, sin = ops.rope_tables(jnp.arange(S), D)
+
+        def pallas_fn(x):
+            with _common.force_impl("pallas"):
+                return ops.apply_rotary_pos_emb(x, cos, sin,
+                                                interleaved=interleaved)
+
+        def gold_fn(x):
+            with _common.force_impl("xla"):
+                return ops.apply_rotary_pos_emb(x, cos, sin,
+                                                interleaved=interleaved)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x,))
+
+    def test_norm_preserved(self, rng):
+        # rotations preserve the norm of each (x1,x2) pair
+        B, S, D = 1, 8, 64
+        x = jnp.asarray(rng.normal(size=(B, S, D)), jnp.float32)
+        cos, sin = ops.rope_tables(jnp.arange(S), D)
+        y = ops.apply_rotary_pos_emb(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+    def test_grad_is_inverse_rotation(self, rng):
+        S, D = 4, 32
+        x = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+        cos, sin = ops.rope_tables(jnp.arange(S), D)
+        # d/dx sum(rope(x) * t) == rope^T(t) == rope with -sin
+        t = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+        g = jax.grad(lambda x: jnp.sum(ops.apply_rotary_pos_emb(
+            x, cos, sin) * t))(x)
+        expected = ops.apply_rotary_pos_emb(t, cos, -sin)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-6)

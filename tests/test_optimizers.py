@@ -1,0 +1,247 @@
+"""Optimizer parity tests — ≙ ``tests/L0/run_optimizers/test_fused_optimizer.py``:
+step the fused optimizer and a gold reference (torch CPU where available,
+hand-written numpy elsewhere) on identical params/grads and assert per-step
+allclose."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex1_tpu import optim
+
+try:
+    import torch
+    HAS_TORCH = True
+except ImportError:
+    HAS_TORCH = False
+
+
+def make_tree(rng, scale=1.0):
+    return {
+        "w": jnp.asarray(rng.normal(size=(17, 31)) * scale, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(31,)) * scale, jnp.float32),
+        "deep": {"k": jnp.asarray(rng.normal(size=(5, 3, 2)), jnp.float32)},
+    }
+
+
+def grads_like(rng, tree, scale=0.1):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape) * scale, jnp.float32),
+        tree)
+
+
+def torch_params_from(tree):
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    return [torch.nn.Parameter(torch.tensor(np.asarray(x))) for x in leaves]
+
+
+def assert_tree_close(tree, torch_params, rtol=1e-5, atol=1e-6):
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    for ours, theirs in zip(leaves, torch_params):
+        np.testing.assert_allclose(np.asarray(ours),
+                                   theirs.detach().numpy(),
+                                   rtol=rtol, atol=atol)
+
+
+def run_both(opt, torch_opt, tree, torch_params, rng, n_steps=5):
+    state = opt.init(tree)
+    for _ in range(n_steps):
+        g = grads_like(rng, tree)
+        g_leaves, _ = jax.tree_util.tree_flatten(g)
+        for p, gl in zip(torch_params, g_leaves):
+            p.grad = torch.tensor(np.asarray(gl))
+        tree, state = opt.step(g, state, tree)
+        torch_opt.step()
+        assert_tree_close(tree, torch_params)
+    return tree
+
+
+@pytest.mark.skipif(not HAS_TORCH, reason="torch gold unavailable")
+class TestVsTorch:
+    def test_adamw_mode(self, rng):
+        tree = make_tree(rng)
+        tp = torch_params_from(tree)
+        run_both(optim.FusedAdam(lr=1e-2, weight_decay=0.05, adam_w_mode=True),
+                 torch.optim.AdamW(tp, lr=1e-2, weight_decay=0.05),
+                 tree, tp, rng)
+
+    def test_adam_l2_mode(self, rng):
+        tree = make_tree(rng)
+        tp = torch_params_from(tree)
+        run_both(optim.FusedAdam(lr=1e-2, weight_decay=0.05,
+                                 adam_w_mode=False),
+                 torch.optim.Adam(tp, lr=1e-2, weight_decay=0.05),
+                 tree, tp, rng)
+
+    def test_adam_no_bias_correction(self, rng):
+        tree = make_tree(rng)
+        opt = optim.FusedAdam(lr=1e-2, bias_correction=False)
+        state = opt.init(tree)
+        g = grads_like(rng, tree)
+        new, _ = opt.step(g, state, tree)
+        # without bias correction the first step is tiny (m = 0.1*g)
+        delta = np.asarray(new["b"] - tree["b"])
+        g32 = np.asarray(g["b"])
+        expected = -1e-2 * (0.1 * g32) / (np.sqrt(0.001 * g32 ** 2) + 1e-8)
+        np.testing.assert_allclose(delta, expected, rtol=1e-4, atol=1e-7)
+
+    def test_sgd_momentum_nesterov(self, rng):
+        for nesterov in (False, True):
+            tree = make_tree(rng)
+            tp = torch_params_from(tree)
+            run_both(
+                optim.FusedSGD(lr=1e-2, momentum=0.9, weight_decay=1e-4,
+                               nesterov=nesterov),
+                torch.optim.SGD(tp, lr=1e-2, momentum=0.9, weight_decay=1e-4,
+                                nesterov=nesterov),
+                tree, tp, rng)
+
+    def test_sgd_dampening(self, rng):
+        tree = make_tree(rng)
+        tp = torch_params_from(tree)
+        run_both(optim.FusedSGD(lr=1e-2, momentum=0.9, dampening=0.3),
+                 torch.optim.SGD(tp, lr=1e-2, momentum=0.9, dampening=0.3),
+                 tree, tp, rng)
+
+    def test_adagrad(self, rng):
+        tree = make_tree(rng)
+        tp = torch_params_from(tree)
+        run_both(optim.FusedAdagrad(lr=1e-2, eps=1e-10),
+                 torch.optim.Adagrad(tp, lr=1e-2, eps=1e-10),
+                 tree, tp, rng)
+
+
+class TestLAMB:
+    def gold_lamb_step(self, params, grads, m, v, step, lr=1e-2, b1=0.9,
+                       b2=0.999, eps=1e-6, wd=0.01, max_gn=1.0):
+        flat_g = np.concatenate([np.asarray(g).ravel()
+                                 for g in jax.tree_util.tree_leaves(grads)])
+        gnorm = np.linalg.norm(flat_g)
+        clip = max(1.0, gnorm / max_gn)
+        out = {}
+        for k in ("w", "b"):
+            g = np.asarray(grads[k]) / clip
+            p = np.asarray(params[k])
+            m[k] = b1 * m[k] + (1 - b1) * g
+            v[k] = b2 * v[k] + (1 - b2) * g * g
+            mh = m[k] / (1 - b1 ** step)
+            vh = v[k] / (1 - b2 ** step)
+            u = mh / (np.sqrt(vh) + eps) + wd * p
+            wn, un = np.linalg.norm(p), np.linalg.norm(u)
+            ratio = wn / un if (wn > 0 and un > 0) else 1.0
+            out[k] = p - lr * ratio * u
+        return out
+
+    def test_vs_gold(self, rng):
+        tree = {"w": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32),
+                "b": jnp.asarray(rng.normal(size=(4,)), jnp.float32)}
+        opt = optim.FusedLAMB(lr=1e-2, weight_decay=0.01)
+        state = opt.init(tree)
+        m = {k: np.zeros(np.shape(v)) for k, v in tree.items()}
+        v = {k: np.zeros(np.shape(x)) for k, x in tree.items()}
+        gold = {k: np.asarray(x) for k, x in tree.items()}
+        for step in range(1, 5):
+            g = grads_like(rng, tree, scale=1.0)
+            gold = self.gold_lamb_step(gold, g, m, v, step)
+            tree, state = opt.step(g, state, tree)
+            for k in gold:
+                np.testing.assert_allclose(np.asarray(tree[k]), gold[k],
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_trust_ratio_skipped_without_wd(self, rng):
+        # wd=0, use_nvlamb=False → plain AdamW-like step (ratio 1);
+        # use_nvlamb=True applies the ratio anyway → different update.
+        tree = {"w": jnp.asarray(rng.normal(size=(4, 4)) * 5, jnp.float32)}
+        g = {"w": jnp.asarray(rng.normal(size=(4, 4)) * 0.1, jnp.float32)}
+        opt_plain = optim.FusedLAMB(lr=1e-2, weight_decay=0.0,
+                                    use_nvlamb=False, max_grad_norm=1e9)
+        opt_nv = optim.FusedLAMB(lr=1e-2, weight_decay=0.0,
+                                 use_nvlamb=True, max_grad_norm=1e9)
+        p1, _ = opt_plain.step(g, opt_plain.init(tree), tree)
+        p2, _ = opt_nv.step(g, opt_nv.init(tree), tree)
+        assert not np.allclose(np.asarray(p1["w"]), np.asarray(p2["w"]))
+
+
+class TestNovoGrad:
+    def test_vs_gold(self, rng):
+        b1, b2, eps, lr, wd = 0.95, 0.98, 1e-8, 1e-2, 0.01
+        tree = {"w": jnp.asarray(rng.normal(size=(6, 5)), jnp.float32)}
+        opt = optim.FusedNovoGrad(lr=lr, weight_decay=wd,
+                                  bias_correction=False)
+        state = opt.init(tree)
+        p = np.asarray(tree["w"], np.float64)
+        m = np.zeros_like(p)
+        v = 0.0
+        for step in range(1, 5):
+            g = grads_like(rng, tree, scale=1.0)
+            gn = np.asarray(g["w"], np.float64)
+            nsq = (gn ** 2).sum()
+            v = nsq if step == 1 else b2 * v + (1 - b2) * nsq
+            gp = gn / (np.sqrt(v) + eps) + wd * p
+            m = b1 * m + (1 - b1) * gp
+            p = p - lr * m
+            tree, state = opt.step(g, state, tree)
+            np.testing.assert_allclose(np.asarray(tree["w"]), p,
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestLARC:
+    def test_clip_reduces_update(self, rng):
+        # huge grads: LARC-clipped step must be smaller than raw SGD step
+        tree = {"w": jnp.ones((4, 4), jnp.float32) * 0.01}
+        g = {"w": jnp.ones((4, 4), jnp.float32) * 100.0}
+        lr = 0.1
+        tx = optax.chain(optim.larc(trust_coefficient=0.02,
+                                    learning_rate=lr),
+                         optim.fused_sgd(lr))
+        state = tx.init(tree)
+        upd, _ = tx.update(g, state, tree)
+        raw = -lr * np.asarray(g["w"])
+        np.testing.assert_array_less(np.abs(np.asarray(upd["w"])),
+                                     np.abs(raw))
+
+    def test_noop_when_local_lr_large(self, rng):
+        # tiny grads → local_lr/lr > 1 → clip to 1 → exact SGD
+        tree = {"w": jnp.ones((4,), jnp.float32)}
+        g = {"w": jnp.full((4,), 1e-6, jnp.float32)}
+        lr = 0.1
+        tx = optax.chain(optim.larc(learning_rate=lr), optim.fused_sgd(lr))
+        upd, _ = tx.update(g, tx.init(tree), tree)
+        np.testing.assert_allclose(np.asarray(upd["w"]),
+                                   -lr * np.asarray(g["w"]), rtol=1e-6)
+
+
+class TestClipGrad:
+    def test_clip(self, rng):
+        g = {"a": jnp.full((3,), 4.0), "b": jnp.full((4,), 3.0)}
+        clipped, norm = optim.clip_grad_norm(g, max_norm=1.0)
+        expected_norm = np.sqrt(3 * 16 + 4 * 9)
+        np.testing.assert_allclose(float(norm), expected_norm, rtol=1e-6)
+        new_norm = float(jnp.sqrt(sum(jnp.sum(x ** 2)
+                                      for x in jax.tree.leaves(clipped))))
+        np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+    def test_noop_below_max(self, rng):
+        g = {"a": jnp.full((2,), 0.1)}
+        clipped, norm = optim.clip_grad_norm(g, max_norm=10.0)
+        np.testing.assert_allclose(np.asarray(clipped["a"]),
+                                   np.asarray(g["a"]), rtol=1e-6)
+
+
+class TestJit:
+    def test_adam_step_jits(self, rng):
+        tree = make_tree(rng)
+        opt = optim.FusedAdam(lr=1e-3)
+        state = opt.init(tree)
+        g = grads_like(rng, tree)
+
+        @jax.jit
+        def step(g, s, p):
+            return opt.step(g, s, p)
+
+        p1, s1 = step(g, state, tree)
+        p2, s2 = step(g, s1, p1)
+        assert int(s2.step) == 2
+        assert jnp.all(jnp.isfinite(p2["w"]))
