@@ -1,0 +1,181 @@
+"""Mixed-precision training services — reference ``apex/amp``.
+
+The reference's ``amp.initialize(model, optimizer, opt_level)`` mutates a
+torch model/optimizer in place (monkey-patching ops for O1, casting the
+model + building fp32 master weights for O2) and ``amp.scale_loss`` wraps
+``backward()``. In JAX the whole step is one traced function, so the same
+capabilities become explicit state + a step builder:
+
+    amp = Amp(tx=fused_adam(1e-4), opt_level="O2")
+    state = amp.init(params)
+    step = jax.jit(amp.make_train_step(loss_fn))
+    state, metrics = step(state, batch)
+
+Correspondence:
+- fp32 master weights (O2)  → ``state.params`` are ALWAYS fp32 (policy
+  ``param_dtype``); compute sees ``policy.cast_to_compute(params)`` inside
+  the grad, so grads arrive in fp32 against the masters
+  (``_process_optimizer.py :: _master_params_to_model_params`` has no
+  equivalent code — the cast is re-traced each step, free under jit).
+- op lists (O1)             → ``policy.fp32_fragile_ops`` consumed by
+  `apex1_tpu.ops` kernels.
+- ``scale_loss`` + overflow skip → ``loss_scale`` state threaded through;
+  non-finite grads skip the update via ``select_tree`` (device-side, no
+  host sync — ≙ ``amp_C`` noop_flag) and halve the scale.
+- ``amp.state_dict()``      → ``state.loss_scale`` is part of the pytree
+  and checkpoints with everything else.
+
+Reference anchors: ``apex/amp/frontend.py :: initialize``,
+``apex/amp/handle.py :: scale_loss``, ``apex/amp/_process_optimizer.py``,
+``apex/amp/scaler.py :: LossScaler``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex1_tpu.core.loss_scale import (LossScaleState, all_finite,
+                                       make_loss_scale, select_tree)
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy
+from apex1_tpu.core.pytree import global_norm
+
+
+@chex.dataclass
+class AmpState:
+    """Train state: fp32 master params + optimizer state + loss-scale state.
+
+    ≙ the (model, optimizer, amp.state_dict()) triple the reference
+    checkpoints (README "checkpointing" recipe).
+    """
+
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    loss_scale: LossScaleState
+
+
+class Amp:
+    """Bundle of precision policy + optimizer transform.
+
+    ``opt_level``/overrides mirror ``amp.initialize`` kwargs:
+    ``Amp(tx, opt_level="O2", loss_scale=128.0, keep_norms_fp32=False)``.
+    """
+
+    def __init__(self, tx: optax.GradientTransformation,
+                 opt_level: str | PrecisionPolicy = "O1",
+                 max_grad_norm: float | None = None,
+                 grad_psum_axes: tuple[str, ...] = (),
+                 **policy_overrides):
+        self.tx = tx
+        self.policy = get_policy(opt_level, **policy_overrides)
+        self.scaler = make_loss_scale(self.policy.loss_scale)
+        self.max_grad_norm = max_grad_norm
+        # mesh axes to pmean grads over (shard_map DDP; pjit needs none)
+        self.grad_psum_axes = tuple(grad_psum_axes)
+
+    # -- setup (≙ amp.initialize) ------------------------------------------
+    def init(self, params) -> AmpState:
+        params = self.policy.cast_to_param(params)
+        return AmpState(step=jnp.zeros([], jnp.int32),
+                        params=params,
+                        opt_state=self.tx.init(params),
+                        loss_scale=self.scaler.init())
+
+    # -- per-step (≙ scale_loss + optimizer.step) --------------------------
+    def make_train_step(self, loss_fn: Callable, *,
+                        has_aux: bool = False) -> Callable:
+        """``loss_fn(params_compute, *batch) -> loss`` (or ``(loss, aux)``).
+
+        The returned function is pure — wrap it in ``jax.jit`` / ``pjit`` /
+        ``shard_map``. Under data parallelism with pjit, gradient psums come
+        from sharding; under shard_map pass ``grad_psum_axes=("dp",)``.
+        """
+        policy, scaler = self.policy, self.scaler
+
+        def train_step(state: AmpState, *batch):
+            def scaled_loss_fn(master_params):
+                compute_params = policy.cast_to_compute(master_params)
+                out = loss_fn(compute_params, *batch)
+                loss, aux = out if has_aux else (out, None)
+                return scaler.scale(loss.astype(jnp.float32),
+                                    state.loss_scale), (loss, aux)
+
+            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
+                state.params)
+            for ax in self.grad_psum_axes:
+                grads = jax.lax.pmean(grads, ax)
+            grads = scaler.unscale(grads, state.loss_scale)
+            finite = all_finite(grads, axis_names=self.grad_psum_axes)
+            gnorm = global_norm(grads)
+            if self.max_grad_norm is not None:
+                from apex1_tpu.optim.clip_grad import clip_grad_norm
+                grads, _ = clip_grad_norm(grads, self.max_grad_norm)
+
+            updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                                    state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            # skip-on-overflow: keep old params/opt state (≙ noop_flag)
+            new_params = select_tree(finite, new_params, state.params)
+            new_opt_state = select_tree(finite, new_opt_state,
+                                        state.opt_state)
+            new_state = AmpState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                loss_scale=scaler.adjust(state.loss_scale, finite),
+            )
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "grad_norm": gnorm,
+                "loss_scale": state.loss_scale.scale,
+                "grads_finite": finite,
+                "skipped_steps": new_state.loss_scale.overflow_count,
+            }
+            if has_aux:
+                metrics["aux"] = aux
+            return new_state, metrics
+
+        return train_step
+
+    # -- parity helpers ----------------------------------------------------
+    def master_params(self, state: AmpState):
+        """≙ ``amp.master_params(optimizer)`` — the fp32 weights."""
+        return state.params
+
+    def model_params(self, state: AmpState):
+        """The compute-dtype view the model consumes (O2's fp16 model)."""
+        return self.policy.cast_to_compute(state.params)
+
+    def state_dict(self, state: AmpState):
+        """≙ ``amp.state_dict()`` — loss-scaler state for checkpointing."""
+        return {"loss_scale": state.loss_scale.scale,
+                "growth_count": state.loss_scale.growth_count,
+                "overflow_count": state.loss_scale.overflow_count}
+
+    def load_state_dict(self, state: AmpState, sd) -> AmpState:
+        return dataclasses.replace(
+            state,
+            loss_scale=LossScaleState(
+                scale=jnp.asarray(sd["loss_scale"], jnp.float32),
+                growth_count=jnp.asarray(sd["growth_count"], jnp.int32),
+                overflow_count=jnp.asarray(sd["overflow_count"],
+                                           jnp.int32)))
+
+
+def initialize(params, tx, opt_level: str = "O1", **overrides):
+    """One-call form mirroring ``amp.initialize(model, optimizer,
+    opt_level)``: returns ``(amp, state)``."""
+    amp = Amp(tx=tx, opt_level=opt_level, **overrides)
+    return amp, amp.init(params)
+
+
+def scale_loss(loss, loss_scale_state: LossScaleState):
+    """Shape-parity helper for hand-rolled steps
+    (≙ ``with amp.scale_loss(loss, opt) as scaled:``)."""
+    return loss * loss_scale_state.scale.astype(loss.dtype)

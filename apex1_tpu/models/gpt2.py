@@ -1,0 +1,135 @@
+"""GPT-2 — BASELINE config 1 model ("GPT-2 125M, amp O1 + Adam").
+
+The reference repo has no model zoo (apex bolts onto user models; its test
+models live in ``apex/transformer/testing/standalone_gpt.py``). This is the
+equivalent standalone model, built from this framework's fused ops:
+FusedLayerNorm, scaled_upper_triang_masked_softmax, softmax_cross_entropy
+— pre-LN transformer with learned positions, GELU MLP, weight-tied LM head.
+
+Policy-aware: ``policy.compute_dtype`` drives activations/matmuls; norms and
+softmax run fp32 when ``keep_norms_fp32``/``fp32_fragile_ops`` ask for it
+(the O1 op-list semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy
+from apex1_tpu.ops import (layer_norm, scaled_upper_triang_masked_softmax,
+                           softmax_cross_entropy_loss)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    policy: PrecisionPolicy = dataclasses.field(
+        default_factory=lambda: get_policy("O0"))
+
+    @staticmethod
+    def gpt2_125m(**kw) -> "GPT2Config":
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        defaults = dict(vocab_size=256, max_seq_len=128, num_layers=2,
+                        num_heads=4, hidden_size=128)
+        defaults.update(kw)
+        return GPT2Config(**defaults)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        h = cfg.hidden_size
+        nh = cfg.num_heads
+        hd = h // nh
+
+        def norm(name, z):
+            gamma = self.param(f"{name}_scale", nn.initializers.ones, (h,),
+                               jnp.float32)
+            beta = self.param(f"{name}_bias", nn.initializers.zeros, (h,),
+                              jnp.float32)
+            if not cfg.policy.keep_norms_fp32:
+                gamma, beta = gamma.astype(dtype), beta.astype(dtype)
+            return layer_norm(z, gamma, beta)
+
+        # attention
+        y = norm("ln1", x)
+        qkv = nn.Dense(3 * h, dtype=dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, S = x.shape[0], x.shape[1]
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        probs = scaled_upper_triang_masked_softmax(
+            scores, scale=1.0 / jnp.sqrt(hd).astype(jnp.float32))
+        probs = probs.astype(dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, h)
+        x = x + nn.Dense(h, dtype=dtype, name="proj")(attn)
+
+        # MLP
+        y = norm("ln2", x)
+        y = nn.Dense(cfg.mlp_ratio * h, dtype=dtype, name="fc_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(h, dtype=dtype, name="fc_out")(y)
+        return x + y
+
+
+class GPT2(nn.Module):
+    """Returns logits; `loss` computes the fused CE."""
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic=True):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        B, S = tokens.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        x = wte[tokens].astype(dtype) + wpe[:S].astype(dtype)[None]
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"h{i}")(x, deterministic=deterministic)
+        gamma = self.param("lnf_scale", nn.initializers.ones,
+                           (cfg.hidden_size,), jnp.float32)
+        beta = self.param("lnf_bias", nn.initializers.zeros,
+                          (cfg.hidden_size,), jnp.float32)
+        x = layer_norm(x, gamma, beta)
+        logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),
+                            wte.astype(dtype),
+                            preferred_element_type=jnp.float32)
+        return logits
+
+
+def gpt2_loss_fn(model: GPT2):
+    """``loss_fn(params, tokens) -> scalar`` for `Amp.make_train_step`:
+    next-token CE via the fused xentropy kernel (O1 runs it fp32 —
+    FP32_FUNCS list)."""
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        losses = softmax_cross_entropy_loss(
+            logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
+        return jnp.mean(losses)
+
+    return loss_fn

@@ -1,0 +1,178 @@
+"""Model-parallel topology state — reference
+``apex/transformer/parallel_state.py :: initialize_model_parallel``.
+
+The reference carves ``world_size`` NCCL ranks into TP groups (contiguous
+ranks), then DP, then PP (strided outermost), plus embedding groups
+({first, last} PP stage) and virtual-pipeline bookkeeping, all stored in
+module-level globals that every transformer module queries.
+
+TPU-native: ONE ``jax.sharding.Mesh`` is the topology — a mesh axis IS a
+process group. This module keeps the reference's *API shape* (initialize /
+getters / destroy, module-level state) so Megatron-style code ports
+mechanically, while the returned objects are mesh axes and sizes. "Rank"
+getters are meaningful only inside ``shard_map``-ped code, where they return
+traced ``jax.lax.axis_index`` values.
+
+Mesh layout matches the reference's rank order: TP innermost (contiguous
+devices ⇒ fastest ICI), then CP, then PP, then DP/FSDP outermost (DCN on
+multi-slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from apex1_tpu.core import mesh as mesh_lib
+from apex1_tpu.core.mesh import (AXIS_CP, AXIS_DP, AXIS_FSDP, AXIS_PP,
+                                 AXIS_TP, MeshConfig)
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PP_SIZE: Optional[int] = None
+_VIRTUAL_PP_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: int | None = None,
+    context_parallel_size: int = 1,
+    fsdp_size: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build and install the global mesh (≙ creating the NCCL groups).
+
+    Data-parallel size is inferred as world // (tp·pp·cp·fsdp), exactly as
+    the reference infers DP from world_size.
+    """
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    if _MESH is not None:
+        raise RuntimeError(
+            "model parallel already initialized; call destroy_model_parallel"
+            " first")
+    cfg = MeshConfig(dp=-1, fsdp=fsdp_size,
+                     pp=pipeline_model_parallel_size,
+                     cp=context_parallel_size,
+                     tp=tensor_model_parallel_size)
+    _MESH = mesh_lib.make_mesh(cfg, devices=devices)
+    if virtual_pipeline_model_parallel_size is not None:
+        if pipeline_model_parallel_size <= 1:
+            raise ValueError("virtual pipeline requires pp > 1")
+    _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size
+    _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size else None
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def destroy_model_parallel() -> None:
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK
+    _MESH = None
+    _VIRTUAL_PP_SIZE = None
+    _VIRTUAL_PP_RANK = None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("call initialize_model_parallel() first")
+    return _MESH
+
+
+def set_mesh(mesh: Mesh) -> None:
+    """Install an externally built mesh (pjit-style workflows)."""
+    global _MESH
+    _MESH = mesh
+
+
+# -- group getters: the mesh axis IS the group ------------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    return AXIS_TP
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return AXIS_PP
+
+
+def get_data_parallel_group() -> tuple[str, str]:
+    """dp + fsdp jointly replicate gradients (fsdp shards them)."""
+    return (AXIS_DP, AXIS_FSDP)
+
+
+def get_context_parallel_group() -> str:
+    return AXIS_CP
+
+
+# -- size getters -----------------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[AXIS_TP]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[AXIS_PP]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[AXIS_DP] * get_mesh().shape[AXIS_FSDP]
+
+
+def get_context_parallel_world_size() -> int:
+    return get_mesh().shape[AXIS_CP]
+
+
+def get_world_size() -> int:
+    return get_mesh().size
+
+
+# -- rank getters (traced; valid under shard_map over the mesh) -------------
+
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(AXIS_TP)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(AXIS_PP)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index((AXIS_DP, AXIS_FSDP))
+
+
+def get_context_parallel_rank():
+    return jax.lax.axis_index(AXIS_CP)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != _VIRTUAL_PP_SIZE - 1:
+            return False
+    return (get_pipeline_model_parallel_rank()
+            == get_pipeline_model_parallel_world_size() - 1)
+
+
+# -- virtual pipeline (interleaved schedule bookkeeping) --------------------
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PP_RANK
+    _VIRTUAL_PP_RANK = rank

@@ -1,0 +1,123 @@
+"""M1 end-to-end slice — BASELINE config 1 ("GPT-2 125M, amp O1 + Adam"),
+scaled down: the cross-product loss-parity methodology of
+``tests/L1/cross_product/run.sh`` + ``compare.py``: train the same tiny
+GPT-2 from identical init under several policies and assert loss curves
+agree; fp16 dynamic scaling must recover from an injected overflow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu import amp as amp_lib
+from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+from apex1_tpu.optim import fused_adam
+
+
+def make_setup(opt_level, **overrides):
+    cfg = GPT2Config.tiny(policy=_policy(opt_level, **overrides))
+    model = GPT2(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 32), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    a = amp_lib.Amp(tx=fused_adam(1e-3), opt_level=opt_level, **overrides)
+    state = a.init(params)
+    step = jax.jit(a.make_train_step(gpt2_loss_fn(model)))
+    return a, state, step, tokens
+
+
+def _policy(opt_level, **overrides):
+    from apex1_tpu.core.policy import get_policy
+    return get_policy(opt_level, **overrides)
+
+
+def run(steps, state, step_fn, tokens):
+    losses = []
+    for _ in range(steps):
+        state, m = step_fn(state, tokens)
+        losses.append(float(m["loss"]))
+    return state, losses, m
+
+
+class TestEndToEnd:
+    def test_o0_trains(self):
+        _, state, step, tokens = make_setup("O0")
+        state, losses, m = run(8, state, step, tokens)
+        assert losses[-1] < losses[0] - 0.3, losses
+        assert bool(m["grads_finite"])
+
+    def test_o1_matches_o0(self):
+        # ≙ L1 cross-product: bf16 O1 loss curve tracks fp32 O0
+        _, s0, f0, tokens = make_setup("O0")
+        _, s1, f1, _ = make_setup("O1")
+        _, l0, _ = run(8, s0, f0, tokens)
+        _, l1, _ = run(8, s1, f1, tokens)
+        np.testing.assert_allclose(l0, l1, rtol=0.05)
+
+    def test_o2_trains(self):
+        _, state, step, tokens = make_setup("O2")
+        state, losses, _ = run(8, state, step, tokens)
+        assert losses[-1] < losses[0] - 0.3
+
+    def test_o1_fp16_dynamic_scaling(self):
+        a, state, step, tokens = make_setup("O1_fp16")
+        assert float(state.loss_scale.scale) == 2.0 ** 16
+        state, losses, m = run(10, state, step, tokens)
+        # may skip during calibration, but must end up training
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        assert float(m["loss_scale"]) <= 2.0 ** 16
+
+    def test_fp16_overflow_skips_and_recovers(self):
+        a, state, step, tokens = make_setup("O1_fp16")
+        # force an overflow by injecting a huge loss-scale
+        import dataclasses
+        from apex1_tpu.core.loss_scale import LossScaleState
+        # near fp32 max so the scaled loss itself overflows to inf
+        state = dataclasses.replace(
+            state, loss_scale=LossScaleState(
+                scale=jnp.float32(2.0 ** 126),
+                growth_count=jnp.int32(0),
+                overflow_count=jnp.int32(0)))
+        params_before = jax.tree_util.tree_leaves(state.params)[0]
+        state, m = step(state, tokens)
+        assert not bool(m["grads_finite"])
+        params_after = jax.tree_util.tree_leaves(state.params)[0]
+        np.testing.assert_array_equal(np.asarray(params_before),
+                                      np.asarray(params_after))
+        # halved then clamped to max_loss_scale (2^24, reference default)
+        assert float(state.loss_scale.scale) == 2.0 ** 24
+        assert int(state.loss_scale.overflow_count) == 1
+
+    def test_master_params_fp32_under_o2(self):
+        a, state, step, tokens = make_setup("O2")
+        for leaf in jax.tree_util.tree_leaves(a.master_params(state)):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert leaf.dtype == jnp.float32
+        for leaf in jax.tree_util.tree_leaves(a.model_params(state)):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert leaf.dtype == jnp.bfloat16
+
+    def test_state_dict_roundtrip(self):
+        a, state, step, tokens = make_setup("O1_fp16")
+        state, _ = step(state, tokens)
+        sd = a.state_dict(state)
+        restored = a.load_state_dict(state, sd)
+        assert float(restored.loss_scale.scale) == float(
+            state.loss_scale.scale)
+
+    def test_max_grad_norm(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0,
+                                    cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        a = amp_lib.Amp(tx=fused_adam(1e-3), opt_level="O0",
+                        max_grad_norm=1e-8)
+        state = a.init(params)
+        step = jax.jit(a.make_train_step(gpt2_loss_fn(model)))
+        before = jax.tree_util.tree_leaves(state.params)[0]
+        state, m = step(state, tokens)
+        after = jax.tree_util.tree_leaves(state.params)[0]
+        # clipped to ~zero grads → params barely move
+        assert float(jnp.max(jnp.abs(after - before))) < 1e-3
