@@ -1,0 +1,33 @@
+"""Input-data broadcast within the TP group — reference
+``apex/transformer/tensor_parallel/data.py :: broadcast_data``.
+
+The reference broadcasts the host batch from TP-rank-0 over NCCL so every
+TP rank traces identical data. Under a JAX single-controller mesh, inputs
+placed with a replicated sharding across the tp axis ARE that broadcast —
+this helper exists for porting parity and for the shard_map path, where it
+re-synchronizes by taking rank-0's copy (an exactness guard against
+divergent per-rank host data, ≙ the reference's keys/dtype checks).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_TP
+
+
+def broadcast_data(keys, data: dict, datatype=None, *, axis_name=AXIS_TP):
+    """Inside shard_map: make ``data[k]`` identical across the tp axis by
+    selecting rank-0's values (psum of the masked copy)."""
+    out = {}
+    for k in keys:
+        x = data[k]
+        if datatype is not None:
+            x = x.astype(datatype)
+        is0 = (jax.lax.axis_index(axis_name) == 0)
+        cast = jnp.asarray(x)
+        # float path sums zeros elsewhere; works for ints too
+        out[k] = jax.lax.psum(jnp.where(is0, cast, jnp.zeros_like(cast)),
+                              axis_name)
+    return out

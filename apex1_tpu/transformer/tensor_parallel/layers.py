@@ -1,0 +1,219 @@
+"""Tensor-parallel layers — reference
+``apex/transformer/tensor_parallel/layers.py :: ColumnParallelLinear,
+RowParallelLinear, VocabParallelEmbedding``.
+
+Two usage modes, matching SURVEY §7's design stance:
+
+1. **GSPMD (default, TPU-idiomatic)** — flax modules create FULL-size params
+   carrying ``nn.with_partitioning`` metadata (column weight sharded on the
+   tp axis along out-features, row weight along in-features, embedding along
+   vocab). Under ``pjit`` over a mesh, XLA inserts exactly the collectives
+   the reference codes by hand (identity/all-reduce duals). Sequence
+   parallelism = activation sharding constraints along the seq dim
+   (``sequence_parallel_enabled``), reproducing the all-gather /
+   reduce-scatter placement of Megatron SP.
+
+2. **Explicit shard_map** — the functional forms (`column_parallel_linear`,
+   `row_parallel_linear`, `vocab_parallel_embedding`) take LOCAL shards and
+   use the `mappings` collectives, for schedule-controlled blocks and for
+   the parity tests (≙ ``test_layers.py``).
+
+``gradient_accumulation_fusion`` (reference ☢#27 ``wgrad_gemm_accum_fp32``)
+needs no code: XLA accumulates wgrads in fp32 when params are fp32 masters
+(the matmul's preferred_element_type) and fuses the accumulation — decision
+documented here per the component inventory.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_TP
+from apex1_tpu.transformer.tensor_parallel import mappings as mp
+
+
+def _maybe_constrain(x, spec):
+    """with_sharding_constraint if a mesh context is active."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.PartitionSpec(*spec))
+    except (ValueError, RuntimeError):
+        return x  # no mesh context (single-device tests)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD flax modules
+# ---------------------------------------------------------------------------
+
+class ColumnParallelLinear(nn.Module):
+    """Y = XW + b with W column-sharded: (in, out/tp) per rank.
+
+    ``gather_output=True`` replicates Y (reference default True; Megatron
+    uses False to feed RowParallelLinear directly).
+    """
+
+    features: int
+    use_bias: bool = True
+    gather_output: bool = False
+    sequence_parallel_enabled: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    tp_axis: str = AXIS_TP
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, self.tp_axis)),
+            (in_features, self.features), self.param_dtype)
+        if self.sequence_parallel_enabled:
+            # activations arrive seq-sharded; all-gather happens via the
+            # sharding constraint change (XLA inserts it)
+            x = _maybe_constrain(x, (None,) * (x.ndim - 1) + (None,))
+        y = jnp.dot(x, kernel.astype(self.dtype),
+                    preferred_element_type=jnp.float32).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.with_partitioning(nn.initializers.zeros,
+                                             (self.tp_axis,)),
+                (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        if self.gather_output:
+            y = _maybe_constrain(y, (None,) * y.ndim)
+        else:
+            y = _maybe_constrain(y, (None,) * (y.ndim - 1) + (self.tp_axis,))
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    """Y = XW + b with W row-sharded: (in/tp, out) per rank; the partial
+    products all-reduce (or reduce-scatter along seq under SP). Bias is
+    added once, after the reduction (reference semantics)."""
+
+    features: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel_enabled: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    tp_axis: str = AXIS_TP
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (self.tp_axis, None)),
+            (in_features, self.features), self.param_dtype)
+        y = jnp.dot(x, kernel.astype(self.dtype),
+                    preferred_element_type=jnp.float32).astype(self.dtype)
+        if self.sequence_parallel_enabled:
+            # output sharded along seq: XLA lowers to reduce-scatter
+            y = _maybe_constrain(
+                y, (None,) * (y.ndim - 2) + (self.tp_axis, None))
+        else:
+            y = _maybe_constrain(y, (None,) * y.ndim)
+        if self.use_bias:
+            bias = self.param("bias",
+                              nn.with_partitioning(nn.initializers.zeros,
+                                                   (None,)),
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding table sharded along vocab; lookup of out-of-shard tokens
+    contributes zero and the partial results all-reduce (GSPMD: gather on a
+    vocab-sharded table lowers to the same masked-lookup + psum)."""
+
+    num_embeddings: int
+    features: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    embedding_init: Callable = nn.initializers.normal(0.02)
+    tp_axis: str = AXIS_TP
+
+    @nn.compact
+    def __call__(self, tokens):
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(self.embedding_init, (self.tp_axis, None)),
+            (self.num_embeddings, self.features), self.param_dtype)
+        y = jnp.take(table, tokens, axis=0).astype(self.dtype)
+        return _maybe_constrain(y, (None,) * (tokens.ndim + 1))
+
+
+# ---------------------------------------------------------------------------
+# explicit shard_map functional forms
+# ---------------------------------------------------------------------------
+
+def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
+                           gather_output=False,
+                           sequence_parallel_enabled=False,
+                           axis_name=AXIS_TP):
+    """x: replicated (or seq-sharded under SP); kernel_shard: (in, out/tp).
+
+    Reference fwd: ``copy_to_tensor_model_parallel_region`` (identity fwd /
+    psum bwd) then local matmul; under SP, all-gather along seq instead.
+    """
+    if sequence_parallel_enabled:
+        x = mp.gather_from_sequence_parallel_region(
+            x, axis_name, 0, True)
+    else:
+        x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
+    y = jnp.dot(x, kernel_shard, preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if bias_shard is not None:
+        y = y + bias_shard
+    if gather_output:
+        y = mp.gather_from_tensor_model_parallel_region(y, axis_name)
+    return y
+
+
+def row_parallel_linear(x_parallel, kernel_shard, bias=None, *,
+                        input_is_parallel=True,
+                        sequence_parallel_enabled=False,
+                        axis_name=AXIS_TP):
+    """x_parallel: (..., in/tp); kernel_shard: (in/tp, out)."""
+    if not input_is_parallel:
+        x_parallel = mp.scatter_to_tensor_model_parallel_region(
+            x_parallel, axis_name)
+    y = jnp.dot(x_parallel, kernel_shard,
+                preferred_element_type=jnp.float32)
+    y = y.astype(x_parallel.dtype)
+    if sequence_parallel_enabled:
+        y = mp.reduce_scatter_to_sequence_parallel_region(y, axis_name, 0)
+    else:
+        y = mp.reduce_from_tensor_model_parallel_region(y, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def vocab_parallel_embedding(tokens, table_shard, *, axis_name=AXIS_TP):
+    """table_shard: (vocab/tp, features) holding rows
+    [rank·V/tp, (rank+1)·V/tp). Out-of-shard tokens are masked to row 0 and
+    zeroed, partials psum — the reference's masked-lookup trick."""
+    per = table_shard.shape[0]
+    start = jax.lax.axis_index(axis_name) * per
+    local = tokens - start
+    in_shard = (local >= 0) & (local < per)
+    local = jnp.clip(local, 0, per - 1)
+    y = jnp.take(table_shard, local, axis=0)
+    y = jnp.where(in_shard[..., None], y, 0.0)
+    return jax.lax.psum(y, axis_name)
+
+
+def set_tensor_model_parallel_attributes(spec_tree):
+    """Reference tags params with ``tensor_model_parallel`` attributes; the
+    JAX equivalent information is the PartitionSpec pytree — returned
+    untouched (exists for porting-surface parity)."""
+    return spec_tree

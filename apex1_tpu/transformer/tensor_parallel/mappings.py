@@ -1,0 +1,188 @@
+"""TP autograd collectives — reference
+``apex/transformer/tensor_parallel/mappings.py``.
+
+Each reference function is an ``autograd.Function`` pairing a forward
+collective with its dual in backward:
+
+    copy_to_tensor_model_parallel_region      fwd identity        bwd psum
+    reduce_from_tensor_model_parallel_region  fwd psum            bwd identity
+    scatter_to_tensor_model_parallel_region   fwd split(last)     bwd all-gather
+    gather_from_tensor_model_parallel_region  fwd all-gather      bwd split
+    scatter_to_sequence_parallel_region       fwd split(seq)      bwd all-gather
+    gather_from_sequence_parallel_region      fwd all-gather(seq) bwd reduce-scatter
+    reduce_scatter_to_sequence_parallel_region fwd reduce-scatter bwd all-gather
+
+Implemented as ``jax.custom_vjp`` over XLA collectives, usable inside
+``shard_map`` over the tp axis (axis_name parameter; default the canonical
+"tp"). Under pure pjit/GSPMD these functions are unnecessary — sharding
+annotations make XLA insert the same collectives — but the explicit forms
+are required for schedule-controlled blocks and for parity tests
+(≙ ``tests/L0/run_transformer/test_mapping.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_TP
+
+
+def _axis_size(axis_name):
+    return jax.lax.axis_size(axis_name)
+
+
+def _axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def _split_dim(x, axis_name, dim):
+    """Local chunk of ``x`` along ``dim`` for this rank."""
+    n = _axis_size(axis_name)
+    if x.shape[dim] % n:
+        raise ValueError(f"dim {dim} size {x.shape[dim]} not divisible by "
+                         f"tp size {n}")
+    chunk = x.shape[dim] // n
+    idx = _axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)
+
+
+def _all_gather_dim(x, axis_name, dim):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter_dim(x, axis_name, dim):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True)
+
+
+# -- tensor-parallel region --------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=AXIS_TP):
+    """``_CopyToModelParallelRegion``: identity fwd, all-reduce bwd."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=AXIS_TP):
+    """``_ReduceFromModelParallelRegion``: all-reduce fwd, identity bwd."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=AXIS_TP):
+    """``_ScatterToModelParallelRegion``: split last dim fwd, gather bwd."""
+    return _split_dim(x, axis_name, -1)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_dim(x, axis_name, -1), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, axis_name, -1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=AXIS_TP):
+    """``_GatherFromModelParallelRegion``: gather last dim fwd, split bwd."""
+    return _all_gather_dim(x, axis_name, -1)
+
+
+def _gather_fwd(x, axis_name):
+    return _all_gather_dim(x, axis_name, -1), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_dim(g, axis_name, -1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- sequence-parallel region (Megatron SP; seq = leading dim) ---------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(x, axis_name=AXIS_TP, seq_dim=0):
+    """``_ScatterToSequenceParallelRegion``: split seq fwd, gather bwd."""
+    return _split_dim(x, axis_name, seq_dim)
+
+
+def _sp_scatter_fwd(x, axis_name, seq_dim):
+    return _split_dim(x, axis_name, seq_dim), None
+
+
+def _sp_scatter_bwd(axis_name, seq_dim, _, g):
+    return (_all_gather_dim(g, axis_name, seq_dim),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def gather_from_sequence_parallel_region(x, axis_name=AXIS_TP, seq_dim=0,
+                                         tensor_parallel_output_grad=True):
+    """``_GatherFromSequenceParallelRegion``: all-gather seq fwd; bwd is
+    reduce-scatter when the consumer is a TP op (each rank contributes a
+    full-size grad), else a plain split."""
+    return _all_gather_dim(x, axis_name, seq_dim)
+
+
+def _sp_gather_fwd(x, axis_name, seq_dim, tensor_parallel_output_grad):
+    return _all_gather_dim(x, axis_name, seq_dim), None
+
+
+def _sp_gather_bwd(axis_name, seq_dim, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_dim(g, axis_name, seq_dim),)
+    return (_split_dim(g, axis_name, seq_dim),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=AXIS_TP,
+                                               seq_dim=0):
+    """``_ReduceScatterToSequenceParallelRegion``: reduce-scatter fwd,
+    all-gather bwd."""
+    return _reduce_scatter_dim(x, axis_name, seq_dim)
+
+
+def _sp_rs_fwd(x, axis_name, seq_dim):
+    return _reduce_scatter_dim(x, axis_name, seq_dim), None
+
+
+def _sp_rs_bwd(axis_name, seq_dim, _, g):
+    return (_all_gather_dim(g, axis_name, seq_dim),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -1,0 +1,90 @@
+"""MP RNG + activation checkpointing — reference
+``apex/transformer/tensor_parallel/random.py :: CudaRNGStatesTracker,
+model_parallel_cuda_manual_seed, checkpoint``.
+
+JAX's counter-based threefry removes the stateful machinery (SURVEY §5.4):
+- per-TP-rank dropout divergence = ``fold_in`` of the axis index;
+- checkpoint recompute replays keys exactly (no state snapshot needed);
+- ``--distribute-saved-activations`` ≙ remat + sharding constraints.
+
+The tracker API shape is preserved so ported code reads the same.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+from apex1_tpu.core.mesh import AXIS_TP
+from apex1_tpu.core.random import domain_key
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """≙ ``CudaRNGStatesTracker``: named RNG domains. ``add(name, seed)``
+    registers a domain; ``fork(name)`` yields the domain key (per-TP-rank
+    when used inside shard_map)."""
+
+    def __init__(self):
+        self._seeds: dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._seeds.clear()
+
+    def get_states(self):
+        return dict(self._seeds)
+
+    def set_states(self, states):
+        self._seeds = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._seeds:
+            raise RuntimeError(f"rng domain {name} already present")
+        self._seeds[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG, *,
+             tp_axis: str | None = AXIS_TP) -> jax.Array:
+        key = self._seeds[name]
+        if tp_axis is not None:
+            try:
+                key = jax.random.fold_in(key, jax.lax.axis_index(tp_axis))
+            except NameError:
+                pass  # not inside shard_map; single-rank semantics
+        return key
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """≙ ``get_cuda_rng_tracker``."""
+    return _TRACKER
+
+
+def model_parallel_seed(seed: int) -> None:
+    """≙ ``model_parallel_cuda_manual_seed(seed)``: default stream seeded
+    ``seed`` (same across TP), model-parallel domain ``seed + 2718`` with
+    the per-rank fold applied at ``fork`` time."""
+    _TRACKER.reset()
+    _TRACKER.add("default", seed)
+    _TRACKER.add(_MODEL_PARALLEL_RNG, seed + 2718)
+
+
+# checkpoint: the reference's ``checkpoint(fn, *args)`` recomputes fn in
+# backward with exact RNG replay. jax.checkpoint IS that; policies expose
+# the reference's distribute/checkpoint knobs.
+checkpoint = jax.checkpoint
+
+
+def checkpoint_policy(name: str = "nothing_saveable"):
+    """Remat policies: "nothing_saveable" (recompute all, the reference's
+    full activation checkpointing), "dots_saveable" (keep matmul outputs),
+    "dots_with_no_batch_dims_saveable" (keep weight-stationary dots)."""
+    return getattr(jax.checkpoint_policies, name)
+
+
+def checkpoint_with_policy(fn: Callable, policy_name: str):
+    return jax.checkpoint(fn, policy=checkpoint_policy(policy_name))

@@ -1,0 +1,41 @@
+"""Shape utilities — reference ``apex/transformer/utils.py :: divide,
+split_tensor_along_last_dim`` and ``tensor_parallel/utils.py ::
+VocabUtility``."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x, num_partitions: int):
+    """Static split into equal chunks (reference returns contiguous views)."""
+    ensure_divisibility(x.shape[-1], num_partitions)
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Vocab-range arithmetic for vocab-sharded tables."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_size, rank,
+                                                  world_size=None):
+        del world_size
+        start = rank * per_partition_size
+        return start, start + per_partition_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank,
+                                           world_size):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank)

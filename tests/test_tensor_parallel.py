@@ -1,0 +1,301 @@
+"""Tensor-parallel tests — ≙ ``tests/L0/run_transformer/{test_mapping,
+test_layers,test_cross_entropy,test_parallel_state}.py``: collectives'
+fwd/bwd duals, sharded layers vs dense gold, vocab-parallel CE vs full CE,
+all on a tp=4 shard_map over the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.mesh import make_mesh
+from apex1_tpu.transformer import parallel_state
+from apex1_tpu.transformer import tensor_parallel as tp
+
+
+@pytest.fixture()
+def mesh(devices):
+    return make_mesh(dp=2, tp=4)
+
+
+def tp_shard_map(mesh, fn, in_specs, out_specs):
+    # check_vma=False: replication of custom_vjp collective outputs can't be
+    # statically inferred (same flag Megatron-JAX ports use)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+class TestParallelState:
+    def test_initialize_and_getters(self, devices):
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+        assert parallel_state.get_tensor_model_parallel_world_size() == 2
+        assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+        assert parallel_state.get_data_parallel_world_size() == 2
+        assert parallel_state.get_world_size() == 8
+        assert parallel_state.model_parallel_is_initialized()
+        with pytest.raises(RuntimeError):
+            parallel_state.initialize_model_parallel(1, 1)
+        parallel_state.destroy_model_parallel()
+        assert not parallel_state.model_parallel_is_initialized()
+
+    def test_virtual_pp(self, devices):
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            1, 2, virtual_pipeline_model_parallel_size=2)
+        assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+        parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+        assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+        parallel_state.destroy_model_parallel()
+
+
+class TestMappings:
+    """fwd/bwd duals of every region mapping."""
+
+    def test_copy_and_reduce(self, mesh, rng):
+        x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+
+        def f(x):
+            # copy: identity fwd; grad of sum → psum of ones = tp_size
+            y = tp.copy_to_tensor_model_parallel_region(x)
+            return y
+
+        y = tp_shard_map(mesh, f, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+        def g(x):
+            return jax.grad(
+                lambda x: jnp.sum(
+                    tp.copy_to_tensor_model_parallel_region(x)))(x)
+
+        gx = tp_shard_map(mesh, g, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(gx), 4.0)  # psum over tp=4
+
+        def h(x):
+            # reduce: psum fwd
+            return tp.reduce_from_tensor_model_parallel_region(x)
+
+        y = tp_shard_map(mesh, h, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), 4.0 * np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_scatter_gather_roundtrip(self, mesh, rng):
+        x = jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)
+
+        def f(x):
+            s = tp.scatter_to_tensor_model_parallel_region(x)
+            assert s.shape == (8, 8)
+            return tp.gather_from_tensor_model_parallel_region(s)
+
+        y = tp_shard_map(mesh, f, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+    def test_sequence_parallel_trio(self, mesh, rng):
+        x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+
+        def f(x):
+            s = tp.scatter_to_sequence_parallel_region(x)  # (4, 8) local
+            assert s.shape == (4, 8)
+            g = tp.gather_from_sequence_parallel_region(s)
+            return g
+
+        y = tp_shard_map(mesh, f, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+        def h(x):
+            # reduce_scatter fwd: each rank ends with the psum of its slice
+            r = tp.reduce_scatter_to_sequence_parallel_region(x)
+            return tp.gather_from_sequence_parallel_region(r)
+
+        y = tp_shard_map(mesh, h, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), 4.0 * np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_gather_bwd_is_reduce_scatter(self, mesh, rng):
+        # grad of sum(gather(x_shard)) wrt x_shard = ones (each rank's slice
+        # receives the full-grad slice reduce-scattered: tp copies of 1 → 4)
+        x = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+
+        def g(x):
+            local = tp.scatter_to_sequence_parallel_region(x)
+            return jax.grad(lambda l: jnp.sum(
+                tp.gather_from_sequence_parallel_region(l)) / 4.0)(local)
+
+        gx = tp_shard_map(mesh, g, P(), P("tp"))(x)
+        np.testing.assert_allclose(np.asarray(gx), 1.0, rtol=1e-6)
+
+
+class TestLayersShardMap:
+    def test_column_then_row_equals_dense(self, mesh, rng):
+        """ParallelMLP pattern: Column → gelu → Row == dense gold."""
+        B, D, H = 8, 32, 64
+        x = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(D, H)) * 0.1, jnp.float32)
+        b1 = jnp.asarray(rng.normal(size=(H,)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(H, D)) * 0.1, jnp.float32)
+        b2 = jnp.asarray(rng.normal(size=(D,)) * 0.1, jnp.float32)
+
+        def parallel_mlp(x, w1, b1, w2, b2):
+            h = tp.column_parallel_linear(x, w1, b1)   # (B, H/4) local
+            h = jax.nn.gelu(h)
+            return tp.row_parallel_linear(h, w2, bias=b2)
+
+        y = tp_shard_map(
+            mesh, parallel_mlp,
+            (P(), P(None, "tp"), P("tp"), P("tp", None), P()),
+            P())(x, w1, b1, w2, b2)
+        gold = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(gold),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grads_match_dense(self, mesh, rng):
+        B, D, H = 4, 16, 32
+        x = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(D, H)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(H, D)) * 0.1, jnp.float32)
+
+        def loss_parallel(x, w1, w2):
+            h = tp.column_parallel_linear(x, w1)
+            return jnp.sum(tp.row_parallel_linear(h, w2) ** 2)
+
+        def grads(x, w1, w2):
+            return jax.grad(loss_parallel, argnums=(1, 2))(x, w1, w2)
+
+        gw1, gw2 = tp_shard_map(
+            mesh, grads, (P(), P(None, "tp"), P("tp", None)),
+            (P(None, "tp"), P("tp", None)))(x, w1, w2)
+        gold_g = jax.grad(
+            lambda w1, w2: jnp.sum((x @ w1 @ w2) ** 2), argnums=(0, 1))(
+                w1, w2)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gold_g[0]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw2), np.asarray(gold_g[1]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_vocab_parallel_embedding(self, mesh, rng):
+        V, D = 64, 16
+        table = jnp.asarray(rng.normal(size=(V, D)), jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, V, size=(4, 8)), jnp.int32)
+
+        def f(tokens, table):
+            return tp.vocab_parallel_embedding(tokens, table)
+
+        y = tp_shard_map(mesh, f, (P(), P("tp", None)), P())(tokens, table)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(table[tokens]), rtol=1e-6)
+
+    def test_sequence_parallel_column_row(self, mesh, rng):
+        S, D, H = 16, 16, 32
+        x = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(D, H)) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(H, D)) * 0.1, jnp.float32)
+
+        def f(x_sp, w1, w2):
+            # x_sp: (S/4, D) sequence-sharded, as after LN/dropout under SP
+            h = tp.column_parallel_linear(x_sp, w1,
+                                          sequence_parallel_enabled=True)
+            return tp.row_parallel_linear(h, w2,
+                                          sequence_parallel_enabled=True)
+
+        y = tp_shard_map(mesh, f, (P("tp"), P(None, "tp"), P("tp", None)),
+                         P("tp"))(x, w1, w2)
+        gold = x @ w1 @ w2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(gold),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestLayersGSPMD:
+    def test_pjit_column_row_mlp(self, mesh, rng):
+        """GSPMD mode: full-size params with partitioning metadata under
+        jit-with-mesh == dense gold."""
+        import flax.linen as nn
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = tp.ColumnParallelLinear(64, name="fc1")(x)
+                h = nn.gelu(h)
+                return tp.RowParallelLinear(16, name="fc2")(h)
+
+        m = MLP()
+        x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        specs = nn.get_partition_spec(params)
+        assert specs["params"]["fc1"]["kernel"] == P(None, "tp")
+        assert specs["params"]["fc2"]["kernel"] == P("tp", None)
+        params_plain = jax.tree.map(
+            lambda x: x.unbox() if hasattr(x, "unbox") else x, params,
+            is_leaf=lambda x: hasattr(x, "unbox"))
+        gold = m.apply(params_plain, x)
+        with jax.set_mesh(mesh):
+            y = jax.jit(m.apply)(params_plain, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(gold),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestVocabParallelCE:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_vs_full_ce(self, mesh, rng, smoothing):
+        N, V = 16, 64
+        logits = jnp.asarray(rng.normal(size=(N, V)) * 3, jnp.float32)
+        targets = jnp.asarray(rng.integers(0, V, size=(N,)), jnp.int32)
+
+        def f(lg, t):
+            return tp.vocab_parallel_cross_entropy(lg, t, smoothing)
+
+        loss = tp_shard_map(mesh, f, (P(None, "tp"), P()), P())(
+            logits, targets)
+        from apex1_tpu.ops import softmax_cross_entropy_loss
+        gold = softmax_cross_entropy_loss(logits, targets,
+                                          smoothing=smoothing)
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(gold),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_vs_full_ce(self, mesh, rng):
+        N, V = 8, 32
+        logits = jnp.asarray(rng.normal(size=(N, V)), jnp.float32)
+        targets = jnp.asarray(rng.integers(0, V, size=(N,)), jnp.int32)
+
+        def g(lg, t):
+            return jax.grad(lambda lg: jnp.sum(
+                tp.vocab_parallel_cross_entropy(lg, t, 0.0)))(lg)
+
+        grad = tp_shard_map(mesh, g, (P(None, "tp"), P()),
+                            P(None, "tp"))(logits, targets)
+        gold = jax.grad(lambda lg: jnp.sum(
+            -jax.nn.log_softmax(lg)[jnp.arange(N), targets]))(logits)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(gold),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestUtils:
+    def test_divide(self):
+        assert tp.divide(12, 4) == 3
+        with pytest.raises(ValueError):
+            tp.divide(10, 3)
+
+    def test_vocab_utility(self):
+        assert tp.VocabUtility.vocab_range_from_global_vocab_size(
+            64, rank=2, world_size=4) == (32, 48)
+
+    def test_broadcast_data(self, mesh, rng):
+        x = jnp.asarray(rng.normal(size=(4, 4)), jnp.float32)
+
+        def f(x):
+            out = tp.broadcast_data(["x"], {"x": x})
+            return out["x"]
+
+        y = tp_shard_map(mesh, f, P(), P())(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+    def test_rng_tracker(self):
+        from apex1_tpu.transformer.tensor_parallel import random as tpr
+        tpr.model_parallel_seed(1234)
+        tr = tpr.get_rng_tracker()
+        k_default = tr.fork("default", tp_axis=None)
+        k_mp = tr.fork(tp_axis=None)
+        assert not np.array_equal(np.asarray(k_default), np.asarray(k_mp))
+        with pytest.raises(RuntimeError):
+            tr.add("default", 1)
