@@ -1,0 +1,9 @@
+"""Model-parallel framework — reference ``apex/transformer`` (vendored
+Megatron core): parallel topology state, tensor parallelism, pipeline
+schedules, microbatch calculators."""
+
+from apex1_tpu.transformer import parallel_state  # noqa: F401
+from apex1_tpu.transformer import tensor_parallel  # noqa: F401
+from apex1_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex1_tpu.transformer.microbatches import (  # noqa: F401
+    build_num_microbatches_calculator)

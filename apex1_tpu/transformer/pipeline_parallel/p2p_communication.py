@@ -1,0 +1,43 @@
+"""P2P boundary communication — reference
+``apex/transformer/pipeline_parallel/p2p_communication.py :: send_forward,
+recv_forward, send_backward, recv_backward, _communicate``.
+
+The reference batches NCCL isend/irecv pairs between adjacent PP stages with
+shape prenegotiation. On TPU the equivalent primitive is a ring
+``collective_permute`` over the pp mesh axis — these helpers exist for
+porting parity and for tests; the scan-based schedules call ppermute
+directly. Shape negotiation (``tensor_shape`` args) is unnecessary: shapes
+are static under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex1_tpu.core.mesh import AXIS_PP
+
+
+def _ring_perm(P, reverse=False):
+    if reverse:
+        return [(i, (i - 1) % P) for i in range(P)]
+    return [(i, (i + 1) % P) for i in range(P)]
+
+
+def send_forward_recv_forward(x, *, axis_name: str = AXIS_PP):
+    """Send activation to the next stage; receive from the previous
+    (one fused ring step — ≙ fused ``send_forward`` + ``recv_forward``)."""
+    P = jax.lax.axis_size(axis_name)
+    return jax.lax.ppermute(x, axis_name, perm=_ring_perm(P))
+
+
+def send_backward_recv_backward(g, *, axis_name: str = AXIS_PP):
+    """Send gradient to the previous stage; receive from the next."""
+    P = jax.lax.axis_size(axis_name)
+    return jax.lax.ppermute(g, axis_name, perm=_ring_perm(P, reverse=True))
+
+
+# single-direction names for API parity; on a ring each is the same permute
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward

@@ -1,0 +1,243 @@
+"""Pipeline-parallel schedules — reference
+``apex/transformer/pipeline_parallel/schedules/*``:
+``fwd_bwd_no_pipelining``, ``forward_backward_pipelining_without_interleaving``
+(1F1B), ``fwd_bwd_pipelining_with_interleaving`` (virtual pipeline), selected
+by ``get_forward_backward_func()``.
+
+The reference schedules are host-side Python loops issuing NCCL p2p
+send/recv per microbatch (§3.4 call stack: warmup `p - rank - 1` fwds,
+steady 1F1B, cooldown). Under XLA the schedule must be a compiled program:
+here the pipeline is ONE ``lax.scan`` over ticks inside ``shard_map`` over
+the ``pp`` axis, with a ring ``ppermute`` moving boundary activations each
+tick. ``jax.grad`` through the scan gives the backward pass — the transpose
+of ``ppermute`` is the reverse-direction ``ppermute``, so the backward
+program is the mirrored pipeline the reference hand-codes.
+
+Schedule math:
+- V = 1 (non-interleaved): microbatch m occupies stage s at tick t = m + s;
+  total ticks M + P − 1 — the same fill/steady/drain structure as 1F1B
+  (identical bubble: P−1; 1F1B vs GPipe differ only in *activation memory*,
+  which `jax.checkpoint` on the stage function controls here).
+- V > 1 (interleaved/circular ≙ virtual pipeline): each stage owns V model
+  chunks (chunk c = v·P + s lives on stage s). Microbatch m enters chunk v
+  at tick t = v·M + m + s; the ring permute routes stage P−1 → stage 0 for
+  free (chunk boundary), with a stage-0 FIFO holding recirculated
+  activations for M−P+1 ticks. Requires M ≥ P (the reference's interleaved
+  schedule asserts microbatches % pp == 0 similarly). Total ticks
+  V·M + P − 1 — bubble still P−1, matching interleaved 1F1B's bubble
+  shrink vs running V·M microbatches through a V·P-deep pipe.
+
+Bubble ticks still execute ``stage_fn`` on zeros (SPMD); their outputs are
+masked and receive zero cotangents, so they cost FLOPs (fraction
+(P−1)/(VM+P−1)) but not correctness.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_PP
+from apex1_tpu.transformer import parallel_state
+
+
+def _tree_select_chunk(stacked, v):
+    """Select chunk v from leaves shaped (V, ...)."""
+    return jax.tree_util.tree_map(
+        lambda p: jax.lax.dynamic_index_in_dim(p, v, axis=0,
+                                               keepdims=False), stacked)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    chunk_params,
+    microbatches,
+    *,
+    num_chunks: int = 1,
+    axis_name: str = AXIS_PP,
+):
+    """Run the pipelined forward. MUST be called inside ``shard_map`` over
+    ``axis_name``.
+
+    - ``stage_fn(params_chunk, x) -> y``: one pipeline-chunk forward; input
+      and output must have identical shape/dtype (boundary activation).
+    - ``chunk_params``: pytree with leading axis V (chunks per stage) on
+      every leaf — the local stage's chunk parameters. For V=1 pass leaves
+      shaped (1, ...).
+    - ``microbatches``: (M, ...) tensor of microbatch inputs, replicated
+      across the pp axis (only stage 0 consumes; ≙ the reference reading
+      the batch on the first stage).
+
+    Returns (M, ...) outputs of the LAST chunk on every rank (masked psum
+    broadcast — its transpose routes cotangents back to the last stage).
+    """
+    P = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    V = num_chunks
+    if V > 1 and M < P:
+        raise ValueError(
+            f"interleaved pipeline requires num_microbatches ({M}) >= "
+            f"pipeline size ({P})")
+    T = V * M + P - 1
+
+    x_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+    zeros_x = jnp.zeros(x_shape, dtype)
+
+    def tick(carry, t):
+        x_recv, fifo, outs = carry
+        # stage-0 FIFO: record the activation that arrived this tick
+        # (sent by stage P-1 at tick t-1, i.e. chunk-output of slot t-P)
+        m_arr = jnp.mod(t - P, M)
+        arrival_ok = (s == 0) & (t >= P) & (V > 1)
+        fifo = jnp.where(arrival_ok,
+                         jax.lax.dynamic_update_index_in_dim(
+                             fifo, x_recv, m_arr, axis=0),
+                         fifo)
+
+        u = t - s                       # local slot
+        v = jnp.clip(u // M, 0, V - 1)  # chunk index
+        m = jnp.mod(u, M)               # microbatch index
+        valid = (u >= 0) & (u < V * M)
+
+        # stage-0 input: fresh microbatch for chunk 0, recirculated otherwise
+        fresh = jax.lax.dynamic_index_in_dim(microbatches, m, axis=0,
+                                             keepdims=False)
+        recirc = jax.lax.dynamic_index_in_dim(fifo, m, axis=0,
+                                              keepdims=False)
+        x0 = jnp.where(v == 0, fresh, recirc)
+        x = jnp.where(s == 0, x0, x_recv)
+
+        params_v = _tree_select_chunk(chunk_params, v)
+        y = stage_fn(params_v, x)
+
+        out_ok = valid & (s == P - 1) & (v == V - 1)
+        outs = jnp.where(out_ok,
+                         jax.lax.dynamic_update_index_in_dim(
+                             outs, y, m, axis=0),
+                         outs)
+
+        y_send = jax.lax.ppermute(
+            y, axis_name, perm=[(i, (i + 1) % P) for i in range(P)])
+        return (y_send, fifo, outs), None
+
+    init = (zeros_x,
+            jnp.zeros((M,) + x_shape, dtype),
+            jnp.zeros((M,) + x_shape, dtype))
+    (x_recv, fifo, outs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+
+    # replicate last-stage outputs (transpose: cotangent flows to stage P-1)
+    is_last = (s == P - 1).astype(outs.dtype)
+    return jax.lax.psum(outs * is_last, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# grad-accumulating no-pipelining schedule
+# ---------------------------------------------------------------------------
+
+def forward_backward_no_pipelining(loss_fn, params, microbatches):
+    """≙ ``fwd_bwd_no_pipelining``: sequential microbatches, one grad
+    accumulation (grad sync happens once, outside — exactly the reference's
+    "grad-sync only on the last microbatch" semantics under jit).
+
+    ``loss_fn(params, microbatch) -> scalar``. Returns (mean_loss, grads).
+    """
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def body(carry, mb):
+        loss_acc, grad_acc = carry
+        loss, grads = grad_fn(params, mb)
+        return (loss_acc + loss,
+                jax.tree_util.tree_map(jnp.add, grad_acc, grads)), None
+
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    init = (jnp.zeros([], jnp.float32),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params))
+    (loss_sum, grad_sum), _ = jax.lax.scan(body, init, microbatches)
+    scale = 1.0 / M
+    return loss_sum * scale, jax.tree_util.tree_map(
+        lambda g: g * scale, grad_sum)
+
+
+# ---------------------------------------------------------------------------
+# mesh-level wrapper: full train-style fwd+bwd through the pipeline
+# ---------------------------------------------------------------------------
+
+def pipelined_loss_fn(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh,
+    *,
+    num_chunks: int = 1,
+    axis_name: str = AXIS_PP,
+    params_spec=None,
+    check_vma: bool = False,
+):
+    """Build ``f(chunk_params_stacked, microbatches, targets) -> loss`` that
+    runs the pipeline under ``shard_map`` over ``mesh``; differentiate with
+    ``jax.grad`` for the full 1F1B-equivalent fwd+bwd.
+
+    ``chunk_params_stacked`` leaves are (V, P, ...) — chunk-major, stage
+    second — sharded on axis 1 over pp. ``loss_fn(outputs, targets) ->
+    scalar`` runs replicated (outputs are broadcast from the last stage).
+    """
+    from jax.sharding import PartitionSpec as Ps
+
+    if params_spec is None:
+        params_spec = Ps(None, axis_name)
+
+    def inner(chunk_params, microbatches, targets):
+        # drop the stage axis (size 1 locally)
+        local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_params)
+        outs = pipeline_apply(stage_fn, local, microbatches,
+                              num_chunks=num_chunks, axis_name=axis_name)
+        return loss_fn(outs, targets)
+
+    smapped = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(params_spec, Ps(), Ps()),
+        out_specs=Ps(),
+        check_vma=check_vma)
+
+    def f(chunk_params, microbatches, targets):
+        # loss is replicated; take it as-is
+        return smapped(chunk_params, microbatches, targets)
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Megatron-parity surface
+# ---------------------------------------------------------------------------
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn, loss_fn, mesh, chunk_params, microbatches, targets,
+        **kw):
+    """1F1B-equivalent schedule (V=1). Returns (loss, grads)."""
+    f = pipelined_loss_fn(stage_fn, loss_fn, mesh, num_chunks=1, **kw)
+    return jax.value_and_grad(f)(chunk_params, microbatches, targets)
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn, loss_fn, mesh, chunk_params, microbatches, targets,
+        num_chunks: int = 2, **kw):
+    """Interleaved/virtual-pipeline schedule (V=num_chunks)."""
+    f = pipelined_loss_fn(stage_fn, loss_fn, mesh, num_chunks=num_chunks,
+                          **kw)
+    return jax.value_and_grad(f)(chunk_params, microbatches, targets)
+
+
+def get_forward_backward_func():
+    """≙ ``schedules/__init__.py :: get_forward_backward_func`` — selects by
+    the installed parallel state."""
+    if (parallel_state.model_parallel_is_initialized()
+            and parallel_state.get_pipeline_model_parallel_world_size() > 1):
+        if parallel_state.get_virtual_pipeline_model_parallel_world_size():
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

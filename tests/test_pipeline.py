@@ -1,0 +1,173 @@
+"""Pipeline-parallel tests — ≙ ``tests/L0/run_transformer/
+test_pipeline_parallel_fwd_bwd.py``: pipeline loss/grads must match the same
+model run unpartitioned, for the 1F1B-equivalent (V=1) and interleaved
+(V>1) schedules, plus the no-pipelining grad-accumulation schedule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.core.mesh import make_mesh
+from apex1_tpu.transformer import parallel_state
+from apex1_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator)
+from apex1_tpu.transformer.pipeline_parallel import schedules
+
+
+D = 16  # feature width
+
+
+def stage_fn(params, x):
+    """One pipeline chunk = linear + tanh (shape-preserving)."""
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def full_model(all_chunk_params, x):
+    """Unpartitioned gold: apply all chunks in order. Leaves are (V, P, ...)
+    chunk-major; execution order is chunk 0 stages 0..P-1, chunk 1 ..."""
+    V, P = all_chunk_params["w"].shape[:2]
+    for v in range(V):
+        for s in range(P):
+            params = {k: p[v, s] for k, p in all_chunk_params.items()}
+            x = stage_fn(params, x)
+    return x
+
+
+def make_params(rng, V, P):
+    return {
+        "w": jnp.asarray(rng.normal(size=(V, P, D, D)) * 0.5, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(V, P, D)) * 0.1, jnp.float32),
+    }
+
+
+def loss_fn(outs, targets):
+    return jnp.mean((outs - targets) ** 2)
+
+
+@pytest.fixture()
+def mesh(devices):
+    return make_mesh(pp=4)
+
+
+class TestPipelineForward:
+    @pytest.mark.parametrize("V,M", [(1, 4), (1, 6), (2, 4), (2, 6)])
+    def test_forward_matches_unpartitioned(self, mesh, rng, V, M):
+        P = 4
+        params = make_params(rng, V, P)
+        mbs = jnp.asarray(rng.normal(size=(M, 2, D)), jnp.float32)
+        targets = jnp.zeros_like(mbs)
+
+        f = schedules.pipelined_loss_fn(stage_fn, loss_fn, mesh,
+                                        num_chunks=V)
+        loss = f(params, mbs, targets)
+        gold_outs = jax.vmap(lambda x: full_model(params, x))(mbs)
+        gold_loss = loss_fn(gold_outs, targets)
+        np.testing.assert_allclose(float(loss), float(gold_loss),
+                                   rtol=1e-5)
+
+    def test_interleaved_requires_enough_microbatches(self, mesh, rng):
+        params = make_params(rng, 2, 4)
+        mbs = jnp.asarray(rng.normal(size=(2, 2, D)), jnp.float32)
+        f = schedules.pipelined_loss_fn(stage_fn, loss_fn, mesh,
+                                        num_chunks=2)
+        with pytest.raises(ValueError):
+            f(params, mbs, jnp.zeros_like(mbs))
+
+
+class TestPipelineBackward:
+    @pytest.mark.parametrize("V,M", [(1, 4), (2, 4)])
+    def test_grads_match_unpartitioned(self, mesh, rng, V, M):
+        P = 4
+        params = make_params(rng, V, P)
+        mbs = jnp.asarray(rng.normal(size=(M, 2, D)), jnp.float32)
+        targets = jnp.asarray(rng.normal(size=(M, 2, D)), jnp.float32)
+
+        loss, grads = (
+            schedules.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, mesh, params, mbs, targets)
+            if V == 1 else
+            schedules.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, mesh, params, mbs, targets,
+                num_chunks=V))
+
+        def gold(params):
+            outs = jax.vmap(lambda x: full_model(params, x))(mbs)
+            return loss_fn(outs, targets)
+
+        gold_loss, gold_grads = jax.value_and_grad(gold)(params)
+        np.testing.assert_allclose(float(loss), float(gold_loss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(gold_grads[k]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_jit_compiles_once(self, mesh, rng):
+        params = make_params(rng, 1, 4)
+        mbs = jnp.asarray(rng.normal(size=(4, 2, D)), jnp.float32)
+        targets = jnp.zeros_like(mbs)
+        f = schedules.pipelined_loss_fn(stage_fn, loss_fn, mesh)
+        jf = jax.jit(jax.value_and_grad(f))
+        l1, g1 = jf(params, mbs, targets)
+        l2, g2 = jf(params, mbs, targets)
+        assert np.isfinite(float(l1)) and float(l1) == float(l2)
+
+
+class TestNoPipelining:
+    def test_grad_accumulation_matches_full_batch(self, rng):
+        params = {"w": jnp.asarray(rng.normal(size=(D, D)) * 0.5,
+                                   jnp.float32)}
+        data = jnp.asarray(rng.normal(size=(8, 2, D)), jnp.float32)
+
+        def loss(p, mb):
+            return jnp.mean((jnp.tanh(mb @ p["w"]) - 1.0) ** 2)
+
+        mean_loss, grads = schedules.forward_backward_no_pipelining(
+            loss, params, data)
+        gold_loss = jnp.mean(jnp.stack([loss(params, data[i])
+                                        for i in range(8)]))
+        gold_grads = jax.grad(
+            lambda p: jnp.mean(jnp.stack(
+                [loss(p, data[i]) for i in range(8)])))(params)
+        np.testing.assert_allclose(float(mean_loss), float(gold_loss),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(gold_grads["w"]), rtol=1e-4,
+                                   atol=1e-7)
+
+
+class TestScheduleSelection:
+    def test_get_forward_backward_func(self, devices):
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(1, 1)
+        assert (schedules.get_forward_backward_func()
+                is schedules.forward_backward_no_pipelining)
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(1, 4)
+        assert (schedules.get_forward_backward_func()
+                is schedules.forward_backward_pipelining_without_interleaving)
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            1, 4, virtual_pipeline_model_parallel_size=2)
+        assert (schedules.get_forward_backward_func()
+                is schedules.forward_backward_pipelining_with_interleaving)
+        parallel_state.destroy_model_parallel()
+
+
+class TestMicrobatchCalculator:
+    def test_constant(self):
+        c = build_num_microbatches_calculator(None, 64, 4, 2)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 64
+        with pytest.raises(ValueError):
+            build_num_microbatches_calculator(None, 65, 4, 2)
+
+    def test_rampup(self):
+        c = build_num_microbatches_calculator((16, 8, 1000), 64, 4, 2)
+        assert c.get_current_global_batch_size() == 16
+        assert c.get() == 2
+        c.update(500)
+        assert c.get_current_global_batch_size() == 40
+        c.update(2000)
+        assert c.get_current_global_batch_size() == 64
+        assert c.get() == 8
