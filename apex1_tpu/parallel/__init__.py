@@ -1,0 +1,9 @@
+"""Distributed training services — reference ``apex/parallel`` +
+``apex/contrib/optimizers``."""
+
+from apex1_tpu.parallel.ddp import (  # noqa: F401
+    DistributedDataParallel, allreduce_grads, broadcast_params)
+from apex1_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm, convert_syncbn_model, sync_batch_stats)
+from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
+    distributed_fused_adam, shard_opt_state_specs)

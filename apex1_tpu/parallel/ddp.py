@@ -1,0 +1,114 @@
+"""Data-parallel gradient synchronization — reference
+``apex/parallel/distributed.py :: DistributedDataParallel``.
+
+The reference registers per-grad backward hooks that fill flat buckets
+(``message_size`` elements), all-reduces each bucket on a side CUDA stream
+overlapped with the remaining backward, with ``delay_allreduce``,
+``gradient_predivide_factor`` and ``retain_allreduce_buffers`` knobs, and
+first-iteration bucket-structure discovery.
+
+TPU-native: under ``pjit`` with batch sharded over dp, XLA inserts ONE fused
+gradient psum and overlaps it with the backward automatically (async
+collectives + latency-hiding scheduler) — the hook/bucket/stream machinery
+has no equivalent code (SURVEY §7.0). What remains meaningful, and is
+provided here:
+
+- an explicit ``allreduce_grads`` for the ``shard_map`` path (with the
+  reference's predivide semantics);
+- a `DistributedDataParallel` wrapper keeping the reference's constructor
+  surface so ported training loops read the same, implemented as a
+  loss-fn transformer;
+- parameter broadcast at init (≙ the reference broadcasting params from
+  rank 0 so replicas start identical).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_DP, AXIS_FSDP
+
+
+def allreduce_grads(grads, *, axis_names=(AXIS_DP,),
+                    gradient_predivide_factor: float = 1.0):
+    """Mean-reduce grads over the dp axes (inside shard_map).
+
+    Reference semantics: predivide by ``gradient_predivide_factor``, sum,
+    postdivide by ``world/factor`` — net effect a mean, with the factor
+    trading overflow headroom (fp16) for underflow; reproduced exactly.
+    """
+    world = 1
+    for ax in axis_names:
+        world *= jax.lax.axis_size(ax)
+    pre = 1.0 / gradient_predivide_factor
+    post = gradient_predivide_factor / world
+
+    def sync(g):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        g = g * pre
+        for ax in axis_names:
+            g = jax.lax.psum(g, ax)
+        return g * post
+
+    return jax.tree_util.tree_map(sync, grads)
+
+
+def broadcast_params(params, *, axis_names=(AXIS_DP, AXIS_FSDP)):
+    """Make params bit-identical across dp ranks (rank-0 wins) — ≙ the
+    init-time ``flat_dist_call`` broadcast. Under single-controller JAX
+    replicas are already identical; this is the shard_map-path guard."""
+    def bcast(p):
+        idx = 0
+        for ax in axis_names:
+            idx = idx + jax.lax.axis_index(ax)
+        is0 = (idx == 0)
+        send = jnp.where(is0, p, jnp.zeros_like(p))
+        for ax in axis_names:
+            send = jax.lax.psum(send, ax)
+        return send
+
+    return jax.tree_util.tree_map(bcast, params)
+
+
+class DistributedDataParallel:
+    """Constructor-surface parity wrapper
+    (``DistributedDataParallel(module, message_size, delay_allreduce, ...)``).
+
+    Wraps a ``loss_fn(params, batch)``; `value_and_grad` returns grads
+    already synchronized over dp. ``message_size``/``delay_allreduce``/
+    ``retain_allreduce_buffers`` are accepted and recorded but have no
+    effect — bucketing and overlap are XLA's job (documented N/A,
+    SURVEY §2.6 DP row).
+    """
+
+    def __init__(self, loss_fn: Callable, *,
+                 message_size: int = 10_000_000,
+                 delay_allreduce: bool = False,
+                 gradient_predivide_factor: float = 1.0,
+                 retain_allreduce_buffers: bool = False,
+                 axis_names=(AXIS_DP,)):
+        self.loss_fn = loss_fn
+        self.message_size = message_size
+        self.delay_allreduce = delay_allreduce
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.retain_allreduce_buffers = retain_allreduce_buffers
+        self.axis_names = tuple(axis_names)
+
+    def __call__(self, params, *batch):
+        return self.loss_fn(params, *batch)
+
+    def value_and_grad(self):
+        vg = jax.value_and_grad(self.loss_fn)
+
+        def f(params, *batch):
+            loss, grads = vg(params, *batch)
+            grads = allreduce_grads(
+                grads, axis_names=self.axis_names,
+                gradient_predivide_factor=self.gradient_predivide_factor)
+            return loss, grads
+
+        return f

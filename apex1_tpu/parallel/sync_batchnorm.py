@@ -1,0 +1,141 @@
+"""SyncBatchNorm — reference ``apex/parallel/optimized_sync_batchnorm.py``
+(+ ``csrc/syncbn.cpp / welford.cu``) and ``apex/parallel/sync_batchnorm.py``.
+
+Reference forward (§3.5 call stack): local per-channel Welford mean/var →
+all-gather stats over the process group (optionally a ``group_size``
+subgroup) → parallel Welford merge → normalize; backward all-reduces the
+two grad-stat sums. Channel-last fast path.
+
+TPU-native: the Welford merge collapses to a psum of (Σx, Σx², n) — a
+single fused collective on the VPU (count-weighted two-moment merge is
+algebraically identical to parallel Welford, and fp32 accumulation gives
+the same stability on TPU). ``group_size`` subgrouping maps to
+``axis_index_groups`` of the psum. The backward comes out of ``jax.grad``
+with exactly the reference's two cross-replica sums because the stats are
+computed through the psum (its transpose re-broadcasts the cotangents).
+
+`convert_syncbn_model` walks a flax module tree replacing BatchNorm with
+SyncBatchNorm, ≙ the reference's recursive module converter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_DP
+
+
+def sync_batch_stats(x, *, axis_name=AXIS_DP, reduce_axes, group_size=None):
+    """Cross-replica per-channel (mean, var, count): psum of
+    (Σx, Σx², n) — the fused ``welford_parallel`` merge."""
+    n_local = 1
+    for ax in reduce_axes:
+        n_local *= x.shape[ax]
+    s1 = jnp.sum(x.astype(jnp.float32), axis=reduce_axes)
+    s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=reduce_axes)
+    groups = None
+    if group_size is not None:
+        world = jax.lax.axis_size(axis_name)
+        if world % group_size:
+            raise ValueError(f"group_size {group_size} must divide dp world "
+                             f"{world}")
+        groups = [list(range(g * group_size, (g + 1) * group_size))
+                  for g in range(world // group_size)]
+    s1 = jax.lax.psum(s1, axis_name, axis_index_groups=groups)
+    s2 = jax.lax.psum(s2, axis_name, axis_index_groups=groups)
+    n = n_local * (group_size or jax.lax.axis_size(axis_name))
+    mean = s1 / n
+    var = s2 / n - jnp.square(mean)
+    return mean, var, n
+
+
+class SyncBatchNorm(nn.Module):
+    """``apex.parallel.SyncBatchNorm(num_features, eps, momentum, affine,
+    track_running_stats, process_group, channel_last)`` equivalent.
+
+    Input layout: channel-last (..., C) — the reference's NHWC fast path is
+    the only layout TPU wants. ``use_running_average`` switches to inference
+    stats. Running stats live in the ``batch_stats`` flax collection with
+    the reference's momentum convention
+    (new = (1−momentum)·old + momentum·batch)."""
+
+    num_features: Optional[int] = None  # inferred from input if None
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = AXIS_DP
+    group_size: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        C = self.num_features or x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            reduce_axes = tuple(range(x.ndim - 1))
+            if self.axis_name is not None:
+                try:
+                    mean, var, _ = sync_batch_stats(
+                        x, axis_name=self.axis_name,
+                        reduce_axes=reduce_axes,
+                        group_size=self.group_size)
+                except NameError:  # axis not bound (single-replica test)
+                    x32 = x.astype(jnp.float32)
+                    mean = jnp.mean(x32, axis=reduce_axes)
+                    var = jnp.var(x32, axis=reduce_axes)
+            else:
+                x32 = x.astype(jnp.float32)
+                mean = jnp.mean(x32, axis=reduce_axes)
+                var = jnp.var(x32, axis=reduce_axes)
+            if self.track_running_stats and not self.is_initializing():
+                ra_mean.value = ((1 - self.momentum) * ra_mean.value
+                                 + self.momentum * mean)
+                ra_var.value = ((1 - self.momentum) * ra_var.value
+                                + self.momentum * var)
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = self.param("scale", nn.initializers.ones, (C,),
+                               jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (C,),
+                              jnp.float32)
+            y = y * scale + bias
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, *, axis_name=AXIS_DP,
+                         group_size=None) -> nn.Module:
+    """≙ ``apex.parallel.convert_syncbn_model(net)``: return a copy of a
+    flax module tree with every ``nn.BatchNorm`` swapped for
+    `SyncBatchNorm`. Flax modules are frozen dataclasses, so this clones
+    with replaced submodules (same param tree structure)."""
+    import dataclasses as dc
+
+    def convert(m):
+        if isinstance(m, nn.BatchNorm):
+            return SyncBatchNorm(
+                eps=m.epsilon, momentum=1.0 - m.momentum,
+                affine=m.use_scale and m.use_bias,
+                axis_name=axis_name, group_size=group_size,
+                name=m.name)
+        if not isinstance(m, nn.Module):
+            return m
+        changes = {}
+        for f in dc.fields(m):
+            v = getattr(m, f.name, None)
+            if isinstance(v, nn.Module):
+                nv = convert(v)
+                if nv is not v:
+                    changes[f.name] = nv
+        return m.clone(**changes) if changes else m
+
+    return convert(module)

@@ -1,0 +1,212 @@
+"""DP services tests — ≙ ``tests/distributed/{DDP,synced_batchnorm}`` and
+``apex/contrib/test/optimizers`` (DistributedFusedAdam): grad sync semantics,
+SyncBN single-vs-multi-replica parity, ZeRO-sharded Adam vs unsharded gold."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.mesh import make_mesh
+from apex1_tpu import parallel
+from apex1_tpu.optim import FusedAdam
+
+
+@pytest.fixture()
+def mesh(devices):
+    return make_mesh(dp=8)
+
+
+@pytest.fixture()
+def fsdp_mesh(devices):
+    return make_mesh(fsdp=8)
+
+
+def smap(mesh, fn, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+class TestDDP:
+    def test_allreduce_grads_is_mean(self, mesh, rng):
+        g = jnp.asarray(np.arange(8, dtype=np.float32).reshape(8, 1))
+
+        def f(g):
+            return parallel.allreduce_grads({"w": g},
+                                            axis_names=("dp",))["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((8, 1), 3.5), rtol=1e-6)
+
+    def test_predivide_factor_net_mean(self, mesh, rng):
+        g = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+
+        def f(g):
+            return parallel.allreduce_grads(
+                {"w": g}, axis_names=("dp",),
+                gradient_predivide_factor=4.0)["w"]
+
+        out = smap(mesh, f, P("dp"), P("dp"))(g)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.broadcast_to(np.asarray(g).mean(0), (8, 4)).reshape(8, 4)
+            * 0 + np.asarray(g).mean(0), rtol=1e-5)
+
+    def test_ddp_wrapper_end_to_end(self, mesh, rng):
+        # per-replica batches; DDP grads == full-batch grads
+        x = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(4, 1)) * 0.3, jnp.float32)
+
+        def loss_fn(w, xb):
+            return jnp.mean((xb @ w) ** 2)
+
+        ddp = parallel.DistributedDataParallel(loss_fn, axis_names=("dp",))
+        vg = ddp.value_and_grad()
+
+        def f(w, xb):
+            loss, grads = vg(w, xb)
+            return jax.lax.pmean(loss, "dp"), grads
+
+        loss, grads = smap(mesh, f, (P(), P("dp")), (P(), P()))(w, x)
+        gold_loss, gold_grads = jax.value_and_grad(loss_fn)(w, x)
+        np.testing.assert_allclose(float(loss), float(gold_loss), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads), np.asarray(gold_grads),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_broadcast_params(self, mesh, rng):
+        # divergent per-rank params → rank-0 copy everywhere
+        ps = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+
+        def f(p):
+            return parallel.broadcast_params(p, axis_names=("dp",))
+
+        out = smap(mesh, f, P("dp"), P("dp"))(ps)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(np.asarray(ps)[0], (8, 1)),
+                                   rtol=1e-6)
+
+
+class TestSyncBatchNorm:
+    def test_stats_match_full_batch(self, mesh, rng):
+        """The reference's canonical test: SyncBN over N replicas each with
+        B/N samples == plain BN over the full batch."""
+        x = jnp.asarray(rng.normal(size=(32, 6)) * 3 + 1, jnp.float32)
+        bn = parallel.SyncBatchNorm(num_features=6, axis_name="dp")
+        variables = bn.init(jax.random.PRNGKey(0), x[:4])
+
+        def f(x_local):
+            y, updates = bn.apply(variables, x_local,
+                                  mutable=["batch_stats"])
+            return y, updates["batch_stats"]["mean"]
+
+        y, means = smap(mesh, f, P("dp"), (P("dp"), P()))(x)
+        # gold: normalize with FULL-batch stats
+        mu = np.asarray(x).mean(0)
+        var = np.asarray(x).var(0)
+        gold = (np.asarray(x) - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), gold, rtol=1e-4,
+                                   atol=1e-5)
+        # running mean updated with momentum towards full-batch mean
+        np.testing.assert_allclose(np.asarray(means), 0.1 * mu, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_group_size_subgroups(self, mesh, rng):
+        # group_size=4: two independent stat groups of 4 replicas
+        x = jnp.asarray(rng.normal(size=(8, 2, 4)), jnp.float32)
+        bn = parallel.SyncBatchNorm(num_features=4, axis_name="dp",
+                                    group_size=4, track_running_stats=False)
+        variables = bn.init(jax.random.PRNGKey(0), x[0])
+
+        def f(x_local):
+            return bn.apply(variables, x_local)
+
+        y = smap(mesh, f, P("dp"), P("dp"))(x)
+        xg = np.asarray(x)
+        for g in range(2):
+            grp = xg[g * 4:(g + 1) * 4].reshape(-1, 4)
+            mu, var = grp.mean(0), grp.var(0)
+            gold = (xg[g * 4:(g + 1) * 4] - mu) / np.sqrt(var + 1e-5)
+            np.testing.assert_allclose(np.asarray(y)[g * 4:(g + 1) * 4],
+                                       gold, rtol=1e-4, atol=1e-5)
+
+    def test_grad_matches_full_batch_bn(self, mesh, rng):
+        x = jnp.asarray(rng.normal(size=(16, 3)), jnp.float32)
+        bn = parallel.SyncBatchNorm(num_features=3, axis_name="dp",
+                                    track_running_stats=False)
+        variables = bn.init(jax.random.PRNGKey(0), x[:2])
+
+        def f(x_local):
+            return jax.grad(lambda x: jnp.sum(
+                bn.apply(variables, x) ** 2) / 16)(x_local)
+
+        g = smap(mesh, f, P("dp"), P("dp"))(x)
+
+        def gold_loss(x):
+            mu = jnp.mean(x, 0)
+            var = jnp.var(x, 0)
+            return jnp.sum(((x - mu) / jnp.sqrt(var + 1e-5)) ** 2) / 16
+
+        gold = jax.grad(gold_loss)(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gold),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_convert_syncbn_model(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            bn: nn.Module = None
+
+            @nn.compact
+            def __call__(self, x):
+                return self.bn(x)
+
+        net = Net(bn=nn.BatchNorm(use_running_average=False))
+        converted = parallel.convert_syncbn_model(net, axis_name=None)
+        assert isinstance(converted.bn, parallel.SyncBatchNorm)
+
+
+class TestDistributedFusedAdam:
+    def test_matches_unsharded_adam(self, fsdp_mesh, rng):
+        params = {"w": jnp.asarray(rng.normal(size=(13, 5)), jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=(7,)), jnp.float32)}
+        opt = parallel.distributed_fused_adam(1e-2, weight_decay=0.01)
+        gold_opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        gold_state = gold_opt.init(params)
+        gold = params
+
+        def one_step(params, state, grads):
+            return opt.step(grads, state, params)
+
+        from apex1_tpu.parallel.distributed_optimizer import (
+            DistributedAdamState)
+        state_spec = DistributedAdamState(step=P(),
+                                          exp_avg_shard=P("fsdp"),
+                                          exp_avg_sq_shard=P("fsdp"))
+
+        def init_fn(params):
+            return opt.init(params)
+
+        state = smap(fsdp_mesh, init_fn, P(), state_spec)(params)
+        step = smap(fsdp_mesh, one_step, (P(), state_spec, P()),
+                    (P(), state_spec))
+        for i in range(3):
+            grads = jax.tree.map(
+                lambda p: jnp.asarray(rng.normal(size=p.shape) * 0.1,
+                                      jnp.float32), params)
+            # replicate the mean-semantics: every rank has the same grads
+            params, state = step(params, state, grads)
+            gold, gold_state = gold_opt.step(grads, gold_state, gold)
+            for k in ("w", "b"):
+                np.testing.assert_allclose(np.asarray(params[k]),
+                                           np.asarray(gold[k]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_shard_opt_state_specs(self, rng):
+        params = {"w": jnp.zeros((16, 4)), "b": jnp.zeros(())}
+        tx = FusedAdam(lr=1e-3)
+        st = tx.init(params)
+        specs = parallel.shard_opt_state_specs(st)
+        assert specs.exp_avg["w"] == P("fsdp", None)
+        assert specs.step == P()
