@@ -67,14 +67,23 @@ class SyncBatchNorm(nn.Module):
     eps: float = 1e-5
     momentum: float = 0.1
     affine: bool = True
+    use_scale: bool = True   # affine granularity (flax use_scale/use_bias)
+    use_bias: bool = True
     track_running_stats: bool = True
+    use_running_average: Optional[bool] = None
+    feature_axis: int = -1
     axis_name: Optional[str] = AXIS_DP
     group_size: Optional[int] = None
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, use_running_average: bool = False):
-        C = self.num_features or x.shape[-1]
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        if use_running_average is None:
+            use_running_average = bool(self.use_running_average)
+        feat_ax = self.feature_axis % x.ndim
+        C = self.num_features or x.shape[feat_ax]
+        reduce_axes = tuple(a for a in range(x.ndim) if a != feat_ax)
+        stat_shape = tuple(1 if a != feat_ax else C for a in range(x.ndim))
         ra_mean = self.variable("batch_stats", "mean",
                                 lambda: jnp.zeros((C,), jnp.float32))
         ra_var = self.variable("batch_stats", "var",
@@ -82,33 +91,46 @@ class SyncBatchNorm(nn.Module):
         if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
-            reduce_axes = tuple(range(x.ndim - 1))
+            # Probe axis binding with the cheap size query only, so a real
+            # NameError inside sync_batch_stats is never swallowed.
+            axis_bound = False
             if self.axis_name is not None:
                 try:
-                    mean, var, _ = sync_batch_stats(
-                        x, axis_name=self.axis_name,
-                        reduce_axes=reduce_axes,
-                        group_size=self.group_size)
-                except NameError:  # axis not bound (single-replica test)
-                    x32 = x.astype(jnp.float32)
-                    mean = jnp.mean(x32, axis=reduce_axes)
-                    var = jnp.var(x32, axis=reduce_axes)
+                    jax.lax.axis_size(self.axis_name)
+                    axis_bound = True
+                except NameError:  # single-replica / untraced test context
+                    axis_bound = False
+            if axis_bound:
+                mean, var, n = sync_batch_stats(
+                    x, axis_name=self.axis_name,
+                    reduce_axes=reduce_axes,
+                    group_size=self.group_size)
             else:
                 x32 = x.astype(jnp.float32)
                 mean = jnp.mean(x32, axis=reduce_axes)
                 var = jnp.var(x32, axis=reduce_axes)
+                n = 1
+                for ax in reduce_axes:
+                    n *= x.shape[ax]
             if self.track_running_stats and not self.is_initializing():
+                # running_var stores the UNBIASED variance (reference /
+                # torch convention), batch normalization uses the biased one
+                unbiased = var * (n / max(n - 1, 1))
                 ra_mean.value = ((1 - self.momentum) * ra_mean.value
                                  + self.momentum * mean)
                 ra_var.value = ((1 - self.momentum) * ra_var.value
-                                + self.momentum * var)
+                                + self.momentum * unbiased)
+        mean = mean.reshape(stat_shape)
+        var = var.reshape(stat_shape)
         y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
-        if self.affine:
+        if self.affine and self.use_scale:
             scale = self.param("scale", nn.initializers.ones, (C,),
                                jnp.float32)
+            y = y * scale.reshape(stat_shape)
+        if self.affine and self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (C,),
                               jnp.float32)
-            y = y * scale + bias
+            y = y + bias.reshape(stat_shape)
         return y.astype(x.dtype)
 
 
@@ -124,18 +146,34 @@ def convert_syncbn_model(module: nn.Module, *, axis_name=AXIS_DP,
         if isinstance(m, nn.BatchNorm):
             return SyncBatchNorm(
                 eps=m.epsilon, momentum=1.0 - m.momentum,
-                affine=m.use_scale and m.use_bias,
+                affine=m.use_scale or m.use_bias,
+                use_scale=m.use_scale, use_bias=m.use_bias,
+                use_running_average=m.use_running_average,
+                feature_axis=(m.axis if isinstance(m.axis, int) else -1),
                 axis_name=axis_name, group_size=group_size,
                 name=m.name)
-        if not isinstance(m, nn.Module):
-            return m
-        changes = {}
-        for f in dc.fields(m):
-            v = getattr(m, f.name, None)
-            if isinstance(v, nn.Module):
+        if isinstance(m, nn.Module):
+            changes = {}
+            for f in dc.fields(m):
+                if f.name in ("parent", "name"):
+                    continue
+                v = getattr(m, f.name, None)
                 nv = convert(v)
                 if nv is not v:
                     changes[f.name] = nv
-        return m.clone(**changes) if changes else m
+            return m.clone(**changes) if changes else m
+        # recurse into containers so BatchNorms inside Sequence/dict fields
+        # (e.g. nn.Sequential's layers tuple) are found
+        if isinstance(m, (list, tuple)):
+            nv = [convert(v) for v in m]
+            if all(a is b for a, b in zip(nv, m)):
+                return m
+            return type(m)(nv)
+        if isinstance(m, dict):
+            nv = {k: convert(v) for k, v in m.items()}
+            if all(nv[k] is m[k] for k in m):
+                return m
+            return nv
+        return m
 
     return convert(module)

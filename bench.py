@@ -1,0 +1,76 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Measures tokens/sec/chip for a GPT-2 125M training step under the
+amp-O2-equivalent policy (bf16 compute, fp32 master weights) + fused Adam —
+BASELINE.json config 1's model under the north-star's optimizer/precision
+recipe.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md); the
+comparator is a literature proxy for a single A100 running a 124M GPT-2
+with torch+apex-class mixed precision: ~1.5e5 tokens/sec. vs_baseline =
+measured / proxy, so >1.0 means beating the A100-class number per chip.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_PROXY_TOKENS_PER_SEC = 150_000.0
+
+
+def main():
+    from apex1_tpu.amp import Amp
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+    from apex1_tpu.optim.fused_adam import fused_adam
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    if on_accel:
+        B, S = 8, 1024
+        cfg = GPT2Config(policy=get_policy("O2"))  # full 125M
+        warmup, iters = 3, 10
+    else:  # CPU smoke mode: tiny model, same code path
+        B, S = 2, 128
+        cfg = GPT2Config.tiny(policy=get_policy("O2"))
+        warmup, iters = 1, 3
+
+    model = GPT2(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S)),
+        jnp.int32)
+    params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
+
+    amp = Amp(tx=fused_adam(1e-4, weight_decay=0.01), opt_level="O2")
+    state = amp.init(params)
+    del params
+    step = jax.jit(amp.make_train_step(gpt2_loss_fn(model)),
+                   donate_argnums=0)
+
+    for _ in range(warmup):
+        state, metrics = step(state, tokens)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, tokens)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    print(json.dumps({
+        "metric": f"tokens/sec/chip GPT-2-125M amp-O2 fused_adam "
+                  f"[{backend}]" if on_accel else
+                  f"tokens/sec/chip GPT-2(tiny smoke) amp-O2 fused_adam "
+                  f"[{backend}]",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / A100_PROXY_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

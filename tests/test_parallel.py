@@ -51,8 +51,7 @@ class TestDDP:
         out = smap(mesh, f, P("dp"), P("dp"))(g)
         np.testing.assert_allclose(
             np.asarray(out),
-            np.broadcast_to(np.asarray(g).mean(0), (8, 4)).reshape(8, 4)
-            * 0 + np.asarray(g).mean(0), rtol=1e-5)
+            np.broadcast_to(np.asarray(g).mean(0), (8, 4)), rtol=1e-5)
 
     def test_ddp_wrapper_end_to_end(self, mesh, rng):
         # per-replica batches; DDP grads == full-batch grads
@@ -165,6 +164,44 @@ class TestSyncBatchNorm:
         net = Net(bn=nn.BatchNorm(use_running_average=False))
         converted = parallel.convert_syncbn_model(net, axis_name=None)
         assert isinstance(converted.bn, parallel.SyncBatchNorm)
+
+    def test_convert_recurses_into_containers(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            layers: tuple = ()
+
+            @nn.compact
+            def __call__(self, x):
+                for l in self.layers:
+                    x = l(x)
+                return x
+
+        net = Net(layers=(nn.Dense(4), nn.BatchNorm(
+            use_running_average=False), nn.Dense(4)))
+        converted = parallel.convert_syncbn_model(net, axis_name=None)
+        assert isinstance(converted.layers[1], parallel.SyncBatchNorm)
+        assert isinstance(converted.layers[0], nn.Dense)
+
+    def test_convert_preserves_bn_config(self):
+        import flax.linen as nn
+
+        bn = nn.BatchNorm(use_running_average=True, use_scale=True,
+                          use_bias=False)
+        sbn = parallel.convert_syncbn_model(bn, axis_name=None)
+        assert sbn.use_running_average is True
+        assert sbn.use_scale and not sbn.use_bias and sbn.affine
+
+    def test_running_var_is_unbiased(self):
+        # reference/torch convention: running_var stores var * n/(n-1)
+        sbn = parallel.SyncBatchNorm(axis_name=None, momentum=1.0)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 3)),
+                        jnp.float32)
+        vs = sbn.init(jax.random.key(0), x)
+        _, mut = sbn.apply(vs, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["var"]),
+            np.var(np.asarray(x), axis=0, ddof=1), rtol=1e-5)
 
 
 class TestDistributedFusedAdam:
