@@ -14,6 +14,7 @@ softmax run fp32 when ``keep_norms_fp32``/``fp32_fragile_ops`` ask for it
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import flax.linen as nn
 import jax
@@ -79,7 +80,7 @@ class Block(nn.Module):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
         probs = scaled_upper_triang_masked_softmax(
-            scores, scale=1.0 / jnp.sqrt(hd).astype(jnp.float32))
+            scores, scale=1.0 / math.sqrt(hd))
         probs = probs.astype(dtype)
         attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, S, h)

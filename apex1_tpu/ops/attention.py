@@ -1,0 +1,469 @@
+"""Flash attention — Pallas TPU kernels.
+
+Reference capability: ``apex/contrib/fmha/fmha.py :: FMHAFun`` (+
+``apex/contrib/csrc/fmha/``, seqlen ≤ 512, head-dim 64, varlen via
+cu_seqlens) and ``apex/contrib/multihead_attn`` (fused full-MHA blocks).
+The reference kernels materialize (or tile) the full score matrix per CTA;
+the TPU-native design is a flash/online-softmax kernel with NO seqlen cap:
+
+- **forward**: grid ``(B, H, num_q_blocks, num_k_blocks)`` with the key axis
+  innermost; VMEM scratch carries the running ``(max, sum, acc)`` across key
+  blocks (TPU grid iteration is sequential, so scratch persists); saves only
+  ``(out, logsumexp)`` — activation memory O(S·D), not O(S²).
+- **backward**: recomputes probabilities from ``q·kᵀ`` and the saved
+  logsumexp (the same recompute-instead-of-save trade the reference's
+  xentropy kernel makes); two kernels — dq (key-innermost) and dk/dv
+  (query-innermost accumulation).
+- **varlen**: ``segment_ids`` — positions in different segments never
+  attend (≙ the reference fmha's cu_seqlens packed batches).
+- **GQA/MQA**: ``k``/``v`` may have fewer heads than ``q`` (grouped by
+  index-map arithmetic, no materialized repeat).
+- **ring/context parallel**: traced ``q_offset``/``k_offset`` scalars (SMEM)
+  shift the global positions used by the causal mask, and the op can return
+  the per-shard ``lse`` so `apex1_tpu.parallel.ring_attention` can merge
+  partial results around an ICI ring — differentiably (the custom VJP
+  handles the lse cotangent: ∂lse/∂s = softmax(s) ⇒ ds += p·dlse).
+
+Shapes: ``q`` (B, Hq, Sq, D); ``k``/``v`` (B, Hkv, Sk, D), Hq % Hkv == 0.
+Accumulation is fp32 regardless of input dtype (bf16 inputs feed the MXU
+directly; only the running statistics are fp32) — matching the reference's
+fp16-in/fp32-accumulate kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex1_tpu.ops._common import NEG_INF, interpret_mode, pad_to, use_pallas
+
+_LANES = 128
+
+
+def _block(size: int, requested: int) -> int:
+    """Block size: the requested tile, shrunk for tiny inputs (≥16-aligned
+    so bf16 (16, 128) sublane tiling stays legal)."""
+    return min(requested, max(16, ((size + 15) // 16) * 16))
+
+
+def _mask_for(qi, ki, bq, bk, *, causal, true_sq, true_sk, q_off, k_off,
+              qseg, kseg):
+    """(bq, bk) validity mask for one score block. Padded rows/cols are
+    invalid; causal compares GLOBAL positions (local + traced offset)."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+    col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+    mask = (col < true_sk) & (row < true_sq)
+    if causal:
+        mask &= (col + k_off) <= (row + q_off)
+    if qseg is not None:
+        mask &= qseg == kseg  # (bq,1) == (1,bk) broadcast
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
+                scale, causal, true_sq, true_sk, has_segs, n_k):
+    if has_segs:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc, m_scr, l_scr = seg_and_out
+        qseg, kseg = qseg_ref[0], kseg_ref[0]  # (bq,1), (1,bk)
+    else:
+        o_ref, lse_ref, acc, m_scr, l_scr = seg_and_out
+        qseg = kseg = None
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(ki == 0)
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
+                     qseg=qseg, kseg=kseg)
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    e = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    l_new = l_prev * corr + jnp.sum(e, axis=1, keepdims=True)
+    v = v_ref[0, 0].astype(jnp.float32)
+    acc[...] = acc[...] * corr + jax.lax.dot_general(
+        e, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc[...] / safe).astype(o_ref.dtype)
+        # finite NEG_INF sentinel for empty rows keeps ring merges exact
+        lse_ref[0, 0] = jnp.where(l > 0.0, m_scr[:, :1] + jnp.log(safe),
+                                  NEG_INF)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
+                   qo_ref, ko_ref, *seg_and_out,
+                   scale, causal, true_sq, true_sk, has_segs, n_k):
+    if has_segs:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = seg_and_out
+        qseg, kseg = qseg_ref[0], kseg_ref[0]
+    else:
+        dq_ref, dq_acc = seg_and_out
+        qseg = kseg = None
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
+                     qseg=qseg, kseg=kseg)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+    do = do_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
+    dq_acc[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
+                    qo_ref, ko_ref, *seg_and_out,
+                    scale, causal, true_sq, true_sk, has_segs, n_q):
+    if has_segs:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = seg_and_out
+        qseg, kseg = qseg_ref[0], kseg_ref[0]
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = seg_and_out
+        qseg = kseg = None
+    ki, qi = pl.program_id(2), pl.program_id(3)  # query axis innermost
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
+                     qseg=qseg, kseg=kseg)
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+    do = do_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    dv_acc[...] += jax.lax.dot_general(                      # pᵀ · do
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
+    dk_acc[...] += jax.lax.dot_general(                      # dsᵀ · q
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _prep(q, k, v, qseg, kseg, has_segs, block_q, block_k):
+    """Pad operands to block multiples; returns padded arrays + geometry."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    bq, bk = _block(Sq, block_q), _block(Sk, block_k)
+    qp, _ = pad_to(q, 2, bq)
+    qp, _ = pad_to(qp, 3, _LANES)
+    kp, _ = pad_to(k, 2, bk)
+    kp, _ = pad_to(kp, 3, _LANES)
+    vp, _ = pad_to(v, 2, bk)
+    vp, _ = pad_to(vp, 3, _LANES)
+    if has_segs:
+        # qseg → (B, Sq, 1) / kseg → (B, 1, Sk): 2-D refs, no in-kernel
+        # transpose; pad value -1 ≠ -2 so padded q never matches padded k
+        qs, _ = pad_to(qseg.astype(jnp.int32)[:, :, None], 1, bq, value=-1)
+        ks, _ = pad_to(kseg.astype(jnp.int32)[:, None, :], 2, bk, value=-2)
+    else:
+        qs = ks = None
+    geom = dict(B=B, Hq=Hq, Hkv=Hkv, group=Hq // Hkv, Sq=Sq, Sk=Sk, D=D,
+                bq=bq, bk=bk, n_q=qp.shape[2] // bq, n_k=kp.shape[2] // bk,
+                Dp=qp.shape[3])
+    return qp, kp, vp, qs, ks, geom
+
+
+def _common_specs(g, *, for_dkv=False):
+    """Block specs shared by all three kernels. Grid axes are (b, h, qi, ki)
+    for fwd/dq and (b, h, ki, qi) for dk/dv (``for_dkv``)."""
+    def ix(bi, hi, i2, i3):
+        qi, ki = (i3, i2) if for_dkv else (i2, i3)
+        return qi, ki
+
+    group = g["group"]
+    q_spec = pl.BlockSpec((1, 1, g["bq"], g["Dp"]),
+                          lambda b, h, i2, i3: (b, h, ix(b, h, i2, i3)[0], 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec(
+        (1, 1, g["bk"], g["Dp"]),
+        lambda b, h, i2, i3: (b, h // group, ix(b, h, i2, i3)[1], 0),
+        memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((1, 1, g["bq"], 1),
+                             lambda b, h, i2, i3: (b, h, ix(b, h, i2, i3)[0],
+                                                   0),
+                             memory_space=pltpu.VMEM)
+    off_spec = pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                            memory_space=pltpu.SMEM)
+    qseg_spec = pl.BlockSpec((1, g["bq"], 1),
+                             lambda b, h, i2, i3: (b, ix(b, h, i2, i3)[0], 0),
+                             memory_space=pltpu.VMEM)
+    kseg_spec = pl.BlockSpec((1, 1, g["bk"]),
+                             lambda b, h, i2, i3: (b, 0,
+                                                   ix(b, h, i2, i3)[1]),
+                             memory_space=pltpu.VMEM)
+    return q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec
+
+
+def _off_arrays(q_off, k_off):
+    return (jnp.asarray(q_off, jnp.int32).reshape(1, 1),
+            jnp.asarray(k_off, jnp.int32).reshape(1, 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash(q, k, v, qseg, kseg, q_off, k_off,
+           scale, causal, has_segs, block_q, block_k):
+    out, lse, _ = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
+                                  scale, causal, has_segs, block_q, block_k)
+    return out, lse
+
+
+def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
+                    scale, causal, has_segs, block_q, block_k):
+    qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
+                                  block_q, block_k)
+    q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
+        _common_specs(g)
+    in_specs = [q_spec, kv_spec, kv_spec, off_spec, off_spec]
+    args = [qp, kp, vp, *_off_arrays(q_off, k_off)]
+    if has_segs:
+        in_specs += [qseg_spec, kseg_spec]
+        args += [qs, ks]
+    Sqp = g["n_q"] * g["bq"]
+    out_p, lse_p = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          true_sq=g["Sq"], true_sk=g["Sk"],
+                          has_segs=has_segs, n_k=g["n_k"]),
+        grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
+        in_specs=in_specs,
+        out_specs=(q_spec, stat_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, g["Dp"]), q.dtype),
+            jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, 1), jnp.float32)),
+        scratch_shapes=[
+            pltpu.VMEM((g["bq"], g["Dp"]), jnp.float32),
+            pltpu.VMEM((g["bq"], _LANES), jnp.float32),
+            pltpu.VMEM((g["bq"], _LANES), jnp.float32)],
+        interpret=interpret_mode(),
+    )(*args)
+    out = out_p[:, :, :g["Sq"], :g["D"]]
+    lse = lse_p[:, :, :g["Sq"], 0]
+    return out, lse, lse_p
+
+
+def _flash_fwd(q, k, v, qseg, kseg, q_off, k_off,
+               scale, causal, has_segs, block_q, block_k):
+    out, lse, lse_p = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
+                                      scale, causal, has_segs,
+                                      block_q, block_k)
+    return (out, lse), (q, k, v, qseg, kseg, q_off, k_off, out, lse_p)
+
+
+def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
+    q, k, v, qseg, kseg, q_off, k_off, out, lse_p = res
+    dout, dlse = cts
+    qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
+                                  block_q, block_k)
+    Sqp = g["n_q"] * g["bq"]
+    dop, _ = pad_to(dout.astype(q.dtype), 2, g["bq"])
+    dop, _ = pad_to(dop, 3, _LANES)
+    # δ_i = Σ_d dout·out — padded regions are zero so no masking needed
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dlt_p, _ = pad_to(delta[..., None], 2, g["bq"])
+    dlse_p, _ = pad_to(dlse.astype(jnp.float32)[..., None], 2, g["bq"])
+
+    stat_args = [lse_p, dlt_p, dlse_p, *_off_arrays(q_off, k_off)]
+    kern = dict(scale=scale, causal=causal, true_sq=g["Sq"],
+                true_sk=g["Sk"], has_segs=has_segs)
+
+    # dq: grid (b, h, qi, ki), key axis innermost
+    q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
+        _common_specs(g)
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec,
+                stat_spec, off_spec, off_spec]
+    args = [qp, kp, vp, dop] + stat_args
+    if has_segs:
+        in_specs += [qseg_spec, kseg_spec]
+        args += [qs, ks]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, n_k=g["n_k"], **kern),
+        grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, g["Dp"]),
+                                       q.dtype),
+        scratch_shapes=[pltpu.VMEM((g["bq"], g["Dp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(*args)[:, :, :g["Sq"], :g["D"]]
+
+    # dk/dv: grid (b, h, ki, qi), query axis innermost; per-q-head partials
+    # are reduced over the GQA group afterwards
+    q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
+        _common_specs(g, for_dkv=True)
+    dkv_spec = pl.BlockSpec((1, 1, g["bk"], g["Dp"]),
+                            lambda b, h, i2, i3: (b, h, i2, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec,
+                stat_spec, off_spec, off_spec]
+    args = [qp, kp, vp, dop] + stat_args
+    if has_segs:
+        in_specs += [qseg_spec, kseg_spec]
+        args += [qs, ks]
+    Skp = g["n_k"] * g["bk"]
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, n_q=g["n_q"], **kern),
+        grid=(g["B"], g["Hq"], g["n_k"], g["n_q"]),
+        in_specs=in_specs,
+        out_specs=(dkv_spec, dkv_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((g["B"], g["Hq"], Skp, g["Dp"]),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((g["B"], g["Hq"], Skp, g["Dp"]),
+                                 jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((g["bk"], g["Dp"]), jnp.float32),
+                        pltpu.VMEM((g["bk"], g["Dp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(*args)
+    dk_h = dk_h[:, :, :g["Sk"], :g["D"]]
+    dv_h = dv_h[:, :, :g["Sk"], :g["D"]]
+    if g["group"] > 1:
+        shp = (g["B"], g["Hkv"], g["group"], g["Sk"], g["D"])
+        dk = jnp.sum(dk_h.reshape(shp), axis=2)
+        dv = jnp.sum(dv_h.reshape(shp), axis=2)
+    else:
+        dk, dv = dk_h, dv_h
+    f0 = lambda x: np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            f0(qseg), f0(kseg), f0(q_off), f0(k_off))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
+                   with_lse=False):
+    """XLA-composite gold: identical semantics incl. empty-row handling."""
+    B, Hq, Sq, D = q.shape
+    Hkv, Sk = k.shape[1], k.shape[2]
+    if Hq != Hkv:
+        k = jnp.repeat(k, Hq // Hkv, axis=1)
+        v = jnp.repeat(v, Hq // Hkv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    row = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+    mask = jnp.ones((B, 1, Sq, Sk), bool)
+    if causal:
+        mask &= ((col + k_off) <= (row + q_off))[None, None]
+    if qseg is not None:
+        mask &= (qseg[:, None, :, None] == kseg[:, None, None, :])
+    m = jnp.max(jnp.where(mask, s, NEG_INF), axis=-1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", e / jnp.where(l > 0, l, 1.0),
+                     v.astype(jnp.float32)).astype(q.dtype)
+    if not with_lse:
+        return out
+    lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)),
+                    NEG_INF)[..., 0]
+    return out, lse
+
+
+def _norm_segments(segment_ids, Sq, Sk):
+    if segment_ids is None:
+        return False, None, None
+    if isinstance(segment_ids, (tuple, list)):
+        qseg, kseg = segment_ids
+    else:
+        if Sq != Sk:
+            raise ValueError("pass (q_seg, k_seg) when Sq != Sk")
+        qseg = kseg = segment_ids
+    return True, qseg, kseg
+
+
+def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
+                    sm_scale: float | None = None, q_offset=0, k_offset=0,
+                    block_q: int = 128, block_k: int = 128,
+                    return_lse: bool = False):
+    """Flash attention over (B, H, S, D) operands.
+
+    ``segment_ids``: (B, S) int array (self-attention) or a
+    ``(q_seg, k_seg)`` pair — tokens attend only within equal ids
+    (≙ fmha's cu_seqlens varlen batches).
+    ``q_offset``/``k_offset``: traced global-position offsets for the
+    causal mask (used by ring/context parallelism; 0 for plain use).
+    ``return_lse``: also return the fp32 logsumexp (B, H, Sq) — needed to
+    merge partial-attention results (ring attention).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("expected (B, H, S, D) operands")
+    if q.shape[1] % k.shape[1] != 0:
+        raise ValueError(f"Hq={q.shape[1]} not a multiple of "
+                         f"Hkv={k.shape[1]}")
+    scale = (1.0 / float(np.sqrt(q.shape[-1]))
+             if sm_scale is None else float(sm_scale))
+    has_segs, qseg, kseg = _norm_segments(segment_ids, q.shape[2],
+                                          k.shape[2])
+    if use_pallas():
+        dummy = jnp.zeros((1, 1), jnp.int32)
+        out, lse = _flash(q, k, v,
+                          qseg if has_segs else dummy,
+                          kseg if has_segs else dummy,
+                          q_offset, k_offset,
+                          scale, causal, has_segs, block_q, block_k)
+    else:
+        out, lse = _xla_attention(q, k, v, qseg, kseg, q_offset, k_offset,
+                                  scale, causal, with_lse=True)
+    return (out, lse) if return_lse else out
+
+
+def fmha(qkv, *, segment_ids=None, causal: bool = True,
+         sm_scale: float | None = None):
+    """``apex.contrib.fmha.FMHAFun`` equivalent: packed (B, S, 3, H, D)
+    QKV, varlen via ``segment_ids`` instead of cu_seqlens. No seqlen-512 or
+    head-dim-64 cap — the flash kernel serves all sizes."""
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                          sm_scale=sm_scale)
+    return out.transpose(0, 2, 1, 3)

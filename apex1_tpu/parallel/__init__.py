@@ -7,3 +7,4 @@ from apex1_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm, convert_syncbn_model, sync_batch_stats)
 from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
     distributed_fused_adam, shard_opt_state_specs)
+from apex1_tpu.parallel.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,115 @@
+"""Ring attention — context parallelism for long sequences over ICI.
+
+The reference has NO long-context attention mechanism (SURVEY.md §5.7:
+``apex/contrib/fmha`` caps seqlen at 512; Megatron SP shards LN/dropout
+activations only). Its closest pattern is the spatial-parallel halo
+exchange (``apex/contrib/bottleneck/halo_exchangers.py :: HaloExchangerNccl``
+— activation-domain decomposition with neighbor transfers), which this
+module generalizes to attention: shard the SEQUENCE over a mesh axis and
+rotate K/V shards around the ring with ``jax.lax.ppermute`` (ICI
+neighbor transfers), merging partial-attention results with the
+numerically-stable logsumexp merge.
+
+Per ring step each device computes flash attention of its local Q shard
+against the visiting K/V shard (`apex1_tpu.ops.attention.flash_attention`
+with traced global offsets for the causal mask), yielding ``(out_t,
+lse_t)``; partials combine exactly:
+
+    lse   = logaddexp(lse_a, lse_b)
+    out   = out_a·exp(lse_a − lse) + out_b·exp(lse_b − lse)
+
+Fully-masked (future, under causal) visiting shards are skipped with
+``lax.cond`` — their transfer still rides the ring but their FLOPs are not
+spent. The whole loop is a ``lax.scan`` (static trip count = ring size),
+so reverse-mode AD works end-to-end: the backward pass is the transposed
+ring (ppermute with inverted permutation), inserted by XLA automatically.
+
+Use inside ``jax.shard_map`` with the sequence dimension sharded over
+``axis_name``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.ops.attention import flash_attention
+
+
+def _axis_size(axis_name) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def _merge(out_a, lse_a, out_b, lse_b):
+    """Exact combine of two normalized partial attentions (fp32 stats)."""
+    lse = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse)[..., None]
+    w_b = jnp.exp(lse_b - lse)[..., None]
+    return out_a * w_a + out_b.astype(out_a.dtype) * w_b, lse
+
+
+def ring_attention(q, k, v, axis_name, *, causal: bool = False,
+                   sm_scale: float | None = None, segment_ids=None,
+                   block_q: int = 128, block_k: int = 128):
+    """Attention over a sequence sharded on mesh axis ``axis_name``.
+
+    ``q``: local shard (B, Hq, S_local, D); ``k``/``v``: (B, Hkv, S_local,
+    D). The global sequence is ``ring_size * S_local``, laid out in
+    axis-index order. ``segment_ids``: local (B, S_local) shard of the
+    global segment ids (rides the ring alongside K/V). Returns the local
+    output shard (B, Hq, S_local, D).
+    """
+    n = _axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Hq, Sq, _ = q.shape
+    Sk = k.shape[2]
+    q_off = idx * Sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_segs = segment_ids is not None
+    qseg = segment_ids
+
+    def _vary(x):  # mark as device-varying over the ring axis (scan/cond
+        return jax.lax.pcast(x, axis_name, to="varying")  # carry typing)
+
+    out0 = _vary(jnp.zeros(q.shape, jnp.promote_types(q.dtype, jnp.float32)))
+    lse0 = _vary(jnp.full((B, Hq, Sq), -1e30, jnp.float32))
+
+    def attend(k_cur, v_cur, kseg_cur, t, out, lse):
+        src = (idx - t) % n           # who this K/V shard belongs to
+        k_off = src * Sk
+
+        def run(_):
+            return flash_attention(
+                q, k_cur, v_cur, causal=causal,
+                segment_ids=(qseg, kseg_cur) if has_segs else None,
+                sm_scale=sm_scale, q_offset=q_off, k_offset=k_off,
+                block_q=block_q, block_k=block_k, return_lse=True)
+
+        def skip(_):
+            return (_vary(jnp.zeros(q.shape, q.dtype)),
+                    _vary(jnp.full((B, Hq, Sq), -1e30, jnp.float32)))
+
+        if causal:
+            # visiting shard strictly in the future → fully masked
+            out_t, lse_t = jax.lax.cond(k_off > q_off + Sq - 1, skip, run,
+                                        None)
+        else:
+            out_t, lse_t = run(None)
+        return _merge(out, lse, out_t, lse_t)
+
+    def step(carry, t):
+        # rotate first, then attend: n attends, n−1 neighbor transfers
+        k_cur, v_cur, kseg_cur, out, lse = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if has_segs:
+            kseg_cur = jax.lax.ppermute(kseg_cur, axis_name, perm)
+        out, lse = attend(k_cur, v_cur, kseg_cur, t, out, lse)
+        return (k_cur, v_cur, kseg_cur, out, lse), None
+
+    kseg0 = qseg if has_segs else jnp.zeros((), jnp.int32)
+    out, lse = attend(k, v, kseg0, 0, out0, lse0)  # local shard, no comm
+    if n > 1:
+        (_, _, _, out, lse), _ = jax.lax.scan(
+            step, (k, v, kseg0, out, lse), jnp.arange(1, n))
+    return out.astype(q.dtype)

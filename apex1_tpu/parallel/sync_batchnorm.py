@@ -78,8 +78,9 @@ class SyncBatchNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
-        if use_running_average is None:
-            use_running_average = bool(self.use_running_average)
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
         feat_ax = self.feature_axis % x.ndim
         C = self.num_features or x.shape[feat_ax]
         reduce_axes = tuple(a for a in range(x.ndim) if a != feat_ax)
@@ -168,6 +169,8 @@ def convert_syncbn_model(module: nn.Module, *, axis_name=AXIS_DP,
             nv = [convert(v) for v in m]
             if all(a is b for a, b in zip(nv, m)):
                 return m
+            if isinstance(m, tuple) and hasattr(m, "_fields"):
+                return type(m)(*nv)  # NamedTuple: positional fields
             return type(m)(nv)
         if isinstance(m, dict):
             nv = {k: convert(v) for k, v in m.items()}

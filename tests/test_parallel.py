@@ -92,7 +92,7 @@ class TestSyncBatchNorm:
         """The reference's canonical test: SyncBN over N replicas each with
         B/N samples == plain BN over the full batch."""
         x = jnp.asarray(rng.normal(size=(32, 6)) * 3 + 1, jnp.float32)
-        bn = parallel.SyncBatchNorm(num_features=6, axis_name="dp")
+        bn = parallel.SyncBatchNorm(num_features=6, axis_name="dp", use_running_average=False)
         variables = bn.init(jax.random.PRNGKey(0), x[:4])
 
         def f(x_local):
@@ -114,7 +114,7 @@ class TestSyncBatchNorm:
     def test_group_size_subgroups(self, mesh, rng):
         # group_size=4: two independent stat groups of 4 replicas
         x = jnp.asarray(rng.normal(size=(8, 2, 4)), jnp.float32)
-        bn = parallel.SyncBatchNorm(num_features=4, axis_name="dp",
+        bn = parallel.SyncBatchNorm(num_features=4, axis_name="dp", use_running_average=False,
                                     group_size=4, track_running_stats=False)
         variables = bn.init(jax.random.PRNGKey(0), x[0])
 
@@ -132,7 +132,7 @@ class TestSyncBatchNorm:
 
     def test_grad_matches_full_batch_bn(self, mesh, rng):
         x = jnp.asarray(rng.normal(size=(16, 3)), jnp.float32)
-        bn = parallel.SyncBatchNorm(num_features=3, axis_name="dp",
+        bn = parallel.SyncBatchNorm(num_features=3, axis_name="dp", use_running_average=False,
                                     track_running_stats=False)
         variables = bn.init(jax.random.PRNGKey(0), x[:2])
 
@@ -194,7 +194,7 @@ class TestSyncBatchNorm:
 
     def test_running_var_is_unbiased(self):
         # reference/torch convention: running_var stores var * n/(n-1)
-        sbn = parallel.SyncBatchNorm(axis_name=None, momentum=1.0)
+        sbn = parallel.SyncBatchNorm(axis_name=None, momentum=1.0, use_running_average=False)
         x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 3)),
                         jnp.float32)
         vs = sbn.init(jax.random.key(0), x)

@@ -1,0 +1,87 @@
+"""Ring attention (context parallelism) vs global attention on the 8-device
+CPU mesh — fwd + grads, causal + segments (SURVEY.md §5.7 build obligation:
+BASELINE config 5 long-context capability the reference lacks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.mesh import make_mesh
+from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.parallel.ring_attention import ring_attention
+
+B, H, S, D = 2, 2, 64, 16
+SP = 4  # ring size
+
+
+def _mk(rng, dtype=jnp.float32):
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype)
+    return q, k, v
+
+
+def _ring_fn(mesh, causal, with_segs=False):
+    spec = P(None, None, "cp", None)
+    segspec = P(None, "cp")
+    in_specs = (spec, spec, spec) + ((segspec,) if with_segs else ())
+
+    def local(q, k, v, *segs):
+        return ring_attention(q, k, v, "cp", causal=causal,
+                              segment_ids=segs[0] if segs else None)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=spec))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_global(rng, causal, devices):
+    mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+    q, k, v = _mk(rng)
+    got = _ring_fn(mesh, causal)(q, k, v)
+    want = flash_attention(q, k, v, causal=causal)  # xla gold on cpu
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_with_segments(rng, devices):
+    mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+    q, k, v = _mk(rng)
+    seg = jnp.sort(jnp.asarray(rng.integers(0, 3, size=(B, S)), jnp.int32),
+                   axis=1)
+    got = _ring_fn(mesh, True, with_segs=True)(q, k, v, seg)
+    want = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grads_match_global(rng, causal, devices):
+    mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+    q, k, v = _mk(rng)
+    ring = _ring_fn(mesh, causal)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.square(ring(q, k, v)))
+
+    def loss_global(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, causal=causal)))
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_global, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_gqa(rng, devices):
+    mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+    q = jnp.asarray(rng.normal(size=(B, 4, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, 2, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, 2, S, D)), jnp.float32)
+    spec = P(None, None, "cp", None)
+    fn = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "cp", causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    got = fn(q, k, v)
+    want = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
