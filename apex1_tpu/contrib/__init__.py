@@ -1,0 +1,27 @@
+"""``apex.contrib`` facade — the reference's optional production
+components, re-exported under their reference names so users of
+``apex.contrib.*`` find the same surface here (SURVEY.md Appendix B).
+
+Implementations live where they belong in the TPU-native layout
+(`apex1_tpu.ops`, `apex1_tpu.optim`, `apex1_tpu.parallel`); this package
+binds them to the reference's import paths:
+
+- ``contrib.fmha``             → `apex1_tpu.ops.attention.fmha`
+- ``contrib.multihead_attn``   → `SelfMultiheadAttn`, `EncdecMultiheadAttn`
+- ``contrib.xentropy``         → `SoftmaxCrossEntropyLoss`
+- ``contrib.clip_grad``        → `clip_grad_norm_`
+- ``contrib.optimizers``       → `distributed_fused_adam` (ZeRO-style)
+
+Documented N/A on TPU (SURVEY.md §2.3): ``nccl_allocator`` (NVLS/SHARP),
+``peer_memory`` (CUDA IPC — superseded by ICI collectives), ``sparsity``
+(2:4 structured sparsity — no TPU sparse units).
+"""
+
+from apex1_tpu.contrib.multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn, SelfMultiheadAttn)
+from apex1_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss  # noqa: F401
+from apex1_tpu.ops.attention import fmha  # noqa: F401
+from apex1_tpu.optim.clip_grad import (  # noqa: F401
+    clip_grad_norm as clip_grad_norm_)
+from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
+    distributed_fused_adam)

@@ -1,0 +1,37 @@
+"""``apex.contrib.xentropy.SoftmaxCrossEntropyLoss`` — class-shaped parity
+wrapper over the fused kernel in `apex1_tpu.ops.xentropy`.
+
+Reference: ``apex/contrib/xentropy/softmax_xentropy.py ::
+SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing, padding_idx,
+half_to_float)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex1_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+
+class SoftmaxCrossEntropyLoss:
+    """Callable/``apply``-style wrapper; returns per-token losses
+    (reduce yourself, as the reference does)."""
+
+    def __init__(self, smoothing: float = 0.0,
+                 padding_idx: int | None = None):
+        self.smoothing = smoothing
+        self.padding_idx = padding_idx
+
+    def __call__(self, logits, labels):
+        return softmax_cross_entropy_loss(
+            logits, labels, smoothing=self.smoothing,
+            padding_idx=self.padding_idx)
+
+    @staticmethod
+    def apply(logits, labels, smoothing: float = 0.0,
+              padding_idx: int | None = None,
+              half_to_float: bool = False):
+        if half_to_float:
+            logits = logits.astype(jnp.float32)
+        return softmax_cross_entropy_loss(
+            logits, labels, smoothing=smoothing, padding_idx=padding_idx)

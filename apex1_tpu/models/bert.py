@@ -1,0 +1,188 @@
+"""BERT — BASELINE config 2 model ("BERT-base pretrain with FusedAdam +
+FusedLayerNorm → Pallas").
+
+Reference analogue: ``apex/transformer/testing/standalone_bert.py`` (the
+reference's test BERT) and the MLPerf BERT lineage of the fmha/multihead
+kernels. Built from this framework's fused ops: `apex1_tpu.ops.layer_norm`
+(Pallas), `apex1_tpu.ops.attention.flash_attention` (non-causal, padding
+via segment ids), fused xentropy for the MLM loss.
+
+Post-LN encoder (original BERT): x = LN(x + Sublayer(x)). Padding is
+expressed through ``attention_mask`` (1 = real token): real tokens form
+segment 1, pads segment 0, so pads never mix into real positions — the
+flash kernel's segment machinery replaces the reference's additive-mask
+softmax kernels (``scaled_masked_softmax_cuda``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy
+from apex1_tpu.ops import layer_norm, softmax_cross_entropy_loss
+from apex1_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    dropout: float = 0.0
+    policy: PrecisionPolicy = dataclasses.field(
+        default_factory=lambda: get_policy("O0"))
+
+    @staticmethod
+    def bert_base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def bert_large(**kw) -> "BertConfig":
+        defaults = dict(num_layers=24, num_heads=16, hidden_size=1024,
+                        intermediate_size=4096)
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        defaults = dict(vocab_size=256, max_seq_len=128, num_layers=2,
+                        num_heads=4, hidden_size=64, intermediate_size=128)
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, seg_mask):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        E, H = cfg.hidden_size, cfg.num_heads
+        D = E // H
+        B, S = x.shape[0], x.shape[1]
+
+        def norm(name, z):
+            g = self.param(f"{name}_scale", nn.initializers.ones, (E,),
+                           jnp.float32)
+            b = self.param(f"{name}_bias", nn.initializers.zeros, (E,),
+                           jnp.float32)
+            if not cfg.policy.keep_norms_fp32:
+                g, b = g.astype(dtype), b.astype(dtype)
+            return layer_norm(z, g, b)
+
+        qkv = nn.Dense(3 * E, dtype=dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+        attn = flash_attention(heads(q), heads(k), heads(v),
+                               segment_ids=seg_mask,
+                               sm_scale=1.0 / math.sqrt(D))
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, E)
+        attn = nn.Dense(E, dtype=dtype, name="attn_out")(attn)
+        x = norm("attn_ln", x + attn).astype(dtype)
+
+        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(E, dtype=dtype, name="ffn_out")(h)
+        return norm("ffn_ln", x + h).astype(dtype)
+
+
+class Bert(nn.Module):
+    """Returns (sequence_output (B,S,E), pooled_output (B,E))."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, attention_mask=None):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        B, S = tokens.shape
+        if token_types is None:
+            token_types = jnp.zeros_like(tokens)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(tokens)
+        wte = self.param("word_embeddings", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("position_embeddings",
+                         nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        tte = self.param("token_type_embeddings",
+                         nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.hidden_size),
+                         jnp.float32)
+        x = (wte[tokens] + wpe[:S][None] + tte[token_types]).astype(dtype)
+        g = self.param("emb_ln_scale", nn.initializers.ones,
+                       (cfg.hidden_size,), jnp.float32)
+        b = self.param("emb_ln_bias", nn.initializers.zeros,
+                       (cfg.hidden_size,), jnp.float32)
+        x = layer_norm(x, g, b).astype(dtype)
+        seg = attention_mask.astype(jnp.int32)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer{i}")(x, seg)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=dtype,
+                                  name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertPretrain(nn.Module):
+    """MLM (weight-tied decoder) + NSP heads — the pretrain objective of
+    BASELINE config 2."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, attention_mask=None):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        bert = Bert(cfg, name="bert")
+        seq, pooled = bert(tokens, token_types, attention_mask)
+        h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlm_transform")(seq)
+        h = nn.gelu(h)
+        g = self.param("mlm_ln_scale", nn.initializers.ones,
+                       (cfg.hidden_size,), jnp.float32)
+        b = self.param("mlm_ln_bias", nn.initializers.zeros,
+                       (cfg.hidden_size,), jnp.float32)
+        h = layer_norm(h, g, b)
+        wte = self.variables["params"]["bert"]["word_embeddings"]
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+        mlm_logits = jnp.matmul(
+            h.astype(dtype), wte.T.astype(dtype),
+            preferred_element_type=jnp.float32) + mlm_bias
+        nsp_logits = nn.Dense(2, dtype=dtype, name="nsp")(pooled)
+        return mlm_logits, nsp_logits.astype(jnp.float32)
+
+
+def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1):
+    """MLM CE (fused xentropy, ``padding_idx``-masked) + NSP CE.
+
+    ``batch``: dict with tokens, mlm_labels (ignore_index where unmasked),
+    nsp_labels, optional token_types/attention_mask."""
+
+    def loss_fn(params, batch):
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params}, batch["tokens"],
+            batch.get("token_types"), batch.get("attention_mask"))
+        labels = batch["mlm_labels"]
+        mlm_losses = softmax_cross_entropy_loss(
+            mlm_logits.astype(jnp.float32),
+            jnp.maximum(labels, 0)) * (labels != ignore_index)
+        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        mlm = jnp.sum(mlm_losses) / denom
+        nsp = jnp.mean(softmax_cross_entropy_loss(
+            nsp_logits, batch["nsp_labels"]))
+        return mlm + nsp
+
+    return loss_fn

@@ -1,0 +1,205 @@
+"""Llama-3 — BASELINE configs 4/5 model ("Llama-3 8B TP/PP on XLA mesh";
+"Llama-3 8B long-ctx, Pallas flash-attn + fused RoPE").
+
+The reference has no model zoo (its test transformers live in
+``apex/transformer/testing/standalone_gpt.py``); this is the standalone
+decoder built from this framework's fused ops: `apex1_tpu.ops.rms_norm`
+(Pallas), `apex1_tpu.ops.attention.flash_attention` (Pallas, GQA, causal),
+`apex1_tpu.ops.apply_rotary_pos_emb` (Pallas), fused vocab cross-entropy.
+
+TPU-first design notes:
+- all parameters are fp32 masters; compute casts per the precision policy
+  (amp-O2 semantics, `apex1_tpu.core.policy`);
+- `param_specs` returns a PartitionSpec tree from regex rules
+  (SNIPPETS.md pattern [1]) binding head/ffn/vocab dims to the ``tp`` mesh
+  axis and (optionally) everything to ``fsdp`` — GSPMD then inserts the
+  same collectives the reference's ColumnParallel/RowParallel autograd
+  functions issue by hand (SURVEY.md §7.0);
+- ``remat`` applies ``jax.checkpoint`` per block (≙ reference activation
+  checkpointing, ``tensor_parallel/random.py :: checkpoint``);
+- ``seq_shard_axis`` + ring attention turn the same block into its
+  context-parallel form (long-ctx config 5) — see
+  `apex1_tpu.models.llama.llama_loss_fn` users and tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy
+from apex1_tpu.ops import (apply_rotary_pos_emb, rms_norm, rope_tables,
+                           softmax_cross_entropy_loss)
+from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    max_seq_len: int = 8192
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    hidden_size: int = 4096
+    ffn_size: int = 14336
+    rope_base: float = 500000.0
+    norm_eps: float = 1e-5
+    remat: bool = False
+    policy: PrecisionPolicy = dataclasses.field(
+        default_factory=lambda: get_policy("O0"))
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        defaults = dict(vocab_size=256, max_seq_len=256, num_layers=2,
+                        num_heads=4, num_kv_heads=2, hidden_size=64,
+                        ffn_size=128)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+    # mesh axis carrying the sequence shard (ring/context parallel), or None
+    seq_shard_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        E, H, Hkv, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                        cfg.head_dim)
+        B, S = x.shape[0], x.shape[1]
+        init = nn.initializers.normal(0.02)
+
+        def norm(name, z):
+            g = self.param(name, nn.initializers.ones, (E,), jnp.float32)
+            if not cfg.policy.keep_norms_fp32:
+                g = g.astype(dtype)
+            return rms_norm(z, g, eps=cfg.norm_eps)
+
+        h = norm("attn_norm", x).astype(dtype)
+        wq = self.param("wq", init, (E, H * D), jnp.float32).astype(dtype)
+        wk = self.param("wk", init, (E, Hkv * D), jnp.float32).astype(dtype)
+        wv = self.param("wv", init, (E, Hkv * D), jnp.float32).astype(dtype)
+        q = (h @ wq).reshape(B, S, H, D)
+        k = (h @ wk).reshape(B, S, Hkv, D)
+        v = (h @ wv).reshape(B, S, Hkv, D)
+        q = apply_rotary_pos_emb(q, cos, sin)
+        k = apply_rotary_pos_emb(k, cos, sin)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if self.seq_shard_axis is not None:
+            attn = ring_attention(q, k, v, self.seq_shard_axis, causal=True)
+        else:
+            attn = flash_attention(q, k, v, causal=True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        wo = self.param("wo", init, (H * D, E), jnp.float32).astype(dtype)
+        x = x + (attn @ wo).astype(x.dtype)
+
+        h = norm("mlp_norm", x).astype(dtype)
+        wg = self.param("w_gate", init, (E, cfg.ffn_size),
+                        jnp.float32).astype(dtype)
+        wu = self.param("w_up", init, (E, cfg.ffn_size),
+                        jnp.float32).astype(dtype)
+        wd = self.param("w_down", init, (cfg.ffn_size, E),
+                        jnp.float32).astype(dtype)
+        y = (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
+        return x + y.astype(x.dtype)
+
+
+class Llama(nn.Module):
+    """Returns logits (B, S, vocab) in fp32-accumulated compute dtype."""
+
+    cfg: LlamaConfig
+    seq_shard_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, *, positions=None):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        B, S = tokens.shape
+        emb = self.param("tok_embeddings", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = emb[tokens].astype(dtype)
+        if positions is None:
+            positions = jnp.arange(S)
+            if self.seq_shard_axis is not None:
+                # local shard's global positions along the ring
+                positions = positions + jax.lax.axis_index(
+                    self.seq_shard_axis) * S
+        cos, sin = rope_tables(positions, cfg.head_dim, base=cfg.rope_base)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, self.seq_shard_axis, name=f"layer{i}")(
+                x, cos, sin)
+        g = self.param("norm", nn.initializers.ones, (cfg.hidden_size,),
+                       jnp.float32)
+        if not cfg.policy.keep_norms_fp32:
+            g = g.astype(dtype)
+        x = rms_norm(x, g, eps=cfg.norm_eps)
+        head = self.param("output", nn.initializers.normal(0.02),
+                          (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return jnp.matmul(x.astype(dtype), head.astype(dtype),
+                          preferred_element_type=jnp.float32)
+
+
+# regex rules over flattened param paths -> PartitionSpec
+# (pattern: SNIPPETS.md [1] — rules instead of per-layer hand specs)
+_TP_RULES = (
+    (r"tok_embeddings$", P("tp", None)),          # vocab-sharded embedding
+    (r"output$", P(None, "tp")),                   # vocab-sharded lm head
+    (r"w[qkv]$", P(None, "tp")),                   # column-parallel qkv
+    (r"wo$", P("tp", None)),                       # row-parallel out proj
+    (r"w_(gate|up)$", P(None, "tp")),              # column-parallel ffn in
+    (r"w_down$", P("tp", None)),                   # row-parallel ffn out
+    (r".*norm$", P()),                             # replicated norms
+)
+
+
+def param_specs(params, *, rules=_TP_RULES, default=P()):
+    """PartitionSpec tree for a Llama param tree (first matching rule wins).
+
+    ≙ reference ``set_tensor_model_parallel_attributes`` on
+    Column/RowParallelLinear weights — here a spec tree handed to pjit,
+    GSPMD inserts the collectives."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        for pat, spec in rules:
+            if re.search(pat, name):
+                return spec
+        return default
+
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [spec_for(path) for path, _ in flat])
+
+
+def llama_loss_fn(model: Llama):
+    """``loss_fn(params, tokens) -> scalar``: next-token CE via the fused
+    xentropy kernel (fp32, recompute-bwd)."""
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        losses = softmax_cross_entropy_loss(
+            logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
+        return jnp.mean(losses)
+
+    return loss_fn

@@ -1,0 +1,107 @@
+"""ResNet-50 — BASELINE config 3 model ("ResNet-50 ImageNet with
+SyncBatchNorm + DDP allreduce over ICI").
+
+Reference analogue: ``examples/imagenet/main_amp.py`` (torchvision
+resnet50 under amp + apex DDP + ``convert_syncbn_model``) and the fused
+NHWC bottleneck of ``apex/contrib/bottleneck/bottleneck.py``. TPU-first
+choices: NHWC layout throughout (the only layout TPU convs want — the
+reference needed a ``channel_last`` fast path; here it is the default),
+`apex1_tpu.parallel.SyncBatchNorm` for cross-replica statistics (psum
+Welford merge), XLA fuses conv+BN+ReLU chains (the ``groupbn`` /
+``cudnn_gbn`` BN+ReLU fusion is a compiler decision here, not a kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex1_tpu.core.policy import PrecisionPolicy, get_policy
+from apex1_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # resnet-50
+    num_classes: int = 1000
+    width: int = 64
+    # mesh axis for SyncBN cross-replica stats; None = local BN
+    bn_axis_name: Optional[str] = None
+    bn_group_size: Optional[int] = None
+    policy: PrecisionPolicy = dataclasses.field(
+        default_factory=lambda: get_policy("O0"))
+
+    @staticmethod
+    def resnet50(**kw) -> "ResNetConfig":
+        return ResNetConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "ResNetConfig":
+        defaults = dict(stage_sizes=(1, 1), num_classes=10, width=8)
+        defaults.update(kw)
+        return ResNetConfig(**defaults)
+
+
+class Bottleneck(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with identity/projection shortcut —
+    ≙ ``apex/contrib/bottleneck/bottleneck.py :: Bottleneck`` (the fused
+    NHWC block; XLA performs the conv+BN+ReLU fusion)."""
+
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        bn = partial(SyncBatchNorm, axis_name=cfg.bn_axis_name,
+                     group_size=cfg.bn_group_size,
+                     use_running_average=not train, dtype=dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dtype)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y))
+        y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                 name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y))
+        y = conv(4 * self.features, (1, 1), name="conv3")(y)
+        y = bn(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.features, (1, 1),
+                            strides=(self.strides,) * 2,
+                            name="downsample_conv")(residual)
+            residual = bn(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; input (B, H, W, 3). Returns logits (B, classes)."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        cfg = self.cfg
+        dtype = cfg.policy.compute_dtype
+        x = x.astype(dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=dtype, name="stem_conv")(x)
+        x = SyncBatchNorm(axis_name=cfg.bn_axis_name,
+                          group_size=cfg.bn_group_size,
+                          use_running_average=not train, dtype=dtype,
+                          name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(cfg, cfg.width * 2 ** i, strides,
+                               name=f"stage{i}_block{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(cfg.num_classes, dtype=dtype, name="fc")(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,128 @@
+"""Fused dense layers — reference ``apex/fused_dense/fused_dense.py ::
+FusedDense, FusedDenseGeluDense`` (+ ``csrc/fused_dense*.cu``) and
+``apex/mlp/mlp.py :: MLP`` (+ ``csrc/mlp*.cu``).
+
+**Documented "XLA already fuses this" decision (SURVEY.md §7.0):** the
+reference needs cuBLASLt epilogue fusion (``CUBLASLT_EPILOGUE_{BIAS,
+GELU_AUX_BIAS,DGELU_BGRAD}``) and a bespoke GEMM-chain kernel because eager
+torch launches matmul/bias/activation as separate kernels. Under XLA the
+matmul lands on the MXU and the bias/GELU/ReLU epilogues are fused into its
+output stage by the compiler — a hand-written Pallas GEMM would have to beat
+XLA's own matmul emitter to win, and profiling on v5e shows no gap. So these
+are thin modules with the reference's API over ``jnp`` compute, with fp32
+MXU accumulation (``preferred_element_type``) matching the reference's
+fp16-in/fp32-accumulate GEMMs. The backward (dgelu+bgrad, wgrad chain) is
+jax AD, which XLA fuses the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense(x, weight, bias=None):
+    """y = x @ Wᵀ + b. ``weight`` is (out, in) — torch convention, like the
+    reference's ``FusedDenseFunc``."""
+    y = jnp.matmul(x, weight.T, preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)
+
+
+def fused_dense_gelu_dense(x, w1, b1, w2, b2):
+    """Linear+bias+GELU+Linear+bias in one traced region (reference
+    ``FusedDenseGeluDenseFunc``); XLA fuses the epilogues."""
+    h = fused_dense(x, w1, b1)
+    h = jax.nn.gelu(h, approximate=True)
+    return fused_dense(h, w2, b2)
+
+
+class FusedDense(nn.Module):
+    """``apex.fused_dense.FusedDense(in_features, out_features, bias)``."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.lecun_normal(),
+                       (self.out_features, self.in_features), jnp.float32)
+        b = (self.param("bias", nn.initializers.zeros,
+                        (self.out_features,), jnp.float32)
+             if self.bias else None)
+        return fused_dense(x, w.astype(x.dtype),
+                           None if b is None else b.astype(x.dtype))
+
+
+class FusedDenseGeluDense(nn.Module):
+    """``apex.fused_dense.FusedDenseGeluDense(in, intermediate, out)``."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        k = nn.initializers.lecun_normal()
+        w1 = self.param("weight1", k, (self.intermediate_features,
+                                       self.in_features), jnp.float32)
+        w2 = self.param("weight2", k, (self.out_features,
+                                       self.intermediate_features),
+                        jnp.float32)
+        b1 = b2 = None
+        if self.bias:
+            b1 = self.param("bias1", nn.initializers.zeros,
+                            (self.intermediate_features,), jnp.float32)
+            b2 = self.param("bias2", nn.initializers.zeros,
+                            (self.out_features,), jnp.float32)
+        cast = lambda t: None if t is None else t.astype(x.dtype)
+        return fused_dense_gelu_dense(x, cast(w1), cast(b1), cast(w2),
+                                      cast(b2))
+
+
+_ACTIVATIONS: dict[str, Optional[Callable]] = {
+    "none": None,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+class MLP(nn.Module):
+    """``apex.mlp.MLP(mlp_sizes, bias=True, relu=True)`` equivalent.
+
+    A stack of Linear(+bias)(+activation) layers evaluated as one traced
+    region — the reference fuses the chain into one autograd node
+    (``MlpFunction``) over cuBLAS calls; here the whole chain is one XLA
+    fusion domain. ``activation``: "none" | "relu" | "sigmoid" (reference
+    flags). No activation after the final layer, matching the reference.
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        if len(self.mlp_sizes) < 2:
+            raise ValueError("mlp_sizes needs >= 2 entries")
+        act = _ACTIVATIONS[self.activation]
+        k = nn.initializers.lecun_normal()
+        h = x
+        for i, (fan_in, fan_out) in enumerate(
+                zip(self.mlp_sizes[:-1], self.mlp_sizes[1:])):
+            w = self.param(f"weight_{i}", k, (fan_out, fan_in),
+                           jnp.float32)
+            b = (self.param(f"bias_{i}", nn.initializers.zeros,
+                            (fan_out,), jnp.float32)
+                 if self.bias else None)
+            h = fused_dense(h, w.astype(h.dtype),
+                            None if b is None else b.astype(h.dtype))
+            if act is not None and i < len(self.mlp_sizes) - 2:
+                h = act(h)
+        return h

@@ -33,6 +33,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from apex1_tpu.ops._common import NEG_INF
 from apex1_tpu.ops.attention import flash_attention
 
 
@@ -72,7 +73,7 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
         return jax.lax.pcast(x, axis_name, to="varying")  # carry typing)
 
     out0 = _vary(jnp.zeros(q.shape, jnp.promote_types(q.dtype, jnp.float32)))
-    lse0 = _vary(jnp.full((B, Hq, Sq), -1e30, jnp.float32))
+    lse0 = _vary(jnp.full((B, Hq, Sq), NEG_INF, jnp.float32))
 
     def attend(k_cur, v_cur, kseg_cur, t, out, lse):
         src = (idx - t) % n           # who this K/V shard belongs to
@@ -87,7 +88,7 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
 
         def skip(_):
             return (_vary(jnp.zeros(q.shape, q.dtype)),
-                    _vary(jnp.full((B, Hq, Sq), -1e30, jnp.float32)))
+                    _vary(jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)))
 
         if causal:
             # visiting shard strictly in the future → fully masked

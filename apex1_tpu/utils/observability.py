@@ -1,0 +1,134 @@
+"""Tracing / profiling / metrics — SURVEY.md §5.1, §5.5.
+
+Reference: ``apex.pyprof`` monkey-patched every torch callable with
+``torch.cuda.nvtx.range_push(json_args)`` so nsys timelines carry op names,
+and post-processed profiler SQLite into per-kernel FLOPs/bytes
+(``pyprof/prof``). ``apex/transformer`` threads an optional ``timers``
+callable through the pipeline schedules.
+
+TPU-native equivalents:
+- `annotate` — ``jax.named_scope`` + ``jax.profiler.TraceAnnotation``
+  (≙ nvtx ranges; names land in XLA HLO metadata AND the profiler trace).
+- `trace` — context manager around ``jax.profiler.start_trace`` writing a
+  TensorBoard-loadable trace (≙ running under nsys).
+- `cost_analysis` — compile-time FLOPs/bytes attribution from XLA
+  (≙ pyprof/prof's per-kernel FLOP counting, but exact and free).
+- `Timers` — named wall-clock timers with device sync, the
+  ``apex/transformer`` ``timers`` contract.
+- `MetricsLogger` — per-step structured metrics (loss, grad-norm,
+  loss-scale, skip-count, tokens/sec/chip — the BASELINE.json metric).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Name a region for both XLA metadata and profiler timelines."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a TensorBoard profiler trace of the enclosed block."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> dict:
+    """Compile ``fn`` (without running it) and return XLA's cost model:
+    ``{"flops": ..., "bytes accessed": ..., "transcendentals": ...}``."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    return dict(ca) if ca else {}
+
+
+def flops_per_step(fn: Callable, *args, **kwargs) -> float:
+    return float(cost_analysis(fn, *args, **kwargs).get("flops", 0.0))
+
+
+class Timers:
+    """Named cumulative timers (``timers("fwd").start()/.stop()``) — the
+    calling convention ``apex/transformer`` schedules expect. ``stop``
+    blocks on ``sync`` trees so device work is attributed correctly."""
+
+    class _Timer:
+        def __init__(self):
+            self.elapsed_ = 0.0
+            self.count = 0
+            self._t0: Optional[float] = None
+
+        def start(self):
+            self._t0 = time.perf_counter()
+
+        def stop(self, sync: Any = None):
+            if sync is not None:
+                jax.block_until_ready(sync)
+            self.elapsed_ += time.perf_counter() - self._t0
+            self.count += 1
+            self._t0 = None
+
+        def elapsed(self, reset: bool = False) -> float:
+            e = self.elapsed_
+            if reset:
+                self.elapsed_, self.count = 0.0, 0
+            return e
+
+    def __init__(self):
+        self._timers: dict[str, Timers._Timer] = {}
+
+    def __call__(self, name: str) -> "Timers._Timer":
+        return self._timers.setdefault(name, Timers._Timer())
+
+    def log(self, names=None, *, reset: bool = True) -> dict[str, float]:
+        names = list(self._timers) if names is None else names
+        return {n: self._timers[n].elapsed(reset=reset) for n in names
+                if n in self._timers}
+
+
+class MetricsLogger:
+    """Structured per-step metrics with tokens/sec/chip derivation.
+
+    ``log(step, metrics, tokens=...)`` fetches scalars (one small transfer)
+    and emits a JSON line via ``print`` or a supplied writer."""
+
+    def __init__(self, writer: Optional[Callable[[str], None]] = None,
+                 n_chips: Optional[int] = None):
+        self.writer = writer or print
+        self.n_chips = n_chips or jax.device_count()
+        self._last_t: Optional[float] = None
+        self._last_step: Optional[int] = None
+
+    def log(self, step: int, metrics: dict, *, tokens: Optional[int] = None
+            ) -> dict:
+        now = time.perf_counter()
+        rec = {"step": int(step)}
+        for k, v in metrics.items():
+            try:
+                rec[k] = float(np.asarray(jax.device_get(v)))
+            except Exception:
+                continue
+        if tokens is not None and self._last_t is not None:
+            dt = now - self._last_t
+            steps = step - (self._last_step or 0)
+            if dt > 0 and steps > 0:
+                rec["tokens_per_sec_per_chip"] = (
+                    tokens * steps / dt / self.n_chips)
+        self._last_t, self._last_step = now, step
+        self.writer(json.dumps(rec))
+        return rec

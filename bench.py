@@ -50,14 +50,26 @@ def main():
     step = jax.jit(amp.make_train_step(gpt2_loss_fn(model)),
                    donate_argnums=0)
 
+    @jax.jit
+    def _reduce_all(tree):
+        return sum(jnp.sum(leaf.astype(jnp.float32))
+                   for leaf in jax.tree.leaves(tree))
+
+    def sync(tree):
+        """Force completion of the WHOLE step chain: on the axon tunnel
+        backend, block_until_ready on one output does not imply the full
+        program ran — fetch ONE scalar reduced (in a single fused dispatch)
+        over every output leaf."""
+        float(_reduce_all(tree))
+
     for _ in range(warmup):
         state, metrics = step(state, tokens)
-    jax.block_until_ready(metrics)
+    sync((state, metrics))  # also compiles the reduction off the clock
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, tokens)
-    jax.block_until_ready(metrics)
+    sync((state, metrics))
     dt = time.perf_counter() - t0
 
     tokens_per_sec = B * S * iters / dt
