@@ -53,16 +53,22 @@ def restore_checkpoint(path: str | os.PathLike, template: Any = None, *,
     with _checkpointer() as ckptr:
         if template is None:
             return ckptr.restore(path)
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp_shape(x), x.dtype), template)
-        if mesh is not None:
-            specs = (spec_tree if spec_tree is not None
-                     else jax.tree.map(lambda _: PartitionSpec(), abstract))
-            abstract = jax.tree.map(
-                lambda a, s: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-                abstract, specs)
-        return ckptr.restore(path, abstract)
+        return ckptr.restore(path, _abstract(template, mesh, spec_tree))
+
+
+def _abstract(template, mesh, spec_tree):
+    """ShapeDtypeStruct tree for restore; with ``mesh``, each leaf carries
+    a NamedSharding so orbax places shards directly on the target mesh."""
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp_shape(x), x.dtype), template)
+    if mesh is None:
+        return abstract
+    specs = (spec_tree if spec_tree is not None
+             else jax.tree.map(lambda _: PartitionSpec(), abstract))
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
+        abstract, specs)
 
 
 def jnp_shape(x) -> tuple:
@@ -97,17 +103,10 @@ class CheckpointManager:
         step = self.latest() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp_shape(x), x.dtype), template)
-        if mesh is not None:
-            specs = (spec_tree if spec_tree is not None
-                     else jax.tree.map(lambda _: PartitionSpec(), abstract))
-            abstract = jax.tree.map(
-                lambda a, s: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype, sharding=NamedSharding(mesh, s)),
-                abstract, specs)
         return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+            step,
+            args=ocp.args.StandardRestore(_abstract(template, mesh,
+                                                    spec_tree)))
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

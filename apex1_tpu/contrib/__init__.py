@@ -17,8 +17,13 @@ Documented N/A on TPU (SURVEY.md §2.3): ``nccl_allocator`` (NVLS/SHARP),
 (2:4 structured sparsity — no TPU sparse units).
 """
 
+from apex1_tpu.contrib.focal_loss import focal_loss  # noqa: F401
+from apex1_tpu.contrib.group_norm import GroupNorm, group_norm  # noqa: F401
+from apex1_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401
 from apex1_tpu.contrib.multihead_attn import (  # noqa: F401
     EncdecMultiheadAttn, SelfMultiheadAttn)
+from apex1_tpu.contrib.transducer import (  # noqa: F401
+    TransducerJoint, TransducerLoss, transducer_joint, transducer_loss)
 from apex1_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss  # noqa: F401
 from apex1_tpu.ops.attention import fmha  # noqa: F401
 from apex1_tpu.optim.clip_grad import (  # noqa: F401

@@ -1,0 +1,62 @@
+"""Fast NHWC GroupNorm (+ SiLU fusion) — reference
+``apex/contrib/group_norm/group_norm.py :: GroupNorm`` (+ csrc
+``group_norm``, tuned for diffusion-model shapes).
+
+TPU-native: NHWC is already the TPU conv layout; the normalize +
+affine + SiLU chain is one XLA fusion over a two-pass moment reduction.
+``act="silu"`` mirrors the reference's fused-activation flag."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def group_norm(x, num_groups: int, gamma=None, beta=None, *,
+               eps: float = 1e-5, act: Optional[str] = None):
+    """``x``: (..., C) channel-last; stats over (spatial..., C/G)."""
+    C = x.shape[-1]
+    if C % num_groups:
+        raise ValueError(f"channels {C} not divisible by groups "
+                         f"{num_groups}")
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    xg = xf.reshape(x.shape[0], -1, num_groups, C // num_groups)
+    mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=(1, 3), keepdims=True)
+    y = (xg - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(xf.shape)
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    if beta is not None:
+        y = y + beta.astype(jnp.float32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    elif act not in (None, "none"):
+        raise ValueError(f"unsupported act {act!r}")
+    return y.astype(orig_dtype)
+
+
+class GroupNorm(nn.Module):
+    """Module form, ``apex.contrib.group_norm.GroupNorm(num_groups,
+    num_channels, eps, affine, act)``."""
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        gamma = beta = None
+        if self.affine:
+            gamma = self.param("weight", nn.initializers.ones,
+                               (self.num_channels,), jnp.float32)
+            beta = self.param("bias", nn.initializers.zeros,
+                              (self.num_channels,), jnp.float32)
+        return group_norm(x, self.num_groups, gamma, beta, eps=self.eps,
+                          act=self.act)

@@ -1,0 +1,192 @@
+"""Legacy manual mixed-precision API — reference ``apex/fp16_utils/
+{fp16_optimizer,loss_scaler,fp16util}.py`` (the pre-amp surface:
+``FP16_Optimizer``, ``DynamicLossScaler``, ``network_to_half``,
+``master_params_to_model_params``...).
+
+These predate ``apex.amp`` but stayed public; users migrating from the
+reference find the same names here, implemented over the same machinery
+`apex1_tpu.amp` uses (`apex1_tpu.core.loss_scale`,
+`apex1_tpu.core.policy`). In JAX "the model" is a param pytree, so
+module-mutating helpers become pytree casts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex1_tpu.core.loss_scale import (LossScaleState, all_finite,
+                                       make_loss_scale, select_tree)
+
+__all__ = [
+    "tofp16", "network_to_half", "BN_convert_float", "prep_param_lists",
+    "master_params_to_model_params", "model_grads_to_master_grads",
+    "DynamicLossScaler", "LossScaler", "FP16_Optimizer",
+]
+
+
+def tofp16(tree):
+    """≙ ``fp16util.tofp16`` — cast float leaves to fp16 (on TPU prefer
+    bf16 via `network_to_half(dtype=jnp.bfloat16)`)."""
+    return network_to_half(tree, dtype=jnp.float16)
+
+
+def network_to_half(tree, *, dtype=jnp.float16, keep_norms_fp32=False):
+    """≙ ``fp16util.network_to_half``: cast floating leaves. With
+    ``keep_norms_fp32``, leaves whose path mentions norm/bn stay fp32
+    (≙ ``BN_convert_float``'s effect on a converted network)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    treedef = jax.tree_util.tree_structure(tree)
+
+    import re
+    # norm-ish path segments only (bn1, attn_norm, ln2_scale, BatchNorm_0)
+    # — NOT every "bias"/"scale": a Dense bias must go half, or the fp32
+    # add would silently promote the rest of the network
+    norm_pat = re.compile(r"(^|[\[\]'/_.])((layer|batch|group|sync|rms)?"
+                          r"norm|bn|ln)\d*([\[\]'/_.]|$)")
+
+    def cast(path, x):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return x
+        name = jax.tree_util.keystr(path).lower()
+        if keep_norms_fp32 and norm_pat.search(name):
+            return jnp.asarray(x, jnp.float32)
+        return jnp.asarray(x, dtype)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [cast(p, x) for p, x in flat])
+
+
+def BN_convert_float(tree):
+    """≙ ``fp16util.BN_convert_float`` — restore norm/BN leaves to fp32
+    after a wholesale half cast."""
+    return network_to_half(tree, dtype=jnp.float16, keep_norms_fp32=True)
+
+
+def prep_param_lists(params):
+    """≙ ``fp16util.prep_param_lists(model)`` — returns (model_params,
+    master_params): the half-precision view and the fp32 masters."""
+    master = jax.tree.map(
+        lambda x: jnp.asarray(x, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        params)
+    return network_to_half(params), master
+
+
+def master_params_to_model_params(master_params, *, dtype=jnp.float16):
+    """≙ ``fp16util.master_params_to_model_params`` (copy direction
+    master→model; functional, returns the new model params)."""
+    return network_to_half(master_params, dtype=dtype)
+
+
+def model_grads_to_master_grads(model_grads):
+    """≙ ``fp16util.model_grads_to_master_grads`` — upcast to fp32."""
+    return jax.tree.map(
+        lambda g: jnp.asarray(g, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+        model_grads)
+
+
+class DynamicLossScaler:
+    """≙ ``fp16_utils.loss_scaler.DynamicLossScaler`` — stateful facade
+    over the functional `LossScaleState` (scale 2^16 init, ×2 every
+    ``scale_window`` clean steps, ÷2 on overflow)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        from apex1_tpu.core.loss_scale import DynamicLossScale
+        self._impl = make_loss_scale(DynamicLossScale(
+            init_scale=init_scale, growth_factor=scale_factor,
+            growth_interval=scale_window))
+        self.state: LossScaleState = self._impl.init()
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.scale)
+
+    def scale_loss(self, loss):
+        return self._impl.scale(loss, self.state)
+
+    def unscale(self, grads):
+        return self._impl.unscale(grads, self.state)
+
+    def has_overflow(self, grads) -> bool:
+        return not bool(all_finite(grads))
+
+    def update_scale(self, overflow: bool) -> None:
+        self.state = self._impl.adjust(self.state,
+                                       jnp.asarray(not overflow))
+
+
+class LossScaler(DynamicLossScaler):
+    """≙ static ``fp16_utils.loss_scaler.LossScaler``."""
+
+    def __init__(self, scale=1.0):
+        self._impl = make_loss_scale(scale)
+        self.state = self._impl.init()
+
+    def update_scale(self, overflow: bool) -> None:
+        pass  # static
+
+
+@dataclasses.dataclass
+class FP16_Optimizer:
+    """≙ ``fp16_utils.fp16_optimizer.FP16_Optimizer`` — wraps any optax
+    transform with fp32 master weights + loss scaling, driven manually:
+
+        opt = FP16_Optimizer(optax.sgd(0.1), dynamic_loss_scale=True)
+        state = opt.init(half_params)
+        loss, half_params, state = opt.step(loss_fn, state, batch)
+
+    The train-loop shape (``backward(loss)`` then ``step()``) collapses
+    into one functional ``step`` because grad+update are one traced
+    program in JAX. Skips the update on overflow (reference semantics).
+    """
+
+    optimizer: optax.GradientTransformation
+    static_loss_scale: float = 1.0
+    dynamic_loss_scale: bool = False
+    compute_dtype: Any = jnp.float16
+
+    def __post_init__(self):
+        self._scaler = make_loss_scale(
+            "dynamic" if self.dynamic_loss_scale else self.static_loss_scale)
+
+    def init(self, params):
+        master = jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.float32)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            params)
+        return {"master": master,
+                "opt": self.optimizer.init(master),
+                "scale": self._scaler.init()}
+
+    def step(self, loss_fn: Callable, state, *batch):
+        scaler = self._scaler
+
+        def scaled(master):
+            model = master_params_to_model_params(
+                master, dtype=self.compute_dtype)
+            loss = loss_fn(model, *batch)
+            return scaler.scale(loss.astype(jnp.float32),
+                                state["scale"]), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(state["master"])
+        grads = scaler.unscale(model_grads_to_master_grads(grads),
+                               state["scale"])
+        finite = all_finite(grads)
+        updates, new_opt = self.optimizer.update(grads, state["opt"],
+                                                 state["master"])
+        new_master = optax.apply_updates(state["master"], updates)
+        new_state = {
+            "master": select_tree(finite, new_master, state["master"]),
+            "opt": select_tree(finite, new_opt, state["opt"]),
+            "scale": scaler.adjust(state["scale"], finite),
+        }
+        model = master_params_to_model_params(new_state["master"],
+                                              dtype=self.compute_dtype)
+        return loss, model, new_state

@@ -1,0 +1,74 @@
+"""Halo exchange — spatial parallelism for convolutions.
+
+Reference: ``apex/contrib/bottleneck/halo_exchangers.py ::
+HaloExchangerPeer / HaloExchangerNccl`` (+ csrc ``peer_memory``,
+``nccl_p2p``): a conv layer's activations are split across GPUs along H;
+each step pushes boundary rows ("halos") to spatial neighbors via CUDA IPC
+peer copies or raw ncclSend/Recv.
+
+TPU-native: one ``jax.lax.ppermute`` per direction over the mesh axis —
+the ICI neighbor transfer IS the halo push, no peer-memory pool or p2p
+plumbing to manage (SURVEY.md §2.6 "Spatial parallelism"). Non-periodic
+boundaries zero-fill (conv SAME-padding semantics at the global edge).
+
+``halo_exchange`` returns the local shard extended with its neighbors'
+boundary slices; `spatial_conv2d` shows the full pattern: exchange →
+conv 'VALID' on the extended shard ≙ global conv 'SAME' on the unsplit
+tensor (asserted in tests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def halo_exchange(x, axis_name: str, *, halo: int, dim: int = 1,
+                  periodic: bool = False):
+    """Extend local shard ``x`` with ``halo`` boundary slices from both
+    spatial neighbors along sharded dimension ``dim``."""
+    if halo <= 0:
+        return x
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def take(arr, lo, hi):
+        sl = [slice(None)] * arr.ndim
+        sl[dim] = slice(lo, hi)
+        return arr[tuple(sl)]
+
+    size = x.shape[dim]
+    if halo > size:
+        raise ValueError(f"halo {halo} exceeds local extent {size}")
+    # my top rows go to the previous rank (they become its bottom halo)
+    fwd = [(i, (i + 1) % n) for i in range(n)]   # send downward
+    bwd = [(i, (i - 1) % n) for i in range(n)]   # send upward
+    from_prev = jax.lax.ppermute(take(x, size - halo, size), axis_name, fwd)
+    from_next = jax.lax.ppermute(take(x, 0, halo), axis_name, bwd)
+    if not periodic:
+        zero = jnp.zeros_like(from_prev)
+        from_prev = jnp.where(idx == 0, zero, from_prev)
+        from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
+                              from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+def spatial_conv2d(x, kernel, axis_name: str, *, dim: int = 1):
+    """SAME-padded NHWC conv over a spatially-sharded activation: halo
+    exchange on the sharded axis (``dim``: 1 = H-split, 2 = W-split), then
+    a conv that is VALID on the sharded axis and SAME-padded on the other
+    — ≙ the reference's ``SpatialBottleneck`` conv split
+    (``apex/contrib/bottleneck/bottleneck.py :: SpatialBottleneck``)."""
+    if dim not in (1, 2):
+        raise ValueError("dim must be 1 (H-sharded) or 2 (W-sharded)")
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    if kh % 2 == 0 or kw % 2 == 0:
+        raise ValueError("odd kernel sizes only")
+    halo = (kh if dim == 1 else kw) // 2
+    other_pad = (kw if dim == 1 else kh) // 2
+    ext = halo_exchange(x, axis_name, halo=halo, dim=dim)
+    padding = (((0, 0), (other_pad, other_pad)) if dim == 1
+               else ((other_pad, other_pad), (0, 0)))
+    return jax.lax.conv_general_dilated(
+        ext, kernel, window_strides=(1, 1), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))

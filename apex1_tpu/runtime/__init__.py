@@ -1,0 +1,249 @@
+"""Host-side native runtime — ctypes bindings over ``_runtime.cpp``.
+
+Reference: ``csrc/flatten_unflatten.cpp :: flatten/unflatten`` (the
+``apex_C`` extension backing DDP bucket flattening) and
+``examples/imagenet/main_amp.py :: data_prefetcher`` (side-stream input
+normalization + prefetch). See `_runtime.cpp` for the TPU-native design
+rationale. The library is compiled on first import with ``g++ -O3``;
+every entry point has a NumPy fallback so the package works without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_runtime.cpp")
+_LIB_PATH = os.path.join(_DIR, "_runtime.so")
+_N_THREADS = max(1, (os.cpu_count() or 4) // 2)
+
+
+def _build_library() -> Optional[str]:
+    if os.path.exists(_LIB_PATH) and (os.path.getmtime(_LIB_PATH)
+                                      >= os.path.getmtime(_SRC)):
+        return _LIB_PATH
+    tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"  # per-pid: concurrent imports
+    try:                                    # must not interleave writes
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             "-pthread", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)          # atomic publish
+        return _LIB_PATH
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    path = _build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        i64, vp = ctypes.c_int64, ctypes.c_void_p
+        lib.apex1_flatten.argtypes = [ctypes.POINTER(vp),
+                                      ctypes.POINTER(i64), i64, vp,
+                                      ctypes.c_int]
+        lib.apex1_unflatten.argtypes = [vp, ctypes.POINTER(i64), i64,
+                                        ctypes.POINTER(vp), ctypes.c_int]
+        lib.apex1_normalize_u8_f32.argtypes = [
+            vp, vp, i64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), i64, ctypes.c_int]
+        lib.apex1_f32_to_bf16.argtypes = [vp, vp, i64, ctypes.c_int]
+        lib.apex1_bf16_to_f32.argtypes = [vp, vp, i64, ctypes.c_int]
+        lib.apex1_runtime_abi_version.restype = ctypes.c_int
+        if lib.apex1_runtime_abi_version() != 1:
+            return None
+        return lib
+    except OSError:
+        return None
+
+
+_LIB = _load()
+
+
+def native_available() -> bool:
+    return _LIB is not None
+
+
+def _as_contig(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a)
+
+
+def flatten(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Pack arrays into one contiguous byte buffer (``apex_C.flatten``).
+    Returns a uint8 view; pair with `unflatten` + the original specs."""
+    arrays = [_as_contig(np.asarray(a)) for a in arrays]
+    sizes = [a.nbytes for a in arrays]
+    out = np.empty(sum(sizes), np.uint8)
+    if _LIB is not None and arrays:
+        n = len(arrays)
+        srcs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data for a in arrays])
+        csizes = (ctypes.c_int64 * n)(*sizes)
+        _LIB.apex1_flatten(srcs, csizes, n, out.ctypes.data, _N_THREADS)
+    else:
+        off = 0
+        for a, s in zip(arrays, sizes):
+            out[off:off + s] = a.view(np.uint8).reshape(-1)
+            off += s
+    return out
+
+
+def unflatten(flat: np.ndarray,
+              specs: Sequence[tuple[tuple[int, ...], np.dtype]]
+              ) -> list[np.ndarray]:
+    """Inverse of `flatten`: ``specs`` is [(shape, dtype), ...]
+    (``apex_C.unflatten``)."""
+    flat = _as_contig(np.asarray(flat)).view(np.uint8)
+    outs = [np.empty(shape, dtype) for shape, dtype in specs]
+    sizes = [o.nbytes for o in outs]
+    if sum(sizes) != flat.nbytes:
+        raise ValueError(f"flat buffer holds {flat.nbytes} bytes, specs "
+                         f"need {sum(sizes)}")
+    if _LIB is not None and outs:
+        n = len(outs)
+        dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+        csizes = (ctypes.c_int64 * n)(*sizes)
+        _LIB.apex1_unflatten(flat.ctypes.data, csizes, n, dsts, _N_THREADS)
+    else:
+        off = 0
+        for o, s in zip(outs, sizes):
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + s]
+            off += s
+    return outs
+
+
+def normalize_images(batch_u8: np.ndarray, mean: Sequence[float],
+                     std: Sequence[float]) -> np.ndarray:
+    """uint8 NHWC -> fp32 ``(x/255 - mean) / std`` per channel — the
+    reference prefetcher's side-stream normalize, on host threads."""
+    batch_u8 = _as_contig(np.asarray(batch_u8, np.uint8))
+    c = batch_u8.shape[-1]
+    if len(mean) != c or len(std) != c:
+        raise ValueError(f"mean/std length must equal channels ({c})")
+    out = np.empty(batch_u8.shape, np.float32)
+    if _LIB is not None:
+        fmean = (ctypes.c_float * c)(*[float(m) for m in mean])
+        fstd = (ctypes.c_float * c)(*[float(s) for s in std])
+        _LIB.apex1_normalize_u8_f32(batch_u8.ctypes.data, out.ctypes.data,
+                                    batch_u8.size, fmean, fstd, c,
+                                    _N_THREADS)
+    else:
+        out[:] = (batch_u8.astype(np.float32) / 255.0
+                  - np.asarray(mean, np.float32)) / np.asarray(
+                      std, np.float32)
+    return out
+
+
+def f32_to_bf16_bits(x: np.ndarray) -> np.ndarray:
+    """fp32 -> bf16 bit patterns (uint16), round-to-nearest-even — host
+    staging for bf16 comm/checkpoint buffers."""
+    x = _as_contig(np.asarray(x, np.float32))
+    out = np.empty(x.shape, np.uint16)
+    if _LIB is not None:
+        _LIB.apex1_f32_to_bf16(x.ctypes.data, out.ctypes.data, x.size,
+                               _N_THREADS)
+    else:
+        bits = x.view(np.uint32)
+        rounding = 0x7FFF + ((bits >> 16) & 1)
+        rounded = ((bits + rounding) >> 16).astype(np.uint16)
+        # NaN: carry out of the mantissa would corrupt to ±0 — quiet it
+        nan = (bits & 0x7FFFFFFF) > 0x7F800000
+        out[:] = np.where(nan, ((bits >> 16) | 0x0040).astype(np.uint16),
+                          rounded)
+    return out
+
+
+def bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
+    bits = _as_contig(np.asarray(bits, np.uint16))
+    out = np.empty(bits.shape, np.float32)
+    if _LIB is not None:
+        _LIB.apex1_bf16_to_f32(bits.ctypes.data, out.ctypes.data,
+                               bits.size, _N_THREADS)
+    else:
+        out.view(np.uint32)[:] = bits.astype(np.uint32) << 16
+    return out
+
+
+class PrefetchLoader:
+    """Background-thread prefetcher — ``examples/imagenet ::
+    data_prefetcher`` equivalent. Pulls batches from ``source`` on a worker
+    thread, runs ``transform`` (e.g. `normalize_images` or `flatten`) off
+    the critical path, and optionally ``device_put``s ahead so
+    host→device transfer overlaps the current step (the reference's CUDA
+    side-stream overlap, via JAX async dispatch)."""
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable, *,
+                 transform: Optional[Callable] = None,
+                 device_put: bool = True, prefetch: int = 2):
+        self.source = source
+        self.transform = transform
+        self.device_put = device_put
+        self.prefetch = max(1, prefetch)
+
+    def __iter__(self) -> Iterator:
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        err: list[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def work():
+            try:
+                import jax
+                for batch in self.source:
+                    if stop.is_set():
+                        return
+                    if self.transform is not None:
+                        batch = self.transform(batch)
+                    if self.device_put:
+                        batch = jax.tree.map(jax.device_put, batch)
+                    if not put(batch):
+                        return
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                put(self._DONE)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # consumer stopped early (break/exception): unblock the worker
+            # so it exits instead of pinning the source + buffered batches
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)

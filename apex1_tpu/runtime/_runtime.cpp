@@ -1,0 +1,134 @@
+// apex1_tpu host runtime — native byte-moving for the data path.
+//
+// Reference capabilities covered (TPU-native redesign, not a port):
+// - csrc/flatten_unflatten.cpp :: flatten/unflatten ("apex_C"): the
+//   reference flattens gradient buckets for NCCL; on TPU gradient
+//   bucketing is XLA's job, but HOST-side flattening is still the right
+//   tool for the input pipeline — pack a batch of samples into ONE
+//   contiguous staging buffer so each step issues a single host->device
+//   transfer (the tunnel/PCIe hop amortizes much better than per-array
+//   puts). Multi-threaded memcpy saturates host memory bandwidth.
+// - examples/imagenet/main_amp.py :: data_prefetcher: the reference
+//   normalizes uint8 NHWC images to fp32 on a CUDA side stream; here the
+//   normalize (u8 -> f32, per-channel mean/std) runs in native threads on
+//   the host, overlapped with device compute by the Python PrefetchLoader.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(i) for i in [0, n) across up to `threads` hardware threads.
+template <typename F>
+void parallel_for(int64_t n, int threads, F fn) {
+  if (n <= 0) return;
+  int tn = std::min<int64_t>(threads, n);
+  if (tn <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(tn);
+  for (int t = 0; t < tn; ++t) {
+    pool.emplace_back([=] {
+      for (int64_t i = t; i < n; i += tn) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack n_src source buffers (sizes in bytes) back-to-back into dst.
+// Offsets are the exclusive prefix sum of sizes; dst must hold sum(sizes).
+void apex1_flatten(const void** srcs, const int64_t* sizes, int64_t n_src,
+                   void* dst, int threads) {
+  std::vector<int64_t> offs(n_src);
+  int64_t acc = 0;
+  for (int64_t i = 0; i < n_src; ++i) { offs[i] = acc; acc += sizes[i]; }
+  parallel_for(n_src, threads, [&](int64_t i) {
+    std::memcpy(static_cast<char*>(dst) + offs[i], srcs[i],
+                static_cast<size_t>(sizes[i]));
+  });
+}
+
+// Inverse: split src into n_dst buffers of the given sizes.
+void apex1_unflatten(const void* src, const int64_t* sizes, int64_t n_dst,
+                     void** dsts, int threads) {
+  std::vector<int64_t> offs(n_dst);
+  int64_t acc = 0;
+  for (int64_t i = 0; i < n_dst; ++i) { offs[i] = acc; acc += sizes[i]; }
+  parallel_for(n_dst, threads, [&](int64_t i) {
+    std::memcpy(dsts[i], static_cast<const char*>(src) + offs[i],
+                static_cast<size_t>(sizes[i]));
+  });
+}
+
+// uint8 NHWC image batch -> float32, (x/255 - mean[c]) / std[c].
+// n = total elements; c = channel count (innermost dim).
+void apex1_normalize_u8_f32(const uint8_t* src, float* dst, int64_t n,
+                            const float* mean, const float* stddev,
+                            int64_t c, int threads) {
+  // precompute per-channel scale/bias: y = x * (1/(255*std)) - mean/std
+  std::vector<float> scale(c), bias(c);
+  for (int64_t ch = 0; ch < c; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stddev[ch]);
+    bias[ch] = -mean[ch] / stddev[ch];
+  }
+  const int64_t kChunk = 1 << 16;
+  int64_t n_chunks = (n + kChunk - 1) / kChunk;
+  parallel_for(n_chunks, threads, [&](int64_t chunk) {
+    int64_t lo = chunk * kChunk, hi = std::min(n, lo + kChunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t ch = i % c;
+      dst[i] = static_cast<float>(src[i]) * scale[ch] + bias[ch];
+    }
+  });
+}
+
+// bf16 (as uint16 bit patterns) <-> f32 host conversion for staging
+// checkpoint/comm buffers without a device round-trip.
+void apex1_f32_to_bf16(const float* src, uint16_t* dst, int64_t n,
+                       int threads) {
+  const int64_t kChunk = 1 << 16;
+  int64_t n_chunks = (n + kChunk - 1) / kChunk;
+  parallel_for(n_chunks, threads, [&](int64_t chunk) {
+    int64_t lo = chunk * kChunk, hi = std::min(n, lo + kChunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      uint32_t bits;
+      std::memcpy(&bits, &src[i], 4);
+      if ((bits & 0x7FFFFFFFu) > 0x7F800000u) {
+        // NaN: rounding could carry out of the mantissa (e.g. 0x7FFFFFFF
+        // -> -0.0); keep a quiet NaN with the top payload bits instead
+        dst[i] = static_cast<uint16_t>((bits >> 16) | 0x0040u);
+        continue;
+      }
+      // round-to-nearest-even on the dropped 16 bits
+      uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+      dst[i] = static_cast<uint16_t>((bits + rounding) >> 16);
+    }
+  });
+}
+
+void apex1_bf16_to_f32(const uint16_t* src, float* dst, int64_t n,
+                       int threads) {
+  const int64_t kChunk = 1 << 16;
+  int64_t n_chunks = (n + kChunk - 1) / kChunk;
+  parallel_for(n_chunks, threads, [&](int64_t chunk) {
+    int64_t lo = chunk * kChunk, hi = std::min(n, lo + kChunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      uint32_t bits = static_cast<uint32_t>(src[i]) << 16;
+      std::memcpy(&dst[i], &bits, 4);
+    }
+  });
+}
+
+int apex1_runtime_abi_version() { return 1; }
+
+}  // extern "C"

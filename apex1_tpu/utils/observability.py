@@ -119,10 +119,14 @@ class MetricsLogger:
         now = time.perf_counter()
         rec = {"step": int(step)}
         for k, v in metrics.items():
-            try:
-                rec[k] = float(np.asarray(jax.device_get(v)))
-            except Exception:
+            if isinstance(v, (str, bool)):
+                rec[k] = v
                 continue
+            arr = np.asarray(jax.device_get(v))
+            if arr.size == 1:
+                rec[k] = float(arr)
+            else:  # vectors go in whole — never silently dropped
+                rec[k] = arr.tolist()
         if tokens is not None and self._last_t is not None:
             dt = now - self._last_t
             steps = step - (self._last_step or 0)

@@ -1,0 +1,235 @@
+"""Contrib long-tail tests — reference analogues:
+``apex/contrib/test/{focal_loss,index_mul_2d,transducer,group_norm}`` +
+``tests/L0/run_fp16util`` + halo-exchange parity (spatial parallelism,
+``apex/contrib/test/bottleneck``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu import fp16_utils
+from apex1_tpu.contrib import (GroupNorm, TransducerJoint, TransducerLoss,
+                               focal_loss, group_norm, index_mul_2d,
+                               transducer_joint, transducer_loss)
+from apex1_tpu.core.mesh import make_mesh
+from apex1_tpu.parallel.halo import halo_exchange, spatial_conv2d
+
+
+class TestFocalLoss:
+    def test_matches_numpy_gold(self, rng):
+        logits = jnp.asarray(rng.normal(size=(16, 5)), jnp.float32)
+        targets = jnp.asarray(rng.integers(0, 5, (16,)), jnp.int32)
+        got = focal_loss(logits, targets, alpha=0.25, gamma=2.0)
+        x = np.asarray(logits)
+        t = np.eye(5)[np.asarray(targets)]
+        p = 1 / (1 + np.exp(-x))
+        loss = (t * 0.25 * (1 - p) ** 2 * -np.log(p)
+                + (1 - t) * 0.75 * p ** 2 * -np.log(1 - p))
+        np.testing.assert_allclose(float(got), loss.sum(), rtol=1e-5)
+
+    def test_grads_and_smoothing(self, rng):
+        logits = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        targets = jnp.asarray(rng.integers(0, 4, (8,)), jnp.int32)
+        g = jax.grad(lambda l: focal_loss(l, targets,
+                                          label_smoothing=0.1))(logits)
+        assert np.all(np.isfinite(g))
+
+
+class TestIndexMul2d:
+    def test_forward_and_grads(self, rng):
+        in1 = jnp.asarray(rng.normal(size=(10, 8)), jnp.float32)
+        in2 = jnp.asarray(rng.normal(size=(6, 8)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, 10, (6,)), jnp.int32)
+        out = index_mul_2d(in1, in2, idx)
+        np.testing.assert_allclose(out, np.asarray(in1)[np.asarray(idx)]
+                                   * np.asarray(in2), rtol=1e-6)
+        # d_in1 is a scatter-add over repeated indices
+        d1 = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+        want = np.zeros_like(np.asarray(in1))
+        np.add.at(want, np.asarray(idx), np.asarray(in2))
+        np.testing.assert_allclose(d1, want, rtol=1e-6)
+
+
+class TestGroupNorm:
+    def test_matches_numpy_gold(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 4, 4, 8)), jnp.float32)
+        got = group_norm(x, num_groups=2)
+        xn = np.asarray(x).reshape(2, 16, 2, 4)
+        mean = xn.mean(axis=(1, 3), keepdims=True)
+        var = xn.var(axis=(1, 3), keepdims=True)
+        want = ((xn - mean) / np.sqrt(var + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_module_affine_silu(self, rng):
+        x = jnp.asarray(rng.normal(size=(2, 4, 4, 8)), jnp.float32)
+        m = GroupNorm(num_groups=4, num_channels=8, act="silu")
+        p = m.init(jax.random.key(0), x)["params"]
+        out = m.apply({"params": p}, x)
+        base = group_norm(x, 4, p["weight"], p["bias"])
+        np.testing.assert_allclose(
+            out, np.asarray(base) / (1 + np.exp(-np.asarray(base))),
+            rtol=1e-5, atol=1e-6)
+
+
+def _brute_force_rnnt(lp, targets, blank):
+    """O(T·U) reference DP in numpy (log domain)."""
+    T, U, V = lp.shape
+    alpha = np.full((T, U), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U):
+            terms = []
+            if t == 0 and u == 0:
+                continue
+            if t > 0:
+                terms.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                terms.append(alpha[t, u - 1] + lp[t, u - 1,
+                                                  targets[u - 1]])
+            alpha[t, u] = np.logaddexp.reduce(terms)
+    return -(alpha[T - 1, U - 1] + lp[T - 1, U - 1, blank])
+
+
+class TestTransducer:
+    def test_joint_shapes_and_relu(self, rng):
+        f = jnp.asarray(rng.normal(size=(2, 5, 8)), jnp.float32)
+        g = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+        out = transducer_joint(f, g, relu=True)
+        assert out.shape == (2, 5, 3, 8)
+        assert float(jnp.min(out)) >= 0
+        joint = TransducerJoint(relu=True)
+        np.testing.assert_allclose(joint(f, g), out)
+
+    def test_loss_matches_brute_force(self, rng):
+        B, T, U, V = 3, 6, 4, 7
+        logits = jnp.asarray(rng.normal(size=(B, T, U, V)), jnp.float32)
+        targets = jnp.asarray(rng.integers(1, V, (B, U - 1)), jnp.int32)
+        f_len = jnp.asarray([T, T, T], jnp.int32)
+        y_len = jnp.asarray([U - 1] * B, jnp.int32)
+        got = transducer_loss(logits, targets, f_len, y_len,
+                              blank_idx=0, reduction="none")
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        for b in range(B):
+            want = _brute_force_rnnt(np.asarray(lp[b]),
+                                     np.asarray(targets[b]), 0)
+            np.testing.assert_allclose(float(got[b]), want, rtol=1e-4)
+
+    def test_varlen_matches_truncated(self, rng):
+        B, T, U, V = 1, 8, 5, 6
+        logits = jnp.asarray(rng.normal(size=(B, T, U, V)), jnp.float32)
+        targets = jnp.asarray(rng.integers(1, V, (B, U - 1)), jnp.int32)
+        t_v, u_v = 6, 3
+        got = transducer_loss(logits, targets,
+                              jnp.asarray([t_v]), jnp.asarray([u_v]),
+                              reduction="none")
+        trunc = transducer_loss(
+            logits[:, :t_v, :u_v + 1], targets[:, :u_v],
+            jnp.asarray([t_v]), jnp.asarray([u_v]), reduction="none")
+        np.testing.assert_allclose(float(got[0]), float(trunc[0]),
+                                   rtol=1e-4)
+
+    def test_loss_grads_finite(self, rng):
+        B, T, U, V = 2, 5, 4, 6
+        logits = jnp.asarray(rng.normal(size=(B, T, U, V)), jnp.float32)
+        targets = jnp.asarray(rng.integers(1, V, (B, U - 1)), jnp.int32)
+        crit = TransducerLoss()
+        g = jax.grad(lambda l: crit(l, targets, jnp.asarray([T] * B),
+                                    jnp.asarray([U - 1] * B)))(logits)
+        assert np.all(np.isfinite(g))
+
+
+class TestFp16Utils:
+    def test_network_to_half_and_back(self, rng):
+        params = {"dense": {"kernel": jnp.ones((4, 4))},
+                  "ln_scale": jnp.ones((4,)),
+                  "step": jnp.int32(3)}
+        half = fp16_utils.network_to_half(params)
+        assert half["dense"]["kernel"].dtype == jnp.float16
+        assert half["step"].dtype == jnp.int32
+        keep = fp16_utils.BN_convert_float(params)
+        assert keep["ln_scale"].dtype == jnp.float32
+        model, master = fp16_utils.prep_param_lists(params)
+        assert master["dense"]["kernel"].dtype == jnp.float32
+
+    def test_fp16_optimizer_trains_and_skips(self, rng):
+        opt = fp16_utils.FP16_Optimizer(optax.sgd(0.1),
+                                        static_loss_scale=128.0)
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        state = opt.init(params)
+
+        def loss_fn(p, x):
+            return jnp.sum(jnp.square(p["w"].astype(jnp.float32))) * x
+
+        loss, model, state = opt.step(loss_fn, state, jnp.float32(1.0))
+        assert float(jnp.sum(state["master"]["w"])) < 4.0
+        w_before = state["master"]["w"]
+        loss, model, state = opt.step(loss_fn, state, jnp.float32(1e38))
+        np.testing.assert_array_equal(state["master"]["w"], w_before)
+
+    def test_dynamic_loss_scaler_facade(self):
+        s = fp16_utils.DynamicLossScaler(init_scale=16.0)
+        assert s.loss_scale == 16.0
+        s.update_scale(overflow=True)
+        assert s.loss_scale == 8.0
+        s2 = fp16_utils.LossScaler(scale=4.0)
+        s2.update_scale(overflow=True)
+        assert s2.loss_scale == 4.0
+
+
+class TestHaloExchange:
+    def test_matches_global_conv(self, rng, devices):
+        mesh = make_mesh(pp=1, dp=1, cp=4, devices=devices[:4])
+        x = jnp.asarray(rng.normal(size=(2, 16, 8, 3)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(3, 3, 3, 5)), jnp.float32)
+
+        fn = jax.jit(jax.shard_map(
+            lambda x: spatial_conv2d(x, k, "cp", dim=1),
+            mesh=mesh, in_specs=P(None, "cp"), out_specs=P(None, "cp")))
+        got = fn(x)
+        want = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO",
+                                                     "NHWC"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_halo_shapes_periodic(self, rng, devices):
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        x = jnp.asarray(rng.normal(size=(1, 8, 4, 2)), jnp.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda x: halo_exchange(x, "cp", halo=1, dim=1, periodic=True),
+            mesh=mesh, in_specs=P(None, "cp"), out_specs=P(None, "cp")))
+        out = fn(x)
+        assert out.shape == (1, 8 + 2 * 4, 4, 2)  # +2 halo rows per shard
+
+
+def test_network_to_half_dense_bias_goes_half():
+    """BN_convert_float must NOT keep plain Dense biases fp32 (only
+    norm/BN params) — a fp32 bias would promote the whole network."""
+    params = {"dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+              "logit_scale": jnp.ones(()),
+              "bn1": {"scale": jnp.ones((2,)), "bias": jnp.ones((2,))},
+              "attn_norm": jnp.ones((2,)),
+              "ln2_scale": jnp.ones((2,))}
+    out = fp16_utils.BN_convert_float(params)
+    assert out["dense"]["bias"].dtype == jnp.float16
+    assert out["logit_scale"].dtype == jnp.float16
+    assert out["bn1"]["scale"].dtype == jnp.float32
+    assert out["attn_norm"].dtype == jnp.float32
+    assert out["ln2_scale"].dtype == jnp.float32
+
+
+def test_spatial_conv2d_w_sharded(rng, devices):
+    mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+    x = jnp.asarray(rng.normal(size=(2, 8, 16, 3)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(3, 3, 3, 5)), jnp.float32)
+    fn = jax.jit(jax.shard_map(
+        lambda x: spatial_conv2d(x, k, "cp", dim=2),
+        mesh=mesh, in_specs=P(None, None, "cp"),
+        out_specs=P(None, None, "cp")))
+    got = fn(x)
+    want = jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

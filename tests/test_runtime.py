@@ -1,0 +1,130 @@
+"""C++ host runtime tests — flatten/unflatten parity (≙ tests around
+``apex_C`` in ``tests/L0/run_fp16util``), normalize parity vs numpy, bf16
+round-trip vs jax, PrefetchLoader ordering/overlap, numpy fallback."""
+
+import numpy as np
+import pytest
+
+import apex1_tpu.runtime as rt
+
+
+def test_native_library_builds():
+    assert rt.native_available(), "g++ build of _runtime.cpp failed"
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    arrays = [rng.normal(size=(4, 5)).astype(np.float32),
+              rng.integers(0, 100, (7,)).astype(np.int32),
+              rng.normal(size=(2, 3, 8)).astype(np.float64),
+              np.asarray(3.5, np.float32)]
+    flat = rt.flatten(arrays)
+    assert flat.dtype == np.uint8
+    assert flat.nbytes == sum(a.nbytes for a in arrays)
+    outs = rt.unflatten(flat, [(a.shape, a.dtype) for a in arrays])
+    for a, b in zip(arrays, outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unflatten_size_mismatch(rng):
+    flat = rt.flatten([np.zeros((4,), np.float32)])
+    with pytest.raises(ValueError):
+        rt.unflatten(flat, [((5,), np.float32)])
+
+
+def test_normalize_matches_numpy(rng):
+    imgs = rng.integers(0, 256, (4, 16, 16, 3)).astype(np.uint8)
+    mean, std = (0.485, 0.456, 0.406), (0.229, 0.224, 0.225)
+    got = rt.normalize_images(imgs, mean, std)
+    want = ((imgs.astype(np.float32) / 255.0
+             - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_bf16_bits_match_jax(rng):
+    import jax.numpy as jnp
+    x = rng.normal(size=(1024,)).astype(np.float32) * 100
+    bits = rt.f32_to_bf16_bits(x)
+    want = np.asarray(jnp.asarray(x).astype(jnp.bfloat16)).view(np.uint16)
+    np.testing.assert_array_equal(bits, want)
+    back = rt.bf16_bits_to_f32(bits)
+    np.testing.assert_array_equal(
+        back, np.asarray(jnp.asarray(x).astype(jnp.bfloat16),
+                         dtype=np.float32))
+
+
+def test_numpy_fallback_paths(rng, monkeypatch):
+    monkeypatch.setattr(rt, "_LIB", None)
+    arrays = [rng.normal(size=(3, 3)).astype(np.float32),
+              rng.integers(0, 9, (4,)).astype(np.int64)]
+    outs = rt.unflatten(rt.flatten(arrays),
+                        [(a.shape, a.dtype) for a in arrays])
+    for a, b in zip(arrays, outs):
+        np.testing.assert_array_equal(a, b)
+    imgs = rng.integers(0, 256, (2, 4, 4, 3)).astype(np.uint8)
+    got = rt.normalize_images(imgs, (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+    assert got.dtype == np.float32
+    x = rng.normal(size=(64,)).astype(np.float32)
+    np.testing.assert_array_equal(rt.f32_to_bf16_bits(x),
+                                  rt.f32_to_bf16_bits(x))
+
+
+def test_prefetch_loader_order_and_transform(rng):
+    batches = [rng.normal(size=(4, 4)).astype(np.float32)
+               for _ in range(5)]
+    loader = rt.PrefetchLoader(batches, transform=lambda b: b * 2,
+                               device_put=False)
+    got = list(loader)
+    assert len(got) == 5
+    for src, out in zip(batches, got):
+        np.testing.assert_allclose(out, src * 2)
+
+
+def test_prefetch_loader_device_put(rng):
+    import jax
+    batches = [{"x": rng.normal(size=(2, 2)).astype(np.float32)}
+               for _ in range(3)]
+    got = list(rt.PrefetchLoader(batches, prefetch=2))
+    assert len(got) == 3
+    assert isinstance(got[0]["x"], jax.Array)
+
+
+def test_prefetch_loader_propagates_errors():
+    def gen():
+        yield np.zeros((2,))
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(rt.PrefetchLoader(gen(), device_put=False))
+
+
+def test_bf16_nan_preserved(rng):
+    import jax.numpy as jnp
+    x = np.array([np.nan, -np.nan, np.inf, -np.inf, 1.5], np.float32)
+    x[0] = np.frombuffer(np.uint32(0x7FFFFFFF).tobytes(), np.float32)[0]
+    bits = rt.f32_to_bf16_bits(x)
+    back = rt.bf16_bits_to_f32(bits)
+    assert np.isnan(back[0]) and np.isnan(back[1])
+    assert np.isposinf(back[2]) and np.isneginf(back[3])
+    assert back[4] == 1.5
+
+
+def test_bf16_nan_preserved_fallback(rng, monkeypatch):
+    monkeypatch.setattr(rt, "_LIB", None)
+    x = np.array([np.nan, 2.0], np.float32)
+    x[0] = np.frombuffer(np.uint32(0x7FFFFFFF).tobytes(), np.float32)[0]
+    back = rt.bf16_bits_to_f32(rt.f32_to_bf16_bits(x))
+    assert np.isnan(back[0]) and back[1] == 2.0
+
+
+def test_prefetch_loader_early_stop_no_leak(rng):
+    import threading
+    n_before = threading.active_count()
+    src = (np.zeros((2,)) for _ in range(100))
+    for batch in rt.PrefetchLoader(src, device_put=False, prefetch=1):
+        break  # early exit must unblock + reap the worker
+    import time
+    deadline = time.time() + 5
+    while threading.active_count() > n_before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= n_before
