@@ -29,4 +29,9 @@ from apex1_tpu.ops.attention import fmha  # noqa: F401
 from apex1_tpu.optim.clip_grad import (  # noqa: F401
     clip_grad_norm as clip_grad_norm_)
 from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
-    distributed_fused_adam)
+    distributed_fused_adam, distributed_fused_lamb)
+from apex1_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm as GroupBatchNorm2d)  # groupbn/cudnn_gbn capability:
+# NHWC (channel-last default here) BN with cross-replica "group" stats —
+# reference ``apex/contrib/groupbn :: BatchNorm2d_NHWC`` /
+# ``cudnn_gbn :: GroupBatchNorm2d``; use ``group_size`` for subgroup stats.

@@ -1,0 +1,58 @@
+"""ASP (2:4 structured sparsity) — reference ``apex/contrib/sparsity/
+asp.py :: ASP``, ``sparse_masklib.py``, ``permutation_search_kernels``.
+
+**Documented N/A on TPU** (SURVEY.md §2.3 row 47): the reference's value
+is NVIDIA Ampere's 2:4 sparse tensor cores — hardware the TPU MXU does
+not have, so pruning to the 2:4 pattern buys no TPU speedup. The MASKING
+capability (train-with-frozen-sparsity, mask re-applied after each
+optimizer step) is still provided for model-portability experiments; the
+permutation search and the speedup expectation are not.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_m4n2_mask(w) -> jnp.ndarray:
+    """2:4 mask along the last dim: keep the 2 largest-|w| of each group
+    of 4 (``sparse_masklib :: m4n2_1d`` pattern)."""
+    if w.shape[-1] % 4:
+        raise ValueError("last dim must be a multiple of 4 for 2:4")
+    groups = w.reshape(*w.shape[:-1], -1, 4)
+    ranks = jnp.argsort(jnp.argsort(-jnp.abs(groups), axis=-1), axis=-1)
+    return (ranks < 2).reshape(w.shape)
+
+
+class ASP:
+    """Mask bookkeeping: ``compute_sparse_masks(params)`` then
+    ``apply_masks(params)`` after each optimizer step (the reference
+    monkey-patches ``optimizer.step``; here call it in your train step —
+    one fused multiply under jit).
+
+    No TPU speedup is claimed — see module docstring."""
+
+    def __init__(self, mask_fn=compute_m4n2_mask):
+        self.mask_fn = mask_fn
+        self.masks = None
+
+    def compute_sparse_masks(self, params, *, predicate=None):
+        predicate = predicate or (
+            lambda path, x: jnp.ndim(x) >= 2 and x.shape[-1] % 4 == 0)
+        self.masks = {
+            jax.tree_util.keystr(p): self.mask_fn(x)
+            for p, x in jax.tree_util.tree_flatten_with_path(params)[0]
+            if predicate(p, x)}
+        return self.masks
+
+    def apply_masks(self, params):
+        if self.masks is None:
+            raise RuntimeError("call compute_sparse_masks first")
+        masks = self.masks
+
+        def mask_leaf(path, x):
+            m = masks.get(jax.tree_util.keystr(path))
+            return x if m is None else x * m.astype(x.dtype)
+
+        return jax.tree_util.tree_map_with_path(mask_leaf, params)

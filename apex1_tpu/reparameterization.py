@@ -1,0 +1,67 @@
+"""Weight reparameterization — reference ``apex/reparameterization/
+{weight_norm,reparameterization}.py`` (fp16-safe weight normalization;
+deprecated upstream, kept for surface parity).
+
+w = g · v / ||v||, with the norm computed in fp32 regardless of the
+parameter dtype (the module's whole reason to exist: fp16 ||v|| overflows
+for large fan-in). Functional (`weight_norm`) and flax-module
+(`WeightNorm` wrapper around a kernel-carrying module) forms.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def weight_norm(v, g, *, dim: int | None = 0, eps: float = 1e-12):
+    """w = g * v / ||v|| with fp32 norm. ``dim``: the output-channel axis
+    kept un-reduced (reference ``dim=0`` convention); None = global norm."""
+    v32 = v.astype(jnp.float32)
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(v32)) + eps)
+    else:
+        axes = tuple(a for a in range(v.ndim) if a != dim % v.ndim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes,
+                                keepdims=True) + eps)
+    g32 = g.astype(jnp.float32)
+    if dim is not None and g32.ndim == 1:
+        shape = [1] * v.ndim
+        shape[dim % v.ndim] = g32.shape[0]
+        g32 = g32.reshape(shape)
+    return (g32 * v32 / norm).astype(v.dtype)
+
+
+class WeightNormDense(nn.Module):
+    """Dense layer under weight norm — ≙ applying the reference's
+    ``apply_weight_norm(module)`` to a Linear."""
+
+    features: int
+    use_bias: bool = True
+    dim: int = 1  # kernel is (in, out); out axis carries g
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        v = self.param("v", nn.initializers.lecun_normal(),
+                       (fan_in, self.features), jnp.float32)
+        g = self.param("g", nn.initializers.ones, (self.features,),
+                       jnp.float32)
+        w = weight_norm(v, g, dim=self.dim).astype(x.dtype)
+        y = x @ w
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.features,),
+                               jnp.float32).astype(x.dtype)
+        return y
+
+
+def remove_weight_norm(params: dict, *, dim: int = 1) -> dict:
+    """Collapse {v, g} back into a materialized kernel
+    (≙ ``remove_weight_norm(module)``)."""
+    out = dict(params)
+    if "v" in out and "g" in out:
+        out["kernel"] = weight_norm(out.pop("v"), out.pop("g"), dim=dim)
+    return out

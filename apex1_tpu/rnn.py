@@ -1,0 +1,140 @@
+"""RNN modules — reference ``apex/RNN/{RNNBackend,cells,models}.py``
+(deprecated upstream, kept for surface parity).
+
+TPU-native: the input projection for ALL timesteps is one big MXU matmul
+hoisted out of the loop; the recurrence is a ``jax.lax.scan`` over the
+(small) hidden-to-hidden matmul + gates — there is no cuDNN-RNN analogue
+to bind. Layout (T, B, F) seq-first, reference convention.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _proj_params(mod, name, fan_in, fan_out, bias):
+    k = nn.initializers.lecun_normal()
+    w = mod.param(f"{name}_w", k, (fan_in, fan_out), jnp.float32)
+    b = (mod.param(f"{name}_b", nn.initializers.zeros, (fan_out,),
+                   jnp.float32) if bias else None)
+    return w, b
+
+
+def _apply(x, w, b):
+    y = x @ w.astype(x.dtype)
+    return y if b is None else y + b.astype(x.dtype)
+
+
+class LSTM(nn.Module):
+    """``apex.RNN.LSTM`` equivalent. Input (T, B, input_size); returns
+    (outputs (T, B, hidden), (h_n, c_n) each (layers, B, hidden))."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, xs, state=None):
+        B, H = xs.shape[1], self.hidden_size
+        outs = xs
+        finals = []
+        for layer in range(self.num_layers):
+            fan_in = self.input_size if layer == 0 else H
+            wi, bi = _proj_params(self, f"l{layer}_ih", fan_in, 4 * H,
+                                  self.bias)
+            wh, _ = _proj_params(self, f"l{layer}_hh", H, 4 * H, False)
+            x_gates = _apply(outs, wi, bi)       # (T, B, 4H), one matmul
+
+            def cell(carry, xg, wh=wh):
+                h, c = carry
+                gates = xg + _apply(h, wh, None)
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h = jax.nn.sigmoid(o) * jnp.tanh(c)
+                return (h, c), h
+
+            if state is None:
+                h0 = jnp.zeros((B, H), xs.dtype)
+                c0 = jnp.zeros((B, H), xs.dtype)
+            else:
+                h0, c0 = state[0][layer], state[1][layer]
+            (h_n, c_n), outs = jax.lax.scan(cell, (h0, c0), x_gates)
+            finals.append((h_n, c_n))
+        return outs, (jnp.stack([f[0] for f in finals]),
+                      jnp.stack([f[1] for f in finals]))
+
+
+class GRU(nn.Module):
+    """``apex.RNN.GRU`` equivalent."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, xs, state=None):
+        B, H = xs.shape[1], self.hidden_size
+        outs = xs
+        finals = []
+        for layer in range(self.num_layers):
+            fan_in = self.input_size if layer == 0 else H
+            wi, bi = _proj_params(self, f"l{layer}_ih", fan_in, 3 * H,
+                                  self.bias)
+            wh, _ = _proj_params(self, f"l{layer}_hh", H, 3 * H, False)
+            x_gates = _apply(outs, wi, bi)
+
+            def cell(h, xg, wh=wh):
+                hg = _apply(h, wh, None)
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                return (1.0 - z) * n + z * h, (1.0 - z) * n + z * h
+
+            h0 = (jnp.zeros((B, H), xs.dtype) if state is None
+                  else state[layer])
+            h_n, outs = jax.lax.scan(cell, h0, x_gates)
+            finals.append(h_n)
+        return outs, jnp.stack(finals)
+
+
+class RNNReLU(nn.Module):
+    """``apex.RNN.RNNReLU`` — vanilla RNN, ReLU nonlinearity."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, xs, state=None):
+        act = jax.nn.relu if self.activation == "relu" else jnp.tanh
+        B, H = xs.shape[1], self.hidden_size
+        outs = xs
+        finals = []
+        for layer in range(self.num_layers):
+            fan_in = self.input_size if layer == 0 else H
+            wi, bi = _proj_params(self, f"l{layer}_ih", fan_in, H,
+                                  self.bias)
+            wh, _ = _proj_params(self, f"l{layer}_hh", H, H, False)
+            x_gates = _apply(outs, wi, bi)
+
+            def cell(h, xg, wh=wh):
+                h = act(xg + _apply(h, wh, None))
+                return h, h
+
+            h0 = (jnp.zeros((B, H), xs.dtype) if state is None
+                  else state[layer])
+            h_n, outs = jax.lax.scan(cell, h0, x_gates)
+            finals.append(h_n)
+        return outs, jnp.stack(finals)
+
+
+class RNNTanh(RNNReLU):
+    activation: str = "tanh"

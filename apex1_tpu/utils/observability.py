@@ -122,11 +122,18 @@ class MetricsLogger:
             if isinstance(v, (str, bool)):
                 rec[k] = v
                 continue
-            arr = np.asarray(jax.device_get(v))
-            if arr.size == 1:
-                rec[k] = float(arr)
-            else:  # vectors go in whole — never silently dropped
-                rec[k] = arr.tolist()
+            try:
+                arr = np.asarray(jax.device_get(v))
+                if arr.size == 1 and arr.dtype != object:
+                    rec[k] = float(arr)
+                elif arr.dtype != object:
+                    rec[k] = arr.tolist()  # vectors go in whole
+                else:
+                    raise TypeError("non-array metric")
+            except (TypeError, ValueError):
+                # arbitrary pytrees (e.g. train-step aux) — keep a
+                # readable form rather than crashing or dropping the key
+                rec[k] = repr(v)[:500]
         if tokens is not None and self._last_t is not None:
             dt = now - self._last_t
             steps = step - (self._last_step or 0)

@@ -1,0 +1,63 @@
+"""GPT-2 mixed-precision training — the amp half of reference
+``examples/imagenet/main_amp.py`` applied to BASELINE config 1 ("GPT-2
+125M, amp O1 + Adam"): opt-level presets, dynamic loss scaling with
+skip-on-overflow, fused Adam. Synthetic tokens.
+
+``python examples/gpt2_amp.py [--opt-level O1|O1_fp16|O2] [--tiny]``
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex1_tpu.amp import Amp
+from apex1_tpu.core.policy import get_policy
+from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+from apex1_tpu.optim.fused_adam import fused_adam
+from apex1_tpu.utils.observability import MetricsLogger
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--opt-level", default="O1")
+    ap.add_argument("--tiny", action="store_true")
+    args = ap.parse_args()
+
+    policy = get_policy(args.opt_level)
+    cfg = (GPT2Config.tiny(policy=policy) if args.tiny
+           else GPT2Config(policy=policy))
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
+    params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
+
+    amp = Amp(tx=fused_adam(3e-4, weight_decay=0.01),
+              opt_level=args.opt_level, max_grad_norm=1.0)
+    state = amp.init(params)
+    step = jax.jit(amp.make_train_step(gpt2_loss_fn(model)),
+                   donate_argnums=0)
+
+    logger = MetricsLogger()
+    t0 = time.time()
+    for i in range(args.steps):
+        batch = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (args.batch, args.seq)),
+            jnp.int32)
+        state, metrics = step(state, batch)
+        if i % 5 == 0 or i == args.steps - 1:
+            logger.log(i, metrics, tokens=args.batch * args.seq)
+    jax.block_until_ready(state.params)
+    print(f"done in {time.time() - t0:.1f}s; final loss-scale "
+          f"{float(state.loss_scale.scale)}, "
+          f"skipped {int(state.loss_scale.overflow_count)} steps")
+
+
+if __name__ == "__main__":
+    main()

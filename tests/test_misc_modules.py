@@ -1,0 +1,127 @@
+"""RNN / weight-norm / ASP / multiproc / examples smoke tests
+(reference: ``apex/RNN``, ``apex/reparameterization``,
+``apex/contrib/sparsity``, ``apex/parallel/multiproc.py``,
+``examples/``)."""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.contrib.sparsity import ASP, compute_m4n2_mask
+from apex1_tpu.reparameterization import (WeightNormDense,
+                                          remove_weight_norm, weight_norm)
+from apex1_tpu.rnn import GRU, LSTM, RNNReLU, RNNTanh
+
+
+class TestRNN:
+    def test_lstm_shapes_and_gold(self, rng):
+        T, B, I, H = 5, 2, 4, 8
+        xs = jnp.asarray(rng.normal(size=(T, B, I)), jnp.float32)
+        m = LSTM(input_size=I, hidden_size=H, num_layers=2)
+        p = m.init(jax.random.key(0), xs)["params"]
+        outs, (h_n, c_n) = m.apply({"params": p}, xs)
+        assert outs.shape == (T, B, H)
+        assert h_n.shape == (2, B, H) and c_n.shape == (2, B, H)
+        # step-by-step numpy gold for layer 0
+        wi = np.asarray(p["l0_ih_w"])
+        bi = np.asarray(p["l0_ih_b"])
+        wh = np.asarray(p["l0_hh_w"])
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        for t in range(T):
+            gates = np.asarray(xs[t]) @ wi + bi + h @ wh
+            i_, f_, g_, o_ = np.split(gates, 4, axis=-1)
+            c = sig(f_) * c + sig(i_) * np.tanh(g_)
+            h = sig(o_) * np.tanh(c)
+        np.testing.assert_allclose(h_n[0], h, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("cls", [GRU, RNNReLU, RNNTanh])
+    def test_variants_run_and_grad(self, rng, cls):
+        xs = jnp.asarray(rng.normal(size=(4, 2, 4)), jnp.float32)
+        m = cls(input_size=4, hidden_size=6)
+        p = m.init(jax.random.key(0), xs)["params"]
+        outs, _ = m.apply({"params": p}, xs)
+        assert outs.shape == (4, 2, 6)
+        g = jax.grad(lambda p: jnp.sum(
+            jnp.square(m.apply({"params": p}, xs)[0])))(p)
+        assert all(np.all(np.isfinite(x)) for x in jax.tree.leaves(g))
+
+
+class TestWeightNorm:
+    def test_norm_property(self, rng):
+        v = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+        g = jnp.asarray(rng.uniform(1, 2, (4,)), jnp.float32)
+        w = weight_norm(v, g, dim=1)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(w), axis=0), np.asarray(g),
+            rtol=1e-5)
+
+    def test_dense_and_remove(self, rng):
+        x = jnp.asarray(rng.normal(size=(3, 8)), jnp.float32)
+        m = WeightNormDense(features=4)
+        p = m.init(jax.random.key(0), x)["params"]
+        out = m.apply({"params": p}, x)
+        collapsed = remove_weight_norm(dict(p))
+        want = x @ collapsed["kernel"] + p["bias"]
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_fp16_safe(self, rng):
+        # large fan-in fp16 vector whose naive ||v||^2 overflows fp16
+        v = jnp.full((4096, 2), 8.0, jnp.float16)
+        w = weight_norm(v, jnp.ones((2,), jnp.float16), dim=1)
+        assert np.all(np.isfinite(np.asarray(w, np.float32)))
+
+
+class TestASP:
+    def test_mask_pattern(self, rng):
+        w = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        mask = compute_m4n2_mask(w)
+        grouped = np.asarray(mask).reshape(4, 2, 4)
+        assert np.all(grouped.sum(-1) == 2)  # exactly 2 of every 4
+        # kept entries are the 2 largest |w| per group
+        wg = np.abs(np.asarray(w)).reshape(4, 2, 4)
+        for i in range(4):
+            for j in range(2):
+                kept = set(np.flatnonzero(grouped[i, j]))
+                top2 = set(np.argsort(-wg[i, j])[:2])
+                assert kept == top2
+
+    def test_apply_masks(self, rng):
+        params = {"dense": {"kernel": jnp.asarray(
+            rng.normal(size=(8, 8)), jnp.float32),
+            "bias": jnp.ones((8,))}}
+        asp = ASP()
+        asp.compute_sparse_masks(params)
+        sparse = asp.apply_masks(params)
+        k = np.asarray(sparse["dense"]["kernel"]).reshape(8, 2, 4)
+        assert np.all((k != 0).sum(-1) <= 2)
+        np.testing.assert_array_equal(sparse["dense"]["bias"],
+                                      params["dense"]["bias"])
+
+
+@pytest.mark.parametrize("script,args", [
+    ("examples/distributed_data_parallel.py", []),
+    ("examples/gpt2_amp.py", ["--tiny", "--steps", "3", "--seq", "64"]),
+    ("examples/imagenet_amp.py", ["--tiny", "--steps", "3", "--batch",
+                                  "8", "--image", "32"]),
+])
+def test_examples_smoke(script, args):
+    """≙ reference examples/ as integration tests (SURVEY §4.1 L1)."""
+    import os
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["APEX1_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         f"import sys; sys.argv = {[script] + args!r};"
+         f"exec(open({script!r}).read())"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=".")
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -247,3 +247,48 @@ class TestDistributedFusedAdam:
         specs = parallel.shard_opt_state_specs(st)
         assert specs.exp_avg["w"] == P("fsdp", None)
         assert specs.step == P()
+
+
+class TestDistributedFusedLamb:
+    def test_matches_full_lamb(self, rng, devices):
+        """4-way flat-sharded LAMB == unsharded fused_lamb, per step —
+        including the per-tensor trust ratios reconstructed across shard
+        boundaries (reference DistributedFusedLAMB's guarantee)."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.optim.fused_lamb import fused_lamb
+        from apex1_tpu.parallel.distributed_optimizer import (
+            distributed_fused_lamb)
+
+        mesh = make_mesh(fsdp=4, dp=1, devices=devices[:4])
+        params = {"w": jnp.asarray(rng.normal(size=(5, 7)), jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=(11,)), jnp.float32)}
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32),
+            params)
+
+        ref_tx = fused_lamb(1e-2, weight_decay=0.01)
+        ref_state = ref_tx.init(params)
+        dist = distributed_fused_lamb(1e-2, weight_decay=0.01,
+                                      axis_name="fsdp")
+
+        def run(params, grads):
+            state = dist.init(params)
+            p1, state = dist.step(grads, state, params)
+            p2, state = dist.step(grads, state, p1)
+            return p2
+
+        sharded = jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))
+        got = sharded(params, grads)
+
+        import optax
+        p_ref = params
+        for _ in range(2):
+            upd, ref_state = ref_tx.update(grads, ref_state, p_ref)
+            p_ref = optax.apply_updates(p_ref, upd)
+        for k in params:
+            np.testing.assert_allclose(got[k], p_ref[k], rtol=1e-5,
+                                       atol=1e-6)
