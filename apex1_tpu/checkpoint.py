@@ -119,3 +119,26 @@ class CheckpointManager:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def to_global(tree, mesh: Mesh, spec_tree: Any = None):
+    """Host-local pytree → globally-addressable arrays on ``mesh``
+    (replicated by default). Required before `save_checkpoint` in
+    multi-controller jobs — orbax refuses host-local arrays
+    (≙ the reference's rank-0 state_dict gather, without the gather)."""
+    from jax.experimental import multihost_utils
+
+    specs = (spec_tree if spec_tree is not None
+             else jax.tree.map(lambda _: PartitionSpec(), tree))
+    return multihost_utils.host_local_array_to_global_array(
+        tree, mesh, specs)
+
+
+def to_host_local(tree, mesh: Mesh, spec_tree: Any = None):
+    """Inverse of `to_global` after a multi-controller restore."""
+    from jax.experimental import multihost_utils
+
+    specs = (spec_tree if spec_tree is not None
+             else jax.tree.map(lambda _: PartitionSpec(), tree))
+    return multihost_utils.global_array_to_host_local_array(
+        tree, mesh, specs)

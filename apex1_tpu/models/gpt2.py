@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
 from apex1_tpu.ops import (layer_norm, scaled_upper_triang_masked_softmax,
                            softmax_cross_entropy_loss)
+from apex1_tpu.ops.attention import flash_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,8 +35,16 @@ class GPT2Config:
     hidden_size: int = 768
     mlp_ratio: int = 4
     dropout: float = 0.0
+    use_flash: bool = True
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
+
+    @property
+    def padded_vocab(self) -> int:
+        """Vocab rounded to a lane multiple (Megatron-style padding) so
+        the LM-head matmul and CE tile cleanly onto the MXU; padded rows
+        exist only in the embedding table, logits are sliced back."""
+        return ((self.vocab_size + 127) // 128) * 128
 
     @staticmethod
     def gpt2_125m(**kw) -> "GPT2Config":
@@ -69,7 +78,9 @@ class Block(nn.Module):
                 gamma, beta = gamma.astype(dtype), beta.astype(dtype)
             return layer_norm(z, gamma, beta)
 
-        # attention
+        # attention — flash kernel (O(S·D) memory; the materialized
+        # scores + fused-softmax path is kept via use_flash=False for
+        # the kernel-parity cross-check)
         y = norm("ln1", x)
         qkv = nn.Dense(3 * h, dtype=dtype, name="qkv")(y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -77,12 +88,15 @@ class Block(nn.Module):
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32)
-        probs = scaled_upper_triang_masked_softmax(
-            scores, scale=1.0 / math.sqrt(hd))
-        probs = probs.astype(dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        if cfg.use_flash:
+            attn = flash_attention(q, k, v, causal=True,
+                                   sm_scale=1.0 / math.sqrt(hd))
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            probs = scaled_upper_triang_masked_softmax(
+                scores, scale=1.0 / math.sqrt(hd))
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, S, h)
         x = x + nn.Dense(h, dtype=dtype, name="proj")(attn)
 
@@ -105,7 +119,7 @@ class GPT2(nn.Module):
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
-                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+                         (cfg.padded_vocab, cfg.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         x = wte[tokens].astype(dtype) + wpe[:S].astype(dtype)[None]
@@ -119,6 +133,8 @@ class GPT2(nn.Module):
         logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),
                             wte.astype(dtype),
                             preferred_element_type=jnp.float32)
+        # returned over padded_vocab — slice-free; consumers mask with
+        # num_classes=cfg.vocab_size (the CE kernel does it in-lane)
         return logits
 
 
@@ -130,7 +146,8 @@ def gpt2_loss_fn(model: GPT2):
     def loss_fn(params, tokens):
         logits = model.apply({"params": params}, tokens)
         losses = softmax_cross_entropy_loss(
-            logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
+            logits[:, :-1].astype(jnp.float32), tokens[:, 1:],
+            num_classes=model.cfg.vocab_size)
         return jnp.mean(losses)
 
     return loss_fn

@@ -77,15 +77,16 @@ def _specs(k):
     return row, stat
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _fused_xent(logits, labels, smoothing, padding_idx):
-    return _fused_xent_fwd(logits, labels, smoothing, padding_idx)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _fused_xent(logits, labels, smoothing, padding_idx, num_classes):
+    return _fused_xent_fwd(logits, labels, smoothing, padding_idx,
+                           num_classes)[0]
 
 
-def _fused_xent_fwd(logits, labels, smoothing, padding_idx):
+def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes):
     shape = logits.shape
-    k = shape[-1]
-    x2 = logits.reshape(-1, k)
+    k = shape[-1] if num_classes is None else num_classes
+    x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
     x2p, rows = pad_to(x2, 0, _BLOCK_ROWS)
     x2p, _ = pad_to(x2p, 1, 128)
@@ -105,11 +106,11 @@ def _fused_xent_fwd(logits, labels, smoothing, padding_idx):
     return loss, (logits, labels, lse)
 
 
-def _fused_xent_bwd(smoothing, padding_idx, res, dloss):
+def _fused_xent_bwd(smoothing, padding_idx, num_classes, res, dloss):
     logits, labels, lse = res
     shape = logits.shape
-    k = shape[-1]
-    x2 = logits.reshape(-1, k)
+    k = shape[-1] if num_classes is None else num_classes
+    x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
     d2 = dloss.reshape(-1, 1).astype(jnp.float32)
     x2p, rows = pad_to(x2, 0, _BLOCK_ROWS)
@@ -126,13 +127,15 @@ def _fused_xent_bwd(smoothing, padding_idx, res, dloss):
         out_shape=jax.ShapeDtypeStruct(x2p.shape, logits.dtype),
         interpret=interpret_mode(),
     )(x2p, t2p, lse, d2p)
-    return dx[:rows, :k].reshape(shape), None
+    return dx[:rows, :shape[-1]].reshape(shape), None
 
 
 _fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
 
 
-def _xla_xent(logits, labels, smoothing, padding_idx):
+def _xla_xent(logits, labels, smoothing, padding_idx, num_classes=None):
+    if num_classes is not None and num_classes != logits.shape[-1]:
+        logits = logits[..., :num_classes]
     x = logits.astype(jnp.float32)
     k = x.shape[-1]
     lse = jax.nn.logsumexp(x, axis=-1, keepdims=True)
@@ -147,13 +150,22 @@ def _xla_xent(logits, labels, smoothing, padding_idx):
 
 
 def softmax_cross_entropy_loss(logits, labels, *, smoothing: float = 0.0,
-                               padding_idx: int | None = None):
+                               padding_idx: int | None = None,
+                               num_classes: int | None = None):
     """``apex.contrib.xentropy.SoftmaxCrossEntropyLoss.apply(logits, labels,
     smoothing, padding_idx, half_to_float)`` equivalent.
 
     Returns per-token loss (reduce with mean/sum yourself, as the reference
     does). ``padding_idx`` tokens contribute zero loss and zero gradient.
+    ``num_classes``: treat only the first N logit columns as real classes —
+    lets callers keep Megatron-style lane-padded vocab logits (the extra
+    columns are masked in-kernel, no slice copy; their grads are zero).
     """
+    if num_classes is not None and not (
+            0 < num_classes <= logits.shape[-1]):
+        raise ValueError(f"num_classes {num_classes} must be in "
+                         f"(0, {logits.shape[-1]}]")
     if use_pallas():
-        return _fused_xent(logits, labels, float(smoothing), padding_idx)
-    return _xla_xent(logits, labels, smoothing, padding_idx)
+        return _fused_xent(logits, labels, float(smoothing), padding_idx,
+                           num_classes)
+    return _xla_xent(logits, labels, smoothing, padding_idx, num_classes)

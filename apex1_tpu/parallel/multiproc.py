@@ -33,6 +33,13 @@ def init_distributed(coordinator_address: Optional[str] = None,
     pods with no args, jax auto-discovers topology from the environment."""
     import jax
 
+    # a sitecustomize may pin jax_platforms via jax.config, which an env
+    # var cannot override — re-assert the env var's choice explicitly so
+    # `launch(cpu_devices_per_process=...)` children actually run on CPU
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -71,8 +78,36 @@ def launch(script: str, args: Sequence[str] = (), *,
                   f"{cpu_devices_per_process}")
         procs.append(subprocess.Popen(
             [sys.executable, script, *args], env=child_env))
-    codes = [p.wait() for p in procs]
-    return next((c for c in codes if c), 0)
+    # poll rather than wait serially: if one rank dies, its peers may be
+    # blocked in a collective forever — reap them instead of hanging
+    import time as _time
+    first_bad = 0
+    while procs:
+        alive = []
+        for p in procs:
+            code = p.poll()
+            if code is None:
+                alive.append(p)
+            elif code and not first_bad:
+                first_bad = code
+        if first_bad and alive:
+            deadline = _time.time() + 10  # grace for co-failing ranks
+            while alive and _time.time() < deadline:
+                alive = [p for p in alive if p.poll() is None]
+                _time.sleep(0.1)
+            for p in alive:
+                p.terminate()
+            for p in alive:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            return first_bad
+        procs = alive
+        if procs:
+            _time.sleep(0.05)
+    return first_bad
 
 
 def init_from_env() -> None:

@@ -1,0 +1,90 @@
+"""Race / divergence / aliasing debug tools — SURVEY.md §5.2.
+
+The reference's only artifact here is
+``tests/distributed/DDP/ddp_race_condition_test.py`` (stressing the
+grad-hook/allreduce overlap); CUDA-side correctness rests on manual
+stream-ordering discipline. Under XLA the compiler owns scheduling, so the
+remaining TPU failure modes are different, and each gets a tool:
+
+- **cross-host program divergence** (ranks tracing different programs →
+  mismatched collectives → hang): `program_fingerprint` hashes the jaxpr;
+  `assert_same_program_across_processes` compares it across the cluster
+  BEFORE launching the real computation — a hang turned into an assert.
+- **donation/aliasing corruption** (``donate_argnums`` reusing a buffer
+  the host still references): `assert_donation_safe` runs a step twice
+  from bitwise-identical inputs and asserts identical outputs.
+- **nondeterminism**: `enable_deterministic` flips the jax flags tests
+  should run under (partitionable threefry; deterministic reductions are
+  the TPU default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def program_fingerprint(fn: Callable, *args, **kwargs) -> int:
+    """Stable 63-bit hash of ``fn``'s traced jaxpr for these args."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    digest = hashlib.sha256(str(jaxpr).encode()).digest()
+    return int.from_bytes(digest[:8], "big") >> 1
+
+
+def assert_same_program_across_processes(fn: Callable, *args,
+                                         **kwargs) -> int:
+    """All processes must trace the same program (≙ the hang-preventing
+    pre-flight check multi-controller JAX lacks). Single-process: no-op
+    beyond returning the fingerprint."""
+    fp = program_fingerprint(fn, *args, **kwargs)
+    if jax.process_count() == 1:
+        return fp
+    from jax.experimental import multihost_utils
+
+    # two uint32 halves: a 63-bit int overflows uint32-truncated jnp
+    # arrays under default x64-disabled jax
+    halves = jnp.asarray([fp >> 32, fp & 0xFFFFFFFF], jnp.uint32)
+    fps = np.asarray(multihost_utils.process_allgather(halves))
+    fps = fps.reshape(-1, 2)
+    joined = [(int(hi) << 32) | int(lo) for hi, lo in fps]
+    if any(j != joined[0] for j in joined):
+        raise AssertionError(
+            f"program divergence across processes: fingerprints "
+            f"{[hex(j) for j in joined]} (process "
+            f"{jax.process_index()} has {hex(fp)}) — ranks would issue "
+            f"mismatched collectives and hang")
+    return fp
+
+
+def assert_donation_safe(step: Callable, *args, n_checks: int = 2,
+                         rtol: float = 0.0, atol: float = 0.0) -> None:
+    """Run ``step`` ``n_checks`` times from bitwise-identical copies of
+    ``args``; any divergence means a donated/aliased buffer was consumed
+    while still referenced (or nondeterminism). ≙ the reference's DDP
+    race-condition test, for XLA's failure mode."""
+    def copy_args():
+        return jax.tree.map(
+            lambda x: jnp.array(x, copy=True)
+            if isinstance(x, jax.Array) else x, args)
+
+    ref = None
+    for i in range(n_checks):
+        out = jax.tree.map(np.asarray, jax.device_get(step(*copy_args())))
+        if ref is None:
+            ref = out
+            continue
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+            if not np.allclose(a, b, rtol=rtol, atol=atol):
+                raise AssertionError(
+                    "donation/aliasing corruption (or nondeterminism): "
+                    f"run {i} diverged from run 0 by "
+                    f"{np.max(np.abs(a.astype(np.float64) - b.astype(np.float64)))}")
+
+
+def enable_deterministic() -> None:
+    """Deterministic-run flags for tests (SURVEY §5.2c)."""
+    jax.config.update("jax_threefry_partitionable", True)

@@ -235,3 +235,26 @@ class TestRoPE:
         expected = ops.apply_rotary_pos_emb(t, cos, -sin)
         np.testing.assert_allclose(np.asarray(g), np.asarray(expected),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_xentropy_num_classes_padded_vocab(rng):
+    """Lane-padded vocab logits with num_classes masking == sliced logits
+    (Megatron-style padded LM head, no slice copy)."""
+    from apex1_tpu.ops import force_impl, softmax_cross_entropy_loss
+    logits = jnp.asarray(rng.normal(size=(6, 256)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 200, (6,)), jnp.int32)
+    for impl in ("pallas", "xla"):
+        with force_impl(impl):
+            got = softmax_cross_entropy_loss(logits, labels,
+                                             num_classes=200)
+            want = softmax_cross_entropy_loss(logits[:, :200], labels)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-6, err_msg=impl)
+            g = jax.grad(lambda l: jnp.sum(softmax_cross_entropy_loss(
+                l, labels, num_classes=200)))(logits)
+            np.testing.assert_array_equal(np.asarray(g[:, 200:]), 0.0)
+            gw = jax.grad(lambda l: jnp.sum(softmax_cross_entropy_loss(
+                l, labels)))(logits[:, :200])
+            np.testing.assert_allclose(np.asarray(g[:, :200]),
+                                       np.asarray(gw), rtol=1e-5,
+                                       atol=1e-6, err_msg=impl)
