@@ -110,6 +110,7 @@ class Amp:
                 state.params)
             for ax in self.grad_psum_axes:
                 grads = jax.lax.pmean(grads, ax)
+                loss = jax.lax.pmean(loss, ax)  # report the GLOBAL mean
             grads = scaler.unscale(grads, state.loss_scale)
             finite = all_finite(grads, axis_names=self.grad_psum_axes)
             gnorm = global_norm(grads)

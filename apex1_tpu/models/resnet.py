@@ -49,25 +49,50 @@ class ResNetConfig:
 class Bottleneck(nn.Module):
     """1×1 → 3×3 → 1×1 bottleneck with identity/projection shortcut —
     ≙ ``apex/contrib/bottleneck/bottleneck.py :: Bottleneck`` (the fused
-    NHWC block; XLA performs the conv+BN+ReLU fusion)."""
+    NHWC block; XLA performs the conv+BN+ReLU fusion).
+
+    ``spatial_axis_name`` turns on spatial parallelism (reference
+    ``SpatialBottleneck``): the activation arrives H-sharded over that
+    mesh axis, the 3×3 conv exchanges one halo row per neighbor
+    (`apex1_tpu.parallel.halo`), the 1×1 convs stay local, and the BN
+    statistics additionally psum over the spatial axis so they cover the
+    FULL activation (otherwise train-mode stats would silently be
+    per-shard). Stride-1 only in spatial mode (the spatial-parallel
+    sweet spot: high-resolution early stages)."""
 
     cfg: ResNetConfig
     features: int
     strides: int = 1
+    spatial_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         cfg = self.cfg
+        spatial = self.spatial_axis_name
+        if spatial is not None and self.strides != 1:
+            raise ValueError("spatial parallelism supports stride 1 only")
         dtype = cfg.policy.compute_dtype
-        bn = partial(SyncBatchNorm, axis_name=cfg.bn_axis_name,
+        # BN stats must span every axis the batch/activation is split over
+        bn_axes = tuple(a for a in (cfg.bn_axis_name, spatial)
+                        if a is not None)
+        bn = partial(SyncBatchNorm,
+                     axis_name=(bn_axes if len(bn_axes) > 1 else
+                                (bn_axes[0] if bn_axes else None)),
                      group_size=cfg.bn_group_size,
                      use_running_average=not train, dtype=dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=dtype)
         residual = x
         y = conv(self.features, (1, 1), name="conv1")(x)
         y = nn.relu(bn(name="bn1")(y))
-        y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
-                 name="conv2")(y)
+        if spatial is not None:
+            from apex1_tpu.parallel.halo import halo_exchange
+
+            y = halo_exchange(y, spatial, halo=1, dim=1)
+            y = conv(self.features, (3, 3), padding=((0, 0), (1, 1)),
+                     name="conv2")(y)      # VALID on H: halo absorbs it
+        else:
+            y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                     name="conv2")(y)
         y = nn.relu(bn(name="bn2")(y))
         y = conv(4 * self.features, (1, 1), name="conv3")(y)
         y = bn(name="bn3")(y)
@@ -105,3 +130,11 @@ class ResNet(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         logits = nn.Dense(cfg.num_classes, dtype=dtype, name="fc")(x)
         return logits.astype(jnp.float32)
+
+
+def SpatialBottleneck(cfg: ResNetConfig, features: int,
+                      spatial_axis_name: str = "cp", **kw) -> Bottleneck:
+    """Reference-name alias: ``SpatialBottleneck`` IS `Bottleneck` with
+    ``spatial_axis_name`` set (one implementation, no divergence)."""
+    return Bottleneck(cfg, features, strides=1,
+                      spatial_axis_name=spatial_axis_name, **kw)

@@ -124,3 +124,36 @@ class TestResNet:
         want, _ = model_local.apply(variables, x, mutable=["batch_stats"])
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestSpatialBottleneck:
+    def test_matches_unsplit_bottleneck(self, rng, devices):
+        """H-sharded SpatialBottleneck == plain Bottleneck on the full
+        activation (the reference's spatial-parallelism guarantee)."""
+        from apex1_tpu.models.resnet import Bottleneck, SpatialBottleneck
+
+        cfg = ResNetConfig.tiny()
+        x = jnp.asarray(rng.normal(size=(2, 16, 8, 16)), jnp.float32)
+        plain = Bottleneck(cfg, features=4)
+        variables = plain.init(jax.random.key(0), x)
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        spatial = SpatialBottleneck(cfg, features=4)
+
+        for train in (False, True):
+            # train=True also checks BN batch stats span the FULL
+            # activation (the spatial axis joins the stats psum)
+            want, _ = plain.apply(variables, x, train=train,
+                                  mutable=["batch_stats"])
+
+            def fwd(v, xs, train=train):
+                out, _ = spatial.apply(v, xs, train=train,
+                                       mutable=["batch_stats"])
+                return out
+
+            got = jax.jit(jax.shard_map(
+                fwd, mesh=mesh,
+                in_specs=(P(), P(None, "cp")),
+                out_specs=P(None, "cp")))(variables, x)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"train={train}")
