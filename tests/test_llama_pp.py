@@ -65,7 +65,7 @@ def test_llama_pipeline_matches_unpartitioned(devices):
 
         def stage_fn(p_stage, x):
             for j in range(LPS):
-                layer_p = jax.tree.map(lambda l, j=j: l[j], p_stage)
+                layer_p = jax.tree.map(lambda l, j=j: l[0, j], p_stage)
                 x = block.apply({"params": layer_p}, x, cos, sin)
             return x
 
@@ -122,7 +122,7 @@ def test_llama_pipeline_microbatched(devices):
 
         def stage_fn(p_stage, x):
             for j in range(LPS):
-                layer_p = jax.tree.map(lambda l, j=j: l[j], p_stage)
+                layer_p = jax.tree.map(lambda l, j=j: l[0, j], p_stage)
                 x = block.apply({"params": layer_p}, x, cos, sin)
             return x
 
