@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
-from apex1_tpu.ops import (layer_norm, scaled_upper_triang_masked_softmax,
+from apex1_tpu.ops import (layer_norm, linear_cross_entropy,
+                           scaled_upper_triang_masked_softmax,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
 
@@ -114,7 +115,7 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic=True):
+    def __call__(self, tokens, *, deterministic=True, return_hidden=False):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -130,6 +131,10 @@ class GPT2(nn.Module):
         beta = self.param("lnf_bias", nn.initializers.zeros,
                           (cfg.hidden_size,), jnp.float32)
         x = layer_norm(x, gamma, beta)
+        if return_hidden:
+            # for the fused LM-head+CE path (ops.linear_cross_entropy):
+            # the (B, S, V) logits never hit HBM
+            return x.astype(dtype)
         logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),
                             wte.astype(dtype),
                             preferred_element_type=jnp.float32)
@@ -138,16 +143,27 @@ class GPT2(nn.Module):
         return logits
 
 
-def gpt2_loss_fn(model: GPT2):
+def gpt2_loss_fn(model: GPT2, *, fuse_head: bool = True):
     """``loss_fn(params, tokens) -> scalar`` for `Amp.make_train_step`:
-    next-token CE via the fused xentropy kernel (O1 runs it fp32 —
-    FP32_FUNCS list)."""
+    next-token CE (fp32 inside the kernel — O1 FP32_FUNCS semantics).
+
+    ``fuse_head=True`` (default) runs the tied LM head through
+    ``ops.linear_cross_entropy`` — head matmul fused into the CE, no
+    (B, S, V) logits in HBM. ``False`` keeps the materialized-logits path
+    (the parity gold; also what inference uses)."""
 
     def loss_fn(params, tokens):
-        logits = model.apply({"params": params}, tokens)
-        losses = softmax_cross_entropy_loss(
-            logits[:, :-1].astype(jnp.float32), tokens[:, 1:],
-            num_classes=model.cfg.vocab_size)
+        if fuse_head:
+            h = model.apply({"params": params}, tokens, return_hidden=True)
+            w = params["wte"].astype(h.dtype)
+            losses = linear_cross_entropy(
+                h[:, :-1], w, tokens[:, 1:],
+                num_classes=model.cfg.vocab_size)
+        else:
+            logits = model.apply({"params": params}, tokens)
+            losses = softmax_cross_entropy_loss(
+                logits[:, :-1].astype(jnp.float32), tokens[:, 1:],
+                num_classes=model.cfg.vocab_size)
         return jnp.mean(losses)
 
     return loss_fn

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
-from apex1_tpu.ops import (apply_rotary_pos_emb, rms_norm, rope_tables,
+from apex1_tpu.ops import (apply_rotary_pos_emb, linear_cross_entropy,
+                           rms_norm, rope_tables,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
 from apex1_tpu.parallel.ring_attention import ring_attention
@@ -128,7 +129,8 @@ class Llama(nn.Module):
     seq_shard_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None):
+    def __call__(self, tokens, *, positions=None,
+                 return_hidden=False):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -153,9 +155,13 @@ class Llama(nn.Module):
         if not cfg.policy.keep_norms_fp32:
             g = g.astype(dtype)
         x = rms_norm(x, g, eps=cfg.norm_eps)
+        if return_hidden:
+            # for the fused LM-head+CE path (ops.linear_cross_entropy)
+            return x.astype(dtype)
         head = self.param("output", nn.initializers.normal(0.02),
-                          (cfg.hidden_size, cfg.vocab_size), jnp.float32)
-        return jnp.matmul(x.astype(dtype), head.astype(dtype),
+                          (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        return jnp.einsum("bsh,vh->bsv", x.astype(dtype),
+                          head.astype(dtype),
                           preferred_element_type=jnp.float32)
 
 
@@ -163,7 +169,7 @@ class Llama(nn.Module):
 # (pattern: SNIPPETS.md [1] — rules instead of per-layer hand specs)
 _TP_RULES = (
     (r"tok_embeddings$", P("tp", None)),          # vocab-sharded embedding
-    (r"output$", P(None, "tp")),                   # vocab-sharded lm head
+    (r"output$", P("tp", None)),                   # vocab-sharded lm head
     (r"w[qkv]$", P(None, "tp")),                   # column-parallel qkv
     (r"wo$", P("tp", None)),                       # row-parallel out proj
     (r"w_(gate|up)$", P(None, "tp")),              # column-parallel ffn in
@@ -192,14 +198,21 @@ def param_specs(params, *, rules=_TP_RULES, default=P()):
         [spec_for(path) for path, _ in flat])
 
 
-def llama_loss_fn(model: Llama):
-    """``loss_fn(params, tokens) -> scalar``: next-token CE via the fused
-    xentropy kernel (fp32, recompute-bwd)."""
+def llama_loss_fn(model: Llama, *, fuse_head: bool = True):
+    """``loss_fn(params, tokens) -> scalar``: next-token CE. Default path
+    fuses the (huge — 128k for Llama-3) vocab head matmul into the CE
+    kernel (``ops.linear_cross_entropy``); ``fuse_head=False`` keeps the
+    materialized-logits gold."""
 
     def loss_fn(params, tokens):
-        logits = model.apply({"params": params}, tokens)
-        losses = softmax_cross_entropy_loss(
-            logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
+        if fuse_head:
+            h = model.apply({"params": params}, tokens, return_hidden=True)
+            losses = linear_cross_entropy(
+                h[:, :-1], params["output"].astype(h.dtype), tokens[:, 1:])
+        else:
+            logits = model.apply({"params": params}, tokens)
+            losses = softmax_cross_entropy_loss(
+                logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
         return jnp.mean(losses)
 
     return loss_fn

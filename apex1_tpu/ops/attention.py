@@ -82,8 +82,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    # native-dtype operands: bf16 inputs ride the MXU's bf16 path with
+    # fp32 accumulation (an fp32 upcast before the dot would run the MXU
+    # ~8x slower); running statistics stay fp32
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
@@ -95,9 +98,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
     corr = jnp.exp(m_prev - m_new)
     e = jnp.where(mask, jnp.exp(s - m_new), 0.0)
     l_new = l_prev * corr + jnp.sum(e, axis=1, keepdims=True)
-    v = v_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0]
     acc[...] = acc[...] * corr + jax.lax.dot_general(
-        e, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -127,21 +131,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     def _():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
                      true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
                      qseg=qseg, kseg=kseg)
     p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
-    do = do_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0]
+    v = v_ref[0, 0]
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
     dq_acc[...] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
     def _():
@@ -165,23 +170,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
                      true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
                      qseg=qseg, kseg=kseg)
     p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
-    do = do_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0]
+    v = v_ref[0, 0]
     dv_acc[...] += jax.lax.dot_general(                      # pᵀ · do
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
     dk_acc[...] += jax.lax.dot_general(                      # dsᵀ · q
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(qi == n_q - 1)
     def _():

@@ -1,0 +1,291 @@
+"""Fused LM-head + softmax cross-entropy ("vocab flash") — Pallas TPU.
+
+Capability extension of ``apex/contrib/xentropy`` (see ``ops/xentropy.py``):
+the reference kernel fuses softmax+CE but still takes materialized logits.
+For an LM head the logits tensor ``x @ Wᵀ`` is (tokens, vocab) — at fp32,
+1.6 GB for GPT-2 (50k vocab, 8k tokens) and 4.2 GB for Llama-3 (128k vocab)
+per step, twice (forward write + backward read). On TPU the HBM traffic for
+that tensor dominates the whole loss computation, so this kernel fuses the
+head matmul INTO the cross entropy with the flash-attention recipe
+(``ops/attention.py``): the vocab axis is tiled onto the sequential Pallas
+grid, each (token-block × vocab-block) logit tile lives only in
+VMEM/registers, and the running (max, sum-exp, target-logit, sum-logits)
+statistics ride in VMEM scratch. Backward recomputes the tile logits from
+``(x, W, lse)`` — the same recompute-instead-of-save trade the reference's
+xentropy kernel makes — and accumulates ``dx = g·W`` (vocab-innermost grid)
+and ``dW = gᵀ·x`` (token-innermost grid) in fp32 scratch.
+
+Loss semantics match ``softmax_cross_entropy_loss`` exactly (label
+smoothing ε, ``padding_idx`` rows → zero loss/grad, ``num_classes`` masks
+lane-padded vocab rows of W in-kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex1_tpu.ops._common import NEG_INF, interpret_mode, pad_to, use_pallas
+
+_LANES = 128
+
+
+def _blk(size: int, requested: int) -> int:
+    return min(requested, max(16, ((size + 15) // 16) * 16))
+
+
+def _tile(x_ref, w_ref):
+    """One (bt, bv) logit tile on the MXU — native-dtype operands (bf16
+    rides the fast MXU path), fp32 accumulation."""
+    return jax.lax.dot_general(x_ref[...], w_ref[...],
+                               (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _grad_tile(s, t, lse, col, valid, smoothing, true_k, padding_idx, dl):
+    """dloss/dlogits for one tile: softmax − (1−ε)·onehot − ε/K, scaled by
+    the (padding-masked) upstream cotangent."""
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    g = p - (1.0 - smoothing) * (col == t) - smoothing / true_k
+    g = jnp.where(valid, g, 0.0)
+    if padding_idx is not None:
+        dl = jnp.where(t == padding_idx, 0.0, dl)
+    return g * dl
+
+
+def _fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
+                m_scr, l_scr, tgt_scr, sx_scr, *,
+                smoothing, true_k, padding_idx, bv, n_v):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        tgt_scr[...] = jnp.zeros_like(tgt_scr)
+        sx_scr[...] = jnp.zeros_like(sx_scr)
+
+    s = _tile(x_ref, w_ref)
+    t = t_ref[...]  # (bt, 1) int32
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
+    valid = col < true_k
+    sm = jnp.where(valid, s, NEG_INF)
+    m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(sm, axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    e = jnp.where(valid, jnp.exp(sm - m_new), 0.0)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_prev * corr
+                                  + jnp.sum(e, axis=1, keepdims=True),
+                                  l_scr.shape)
+    tgt_scr[...] += jnp.sum(jnp.where(col == t, s, 0.0), axis=1,
+                            keepdims=True)
+    sx_scr[...] += jnp.sum(jnp.where(valid, s, 0.0), axis=1, keepdims=True)
+
+    @pl.when(vi == n_v - 1)
+    def _():
+        lse = m_scr[:, :1] + jnp.log(l_scr[:, :1])
+        loss = ((1.0 - smoothing) * (lse - tgt_scr[:, :1])
+                + smoothing * (lse - sx_scr[:, :1] / true_k))
+        if padding_idx is not None:
+            loss = jnp.where(t == padding_idx, 0.0, loss)
+        loss_ref[...] = loss
+        lse_ref[...] = lse
+
+
+def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dx_ref, dx_acc, *,
+                   smoothing, true_k, padding_idx, bv, n_v):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _():
+        dx_acc[...] = jnp.zeros_like(dx_acc)
+
+    s = _tile(x_ref, w_ref)
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
+    g = _grad_tile(s, t_ref[...], lse_ref[...], col, col < true_k,
+                   smoothing, true_k, padding_idx, dl_ref[...])
+    w = w_ref[...]
+    dx_acc[...] += jax.lax.dot_general(
+        g.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(vi == n_v - 1)
+    def _():
+        dx_ref[...] = dx_acc[...].astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dw_ref, dw_acc, *,
+                   smoothing, true_k, padding_idx, bv, n_t):
+    vi, ti = pl.program_id(0), pl.program_id(1)  # token axis innermost
+
+    @pl.when(ti == 0)
+    def _():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+
+    s = _tile(x_ref, w_ref)
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
+    g = _grad_tile(s, t_ref[...], lse_ref[...], col, col < true_k,
+                   smoothing, true_k, padding_idx, dl_ref[...])
+    x = x_ref[...]
+    dw_acc[...] += jax.lax.dot_general(            # gᵀ · x
+        g.astype(x.dtype), x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ti == n_t - 1)
+    def _():
+        dw_ref[...] = dw_acc[...].astype(dw_ref.dtype)
+
+
+def _auto_blocks(Hp, block_t, block_v):
+    """Shrink default blocks so the fp32 accumulators (dx_acc (bt, Hp),
+    dw_acc (bv, Hp)) + operand blocks stay within ~a quarter of VMEM at
+    large hidden sizes (Llama-3 8B: H=4096; 70B: 8192). Explicitly
+    requested blocks are honored as-is."""
+    cap = max(16, (4 * 1024 * 1024) // (4 * Hp) // 16 * 16)  # ≤4 MiB fp32
+    bt = min(block_t, cap) if block_t is not None else min(256, cap)
+    bv = min(block_v, cap) if block_v is not None else min(512, cap)
+    return bt, bv
+
+
+def _prep(x2, weight, t2, block_t, block_v):
+    T, H = x2.shape
+    V = weight.shape[0]
+    Hp = ((H + _LANES - 1) // _LANES) * _LANES
+    block_t, block_v = _auto_blocks(Hp, block_t, block_v)
+    bt, bv = _blk(T, block_t), _blk(V, block_v)
+    xp, _ = pad_to(x2, 0, bt)
+    xp, _ = pad_to(xp, 1, _LANES)
+    wp, _ = pad_to(weight, 0, bv)
+    wp, _ = pad_to(wp, 1, _LANES)
+    tp, _ = pad_to(t2, 0, bt, value=-1)
+    g = dict(T=T, H=H, V=V, bt=bt, bv=bv, Hp=xp.shape[1],
+             n_t=xp.shape[0] // bt, n_v=wp.shape[0] // bv)
+    return xp, wp, tp, g
+
+
+def _specs(g, *, for_dw=False):
+    """Grid is (ti, vi) for fwd/dx and (vi, ti) for dW (``for_dw``)."""
+    def ix(i0, i1):
+        return (i1, i0) if for_dw else (i0, i1)
+
+    x_spec = pl.BlockSpec((g["bt"], g["Hp"]),
+                          lambda i0, i1: (ix(i0, i1)[0], 0),
+                          memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((g["bv"], g["Hp"]),
+                          lambda i0, i1: (ix(i0, i1)[1], 0),
+                          memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((g["bt"], 1),
+                             lambda i0, i1: (ix(i0, i1)[0], 0),
+                             memory_space=pltpu.VMEM)
+    return x_spec, w_spec, stat_spec
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused(x2, weight, t2, smoothing, padding_idx, num_classes,
+           block_t, block_v):
+    return _fused_fwd(x2, weight, t2, smoothing, padding_idx, num_classes,
+                      block_t, block_v)[0]
+
+
+def _fused_fwd(x2, weight, t2, smoothing, padding_idx, num_classes,
+               block_t, block_v):
+    xp, wp, tp, g = _prep(x2, weight, t2, block_t, block_v)
+    k = num_classes if num_classes is not None else g["V"]
+    x_spec, w_spec, stat_spec = _specs(g)
+    Tp = g["n_t"] * g["bt"]
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, smoothing=smoothing, true_k=k,
+                          padding_idx=padding_idx, bv=g["bv"], n_v=g["n_v"]),
+        grid=(g["n_t"], g["n_v"]),
+        in_specs=[x_spec, w_spec, stat_spec],
+        out_specs=(stat_spec, stat_spec),
+        out_shape=(jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((Tp, 1), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
+        interpret=interpret_mode(),
+    )(xp, wp, tp)
+    return loss[:g["T"], 0], (x2, weight, t2, lse)
+
+
+def _fused_bwd(smoothing, padding_idx, num_classes, block_t, block_v,
+               res, dloss):
+    x2, weight, t2, lse = res
+    xp, wp, tp, g = _prep(x2, weight, t2, block_t, block_v)
+    k = num_classes if num_classes is not None else g["V"]
+    dl, _ = pad_to(dloss.reshape(-1, 1).astype(jnp.float32), 0, g["bt"])
+    kern = dict(smoothing=smoothing, true_k=k, padding_idx=padding_idx,
+                bv=g["bv"])
+
+    x_spec, w_spec, stat_spec = _specs(g)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, n_v=g["n_v"], **kern),
+        grid=(g["n_t"], g["n_v"]),
+        in_specs=[x_spec, w_spec, stat_spec, stat_spec, stat_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+        scratch_shapes=[pltpu.VMEM((g["bt"], g["Hp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(xp, wp, tp, lse, dl)[:g["T"], :g["H"]]
+
+    x_spec, w_spec, stat_spec = _specs(g, for_dw=True)
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, n_t=g["n_t"], **kern),
+        grid=(g["n_v"], g["n_t"]),
+        in_specs=[x_spec, w_spec, stat_spec, stat_spec, stat_spec],
+        out_specs=w_spec,
+        out_shape=jax.ShapeDtypeStruct(wp.shape, weight.dtype),
+        scratch_shapes=[pltpu.VMEM((g["bv"], g["Hp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(xp, wp, tp, lse, dl)[:g["V"], :g["H"]]
+
+    f0 = np.zeros(t2.shape, dtype=jax.dtypes.float0)
+    return dx, dw, f0
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def _xla_linear_xent(x, weight, labels, smoothing, padding_idx, num_classes):
+    """Composite gold: materializes logits (what this kernel avoids)."""
+    from apex1_tpu.ops.xentropy import _xla_xent
+    logits = jnp.einsum("th,vh->tv", x.astype(jnp.float32),
+                        weight.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    return _xla_xent(logits, labels, smoothing, padding_idx, num_classes)
+
+
+def linear_cross_entropy(x, weight, labels, *, smoothing: float = 0.0,
+                         padding_idx: int | None = None,
+                         num_classes: int | None = None,
+                         block_t: int | None = None,
+                         block_v: int | None = None):
+    """Per-token CE of ``softmax(x @ weightᵀ)`` without materializing the
+    logits — ``x`` (..., H), ``weight`` (V, H) (an embedding table for tied
+    LM heads), ``labels`` (...,) int. Returns (...,) fp32 losses.
+
+    Semantics ≡ ``softmax_cross_entropy_loss(x @ weightᵀ, labels, ...)``
+    (``ops/xentropy.py``): label ``smoothing``, zero loss/grad at
+    ``padding_idx`` rows, ``num_classes`` masking of lane-padded vocab rows.
+    """
+    if x.shape[-1] != weight.shape[-1]:
+        raise ValueError(f"hidden mismatch: x {x.shape} vs weight "
+                         f"{weight.shape}")
+    if num_classes is not None and not (0 < num_classes <= weight.shape[0]):
+        raise ValueError(f"num_classes {num_classes} must be in "
+                         f"(0, {weight.shape[0]}]")
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    t2 = labels.reshape(-1, 1).astype(jnp.int32)
+    if use_pallas():
+        loss = _fused(x2, weight, t2, float(smoothing), padding_idx,
+                      num_classes, block_t, block_v)
+    else:
+        loss = _xla_linear_xent(x2, weight, t2[:, 0], smoothing,
+                                padding_idx, num_classes)
+    return loss.reshape(lead)

@@ -1,0 +1,155 @@
+"""Fused LM-head + CE ("vocab flash", ``ops/linear_xent.py``) parity —
+vs the materialized-logits path it replaces: loss, dx, dW, under label
+smoothing / padding_idx / num_classes lane-pad masking, fp32 and bf16.
+Reference capability lineage: ``apex/contrib/xentropy`` (the fused-softmax
+CE this kernel extends with the head matmul)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu import ops
+from apex1_tpu.ops import _common
+from apex1_tpu.ops.linear_xent import linear_cross_entropy
+
+FP32_TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def _materialized(x, w, labels, **kw):
+    logits = jnp.einsum("th,vh->tv", x.astype(jnp.float32),
+                        w.astype(jnp.float32))
+    return ops.softmax_cross_entropy_loss(logits, labels, **kw)
+
+
+class TestLinearCrossEntropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_parity_vs_materialized(self, rng, smoothing):
+        T, H, V = 24, 96, 307  # V, H non-multiples of 128 exercise padding
+        x = jnp.asarray(rng.normal(size=(T, H)) * 0.3, jnp.float32)
+        w = jnp.asarray(rng.normal(size=(V, H)) * 0.3, jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, size=(T,)), jnp.int32)
+
+        def fused(x, w):
+            with _common.force_impl("pallas"):
+                return linear_cross_entropy(x, w, labels,
+                                            smoothing=smoothing,
+                                            block_t=16, block_v=64)
+
+        def gold(x, w):
+            return _materialized(x, w, labels, smoothing=smoothing)
+
+        np.testing.assert_allclose(np.asarray(fused(x, w)),
+                                   np.asarray(gold(x, w)), **FP32_TOL)
+        gp = jax.grad(lambda x, w: jnp.sum(fused(x, w)), argnums=(0, 1))(
+            x, w)
+        gg = jax.grad(lambda x, w: jnp.sum(gold(x, w)), argnums=(0, 1))(
+            x, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_padding_idx_and_weighted_cotangent(self, rng):
+        T, H, V = 16, 64, 130
+        pad = 7
+        x = jnp.asarray(rng.normal(size=(T, H)) * 0.3, jnp.float32)
+        w = jnp.asarray(rng.normal(size=(V, H)) * 0.3, jnp.float32)
+        labels = np.asarray(rng.integers(0, V, size=(T,)), np.int32)
+        labels[::3] = pad
+        labels = jnp.asarray(labels)
+        ct = jnp.asarray(rng.normal(size=(T,)), jnp.float32)  # non-unit
+
+        def fused(x, w):
+            with _common.force_impl("pallas"):
+                return linear_cross_entropy(x, w, labels, padding_idx=pad,
+                                            block_t=16, block_v=64)
+
+        def gold(x, w):
+            return _materialized(x, w, labels, padding_idx=pad)
+
+        lf, lg = fused(x, w), gold(x, w)
+        assert np.all(np.asarray(lf)[::3] == 0.0)
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(lg),
+                                   **FP32_TOL)
+        gp = jax.grad(lambda x, w: jnp.sum(fused(x, w) * ct),
+                      argnums=(0, 1))(x, w)
+        gg = jax.grad(lambda x, w: jnp.sum(gold(x, w) * ct),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_num_classes_masks_padded_vocab_rows(self, rng):
+        """W carries Megatron-style lane-padded rows; they must get zero
+        probability mass and zero gradient."""
+        T, H, K, Vp = 16, 64, 100, 128
+        x = jnp.asarray(rng.normal(size=(T, H)) * 0.3, jnp.float32)
+        w = jnp.asarray(rng.normal(size=(Vp, H)) * 0.3, jnp.float32)
+        labels = jnp.asarray(rng.integers(0, K, size=(T,)), jnp.int32)
+
+        def fused(x, w):
+            with _common.force_impl("pallas"):
+                return linear_cross_entropy(x, w, labels, num_classes=K,
+                                            block_t=16, block_v=64)
+
+        def gold(x, w):
+            return _materialized(x, w[:K], labels)
+
+        np.testing.assert_allclose(np.asarray(fused(x, w)),
+                                   np.asarray(gold(x, w)), **FP32_TOL)
+        dw = jax.grad(lambda w: jnp.sum(fused(x, w)))(w)
+        assert np.all(np.asarray(dw)[K:] == 0.0)
+        np.testing.assert_allclose(
+            np.asarray(dw)[:K],
+            np.asarray(jax.grad(lambda w: jnp.sum(gold(x, w)))(w)[:K]),
+            **FP32_TOL)
+
+    def test_bf16_inputs(self, rng):
+        T, H, V = 32, 128, 256
+        x = jnp.asarray(rng.normal(size=(T, H)) * 0.3, jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(V, H)) * 0.3, jnp.bfloat16)
+        labels = jnp.asarray(rng.integers(0, V, size=(T,)), jnp.int32)
+
+        def fused(x, w):
+            with _common.force_impl("pallas"):
+                return linear_cross_entropy(x, w, labels,
+                                            block_t=16, block_v=128)
+
+        lf = fused(x, w)
+        lg = _materialized(x, w, labels)
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(lg),
+                                   rtol=2e-2, atol=2e-2)
+        dx, dw = jax.grad(lambda x, w: jnp.sum(fused(x, w)),
+                          argnums=(0, 1))(x, w)
+        assert dx.dtype == jnp.bfloat16 and dw.dtype == jnp.bfloat16
+        gx, gw = jax.grad(
+            lambda x, w: jnp.sum(_materialized(x, w, labels)),
+            argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(dx, np.float32),
+                                   np.asarray(gx, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+        np.testing.assert_allclose(np.asarray(dw, np.float32),
+                                   np.asarray(gw, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_leading_dims_and_xla_path(self, rng):
+        B, S, H, V = 2, 8, 64, 130
+        x = jnp.asarray(rng.normal(size=(B, S, H)) * 0.3, jnp.float32)
+        w = jnp.asarray(rng.normal(size=(V, H)) * 0.3, jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, size=(B, S)), jnp.int32)
+        with _common.force_impl("xla"):
+            lx = linear_cross_entropy(x, w, labels)
+        with _common.force_impl("pallas"):
+            lp = linear_cross_entropy(x, w, labels, block_t=16, block_v=64)
+        assert lx.shape == (B, S)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(lx),
+                                   **FP32_TOL)
+
+    def test_shape_validation(self, rng):
+        x = jnp.zeros((4, 8))
+        w = jnp.zeros((16, 9))
+        with pytest.raises(ValueError):
+            linear_cross_entropy(x, w, jnp.zeros((4,), jnp.int32))
+        with pytest.raises(ValueError):
+            linear_cross_entropy(x, jnp.zeros((16, 8)),
+                                 jnp.zeros((4,), jnp.int32), num_classes=17)

@@ -67,7 +67,9 @@ def test_param_specs_rules():
     assert by_name["['layer0']['w_down']"] == P("tp", None)
     assert by_name["['layer0']['attn_norm']"] == P()
     assert by_name["['tok_embeddings']"] == P("tp", None)
-    assert by_name["['output']"] == P(None, "tp")
+    # head now stored (V, H) — embedding-table layout for the fused
+    # LM-head+CE kernel; vocab axis still tp-sharded
+    assert by_name["['output']"] == P("tp", None)
 
 
 def test_tp_sharded_forward_matches_single(devices):

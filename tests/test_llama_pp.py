@@ -73,7 +73,7 @@ def test_llama_pipeline_matches_unpartitioned(devices):
                            num_chunks=1)[0]
         from apex1_tpu.ops import rms_norm
         x = rms_norm(x, params["norm"], eps=cfg.norm_eps)
-        logits = x @ params["output"]
+        logits = x @ params["output"].T
         return loss_of_logits(logits, tokens)
 
     pp_loss = jax.jit(jax.shard_map(
