@@ -18,6 +18,14 @@ and ``dW = gᵀ·x`` (token-innermost grid) in fp32 scratch.
 Loss semantics match ``softmax_cross_entropy_loss`` exactly (label
 smoothing ε, ``padding_idx`` rows → zero loss/grad, ``num_classes`` masks
 lane-padded vocab rows of W in-kernel).
+
+**Tensor-parallel form**: a traced ``col_offset`` scalar (SMEM, like the
+ring offsets in ``ops/attention.py``) shifts the global column ids, and
+``shard_stats``/``shard_grads`` expose the per-shard partial statistics /
+gradients so ``transformer.tensor_parallel.cross_entropy ::
+vocab_parallel_linear_cross_entropy`` can merge them across the ``tp``
+axis (pmax/psum) — the Megatron vocab-parallel CE with the head matmul
+fused in, which the reference does not have.
 """
 
 from __future__ import annotations
@@ -47,20 +55,34 @@ def _tile(x_ref, w_ref):
                                preferred_element_type=jnp.float32)
 
 
-def _grad_tile(s, t, lse, col, valid, smoothing, true_k, padding_idx, dl):
+def _cols(s_shape, vi, bv, off, true_v, true_k):
+    """(local col, global col, validity) for one tile. Validity needs BOTH
+    bounds: local (pad rows of this W shard) and global (lane-padded or
+    shard-truncated vocab)."""
+    lcol = jax.lax.broadcasted_iota(jnp.int32, s_shape, 1) + vi * bv
+    gcol = lcol + off
+    return gcol, (lcol < true_v) & (gcol < true_k)
+
+
+def _grad_tile(s, t, lse, gcol, valid, smoothing, true_k, padding_idx, dl):
     """dloss/dlogits for one tile: softmax − (1−ε)·onehot − ε/K, scaled by
     the (padding-masked) upstream cotangent."""
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
-    g = p - (1.0 - smoothing) * (col == t) - smoothing / true_k
+    g = p - (1.0 - smoothing) * (gcol == t) - smoothing / true_k
     g = jnp.where(valid, g, 0.0)
     if padding_idx is not None:
         dl = jnp.where(t == padding_idx, 0.0, dl)
     return g * dl
 
 
-def _fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
-                m_scr, l_scr, tgt_scr, sx_scr, *,
-                smoothing, true_k, padding_idx, bv, n_v):
+def _fwd_kernel(x_ref, w_ref, t_ref, off_ref, *out_and_scratch,
+                smoothing, true_k, true_v, padding_idx, bv, n_v,
+                emit_stats):
+    if emit_stats:
+        m_ref, l_ref, tgt_ref, sx_ref = out_and_scratch[:4]
+    else:
+        loss_ref, lse_ref = out_and_scratch[:2]
+    m_scr, l_scr, tgt_scr, sx_scr = out_and_scratch[-4:]
     vi = pl.program_id(1)
 
     @pl.when(vi == 0)
@@ -72,8 +94,7 @@ def _fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
 
     s = _tile(x_ref, w_ref)
     t = t_ref[...]  # (bt, 1) int32
-    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
-    valid = col < true_k
+    gcol, valid = _cols(s.shape, vi, bv, off_ref[0, 0], true_v, true_k)
     sm = jnp.where(valid, s, NEG_INF)
     m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(sm, axis=1, keepdims=True))
@@ -83,23 +104,30 @@ def _fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
     l_scr[...] = jnp.broadcast_to(l_prev * corr
                                   + jnp.sum(e, axis=1, keepdims=True),
                                   l_scr.shape)
-    tgt_scr[...] += jnp.sum(jnp.where(col == t, s, 0.0), axis=1,
+    tgt_scr[...] += jnp.sum(jnp.where(gcol == t, s, 0.0), axis=1,
                             keepdims=True)
     sx_scr[...] += jnp.sum(jnp.where(valid, s, 0.0), axis=1, keepdims=True)
 
     @pl.when(vi == n_v - 1)
     def _():
-        lse = m_scr[:, :1] + jnp.log(l_scr[:, :1])
-        loss = ((1.0 - smoothing) * (lse - tgt_scr[:, :1])
-                + smoothing * (lse - sx_scr[:, :1] / true_k))
-        if padding_idx is not None:
-            loss = jnp.where(t == padding_idx, 0.0, loss)
-        loss_ref[...] = loss
-        lse_ref[...] = lse
+        if emit_stats:
+            m_ref[...] = m_scr[:, :1]
+            l_ref[...] = l_scr[:, :1]
+            tgt_ref[...] = tgt_scr[:, :1]
+            sx_ref[...] = sx_scr[:, :1]
+        else:
+            lse = m_scr[:, :1] + jnp.log(l_scr[:, :1])
+            loss = ((1.0 - smoothing) * (lse - tgt_scr[:, :1])
+                    + smoothing * (lse - sx_scr[:, :1] / true_k))
+            if padding_idx is not None:
+                loss = jnp.where(t == padding_idx, 0.0, loss)
+            loss_ref[...] = loss
+            lse_ref[...] = lse
 
 
-def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dx_ref, dx_acc, *,
-                   smoothing, true_k, padding_idx, bv, n_v):
+def _bwd_dx_kernel(x_ref, w_ref, t_ref, off_ref, lse_ref, dl_ref,
+                   dx_ref, dx_acc, *,
+                   smoothing, true_k, true_v, padding_idx, bv, n_v):
     vi = pl.program_id(1)
 
     @pl.when(vi == 0)
@@ -107,8 +135,8 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dx_ref, dx_acc, *,
         dx_acc[...] = jnp.zeros_like(dx_acc)
 
     s = _tile(x_ref, w_ref)
-    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
-    g = _grad_tile(s, t_ref[...], lse_ref[...], col, col < true_k,
+    gcol, valid = _cols(s.shape, vi, bv, off_ref[0, 0], true_v, true_k)
+    g = _grad_tile(s, t_ref[...], lse_ref[...], gcol, valid,
                    smoothing, true_k, padding_idx, dl_ref[...])
     w = w_ref[...]
     dx_acc[...] += jax.lax.dot_general(
@@ -120,8 +148,9 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dx_ref, dx_acc, *,
         dx_ref[...] = dx_acc[...].astype(dx_ref.dtype)
 
 
-def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dw_ref, dw_acc, *,
-                   smoothing, true_k, padding_idx, bv, n_t):
+def _bwd_dw_kernel(x_ref, w_ref, t_ref, off_ref, lse_ref, dl_ref,
+                   dw_ref, dw_acc, *,
+                   smoothing, true_k, true_v, padding_idx, bv, n_t):
     vi, ti = pl.program_id(0), pl.program_id(1)  # token axis innermost
 
     @pl.when(ti == 0)
@@ -129,8 +158,8 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, dl_ref, dw_ref, dw_acc, *,
         dw_acc[...] = jnp.zeros_like(dw_acc)
 
     s = _tile(x_ref, w_ref)
-    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + vi * bv
-    g = _grad_tile(s, t_ref[...], lse_ref[...], col, col < true_k,
+    gcol, valid = _cols(s.shape, vi, bv, off_ref[0, 0], true_v, true_k)
+    g = _grad_tile(s, t_ref[...], lse_ref[...], gcol, valid,
                    smoothing, true_k, padding_idx, dl_ref[...])
     x = x_ref[...]
     dw_acc[...] += jax.lax.dot_general(            # gᵀ · x
@@ -183,7 +212,77 @@ def _specs(g, *, for_dw=False):
     stat_spec = pl.BlockSpec((g["bt"], 1),
                              lambda i0, i1: (ix(i0, i1)[0], 0),
                              memory_space=pltpu.VMEM)
-    return x_spec, w_spec, stat_spec
+    off_spec = pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                            memory_space=pltpu.SMEM)
+    return x_spec, w_spec, stat_spec, off_spec
+
+
+def _off_array(off):
+    return jnp.asarray(off, jnp.int32).reshape(1, 1)
+
+
+def shard_stats(x2, w_shard, t2, *, col_offset=0, num_classes=None,
+                block_t=None, block_v=None):
+    """Per-shard online-softmax partials ``(m, l, tgt, sumx)`` — each
+    (T,) fp32 — over the GLOBAL columns ``[col_offset, col_offset + V_l)``
+    this shard's ``w_shard`` (V_l, H) covers. NOT differentiable on its
+    own; the vocab-parallel wrapper owns the VJP."""
+    xp, wp, tp, g = _prep(x2, w_shard, t2, block_t, block_v)
+    k = num_classes if num_classes is not None else g["V"]
+    x_spec, w_spec, stat_spec, off_spec = _specs(g)
+    Tp = g["n_t"] * g["bt"]
+    outs = pl.pallas_call(
+        functools.partial(_fwd_kernel, smoothing=0.0, true_k=k,
+                          true_v=g["V"], padding_idx=None, bv=g["bv"],
+                          n_v=g["n_v"], emit_stats=True),
+        grid=(g["n_t"], g["n_v"]),
+        in_specs=[x_spec, w_spec, stat_spec, off_spec],
+        out_specs=(stat_spec,) * 4,
+        out_shape=(jax.ShapeDtypeStruct((Tp, 1), jnp.float32),) * 4,
+        scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
+        interpret=interpret_mode(),
+    )(xp, wp, tp, _off_array(col_offset))
+    return tuple(o[:g["T"], 0] for o in outs)
+
+
+def shard_grads(x2, w_shard, t2, lse, dloss, *, col_offset=0,
+                smoothing=0.0, padding_idx=None, num_classes=None,
+                block_t=None, block_v=None):
+    """Per-shard gradients given the GLOBAL logsumexp: returns
+    ``(dx_partial, dw_shard)`` — dx must still be summed across shards
+    (each shard only saw its own vocab columns)."""
+    xp, wp, tp, g = _prep(x2, w_shard, t2, block_t, block_v)
+    k = num_classes if num_classes is not None else g["V"]
+    lse_p, _ = pad_to(lse.reshape(-1, 1).astype(jnp.float32), 0, g["bt"])
+    dl, _ = pad_to(dloss.reshape(-1, 1).astype(jnp.float32), 0, g["bt"])
+    off = _off_array(col_offset)
+    kern = dict(smoothing=smoothing, true_k=k, true_v=g["V"],
+                padding_idx=padding_idx, bv=g["bv"])
+
+    x_spec, w_spec, stat_spec, off_spec = _specs(g)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, n_v=g["n_v"], **kern),
+        grid=(g["n_t"], g["n_v"]),
+        in_specs=[x_spec, w_spec, stat_spec, off_spec, stat_spec,
+                  stat_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+        scratch_shapes=[pltpu.VMEM((g["bt"], g["Hp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(xp, wp, tp, off, lse_p, dl)[:g["T"], :g["H"]]
+
+    x_spec, w_spec, stat_spec, off_spec = _specs(g, for_dw=True)
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, n_t=g["n_t"], **kern),
+        grid=(g["n_v"], g["n_t"]),
+        in_specs=[x_spec, w_spec, stat_spec, off_spec, stat_spec,
+                  stat_spec],
+        out_specs=w_spec,
+        out_shape=jax.ShapeDtypeStruct(wp.shape, w_shard.dtype),
+        scratch_shapes=[pltpu.VMEM((g["bv"], g["Hp"]), jnp.float32)],
+        interpret=interpret_mode(),
+    )(xp, wp, tp, off, lse_p, dl)[:g["V"], :g["H"]]
+    return dx, dw
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -197,53 +296,30 @@ def _fused_fwd(x2, weight, t2, smoothing, padding_idx, num_classes,
                block_t, block_v):
     xp, wp, tp, g = _prep(x2, weight, t2, block_t, block_v)
     k = num_classes if num_classes is not None else g["V"]
-    x_spec, w_spec, stat_spec = _specs(g)
+    x_spec, w_spec, stat_spec, off_spec = _specs(g)
     Tp = g["n_t"] * g["bt"]
     loss, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, smoothing=smoothing, true_k=k,
-                          padding_idx=padding_idx, bv=g["bv"], n_v=g["n_v"]),
+                          true_v=g["V"], padding_idx=padding_idx,
+                          bv=g["bv"], n_v=g["n_v"], emit_stats=False),
         grid=(g["n_t"], g["n_v"]),
-        in_specs=[x_spec, w_spec, stat_spec],
+        in_specs=[x_spec, w_spec, stat_spec, off_spec],
         out_specs=(stat_spec, stat_spec),
         out_shape=(jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
                    jax.ShapeDtypeStruct((Tp, 1), jnp.float32)),
         scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
         interpret=interpret_mode(),
-    )(xp, wp, tp)
-    return loss[:g["T"], 0], (x2, weight, t2, lse)
+    )(xp, wp, tp, _off_array(0))
+    return loss[:g["T"], 0], (x2, weight, t2, lse[:g["T"], 0])
 
 
 def _fused_bwd(smoothing, padding_idx, num_classes, block_t, block_v,
                res, dloss):
     x2, weight, t2, lse = res
-    xp, wp, tp, g = _prep(x2, weight, t2, block_t, block_v)
-    k = num_classes if num_classes is not None else g["V"]
-    dl, _ = pad_to(dloss.reshape(-1, 1).astype(jnp.float32), 0, g["bt"])
-    kern = dict(smoothing=smoothing, true_k=k, padding_idx=padding_idx,
-                bv=g["bv"])
-
-    x_spec, w_spec, stat_spec = _specs(g)
-    dx = pl.pallas_call(
-        functools.partial(_bwd_dx_kernel, n_v=g["n_v"], **kern),
-        grid=(g["n_t"], g["n_v"]),
-        in_specs=[x_spec, w_spec, stat_spec, stat_spec, stat_spec],
-        out_specs=x_spec,
-        out_shape=jax.ShapeDtypeStruct(xp.shape, x2.dtype),
-        scratch_shapes=[pltpu.VMEM((g["bt"], g["Hp"]), jnp.float32)],
-        interpret=interpret_mode(),
-    )(xp, wp, tp, lse, dl)[:g["T"], :g["H"]]
-
-    x_spec, w_spec, stat_spec = _specs(g, for_dw=True)
-    dw = pl.pallas_call(
-        functools.partial(_bwd_dw_kernel, n_t=g["n_t"], **kern),
-        grid=(g["n_v"], g["n_t"]),
-        in_specs=[x_spec, w_spec, stat_spec, stat_spec, stat_spec],
-        out_specs=w_spec,
-        out_shape=jax.ShapeDtypeStruct(wp.shape, weight.dtype),
-        scratch_shapes=[pltpu.VMEM((g["bv"], g["Hp"]), jnp.float32)],
-        interpret=interpret_mode(),
-    )(xp, wp, tp, lse, dl)[:g["V"], :g["H"]]
-
+    dx, dw = shard_grads(x2, weight, t2, lse, dloss,
+                         smoothing=smoothing, padding_idx=padding_idx,
+                         num_classes=num_classes,
+                         block_t=block_t, block_v=block_v)
     f0 = np.zeros(t2.shape, dtype=jax.dtypes.float0)
     return dx, dw, f0
 

@@ -18,7 +18,7 @@ from apex1_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
     vocab_parallel_embedding,
 )
 from apex1_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
-    vocab_parallel_cross_entropy,
+    vocab_parallel_cross_entropy, vocab_parallel_linear_cross_entropy,
 )
 from apex1_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     RNGStatesTracker, checkpoint, get_rng_tracker, model_parallel_seed)
