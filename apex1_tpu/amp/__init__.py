@@ -157,7 +157,8 @@ class Amp:
         """≙ ``amp.state_dict()`` — loss-scaler state for checkpointing."""
         return {"loss_scale": state.loss_scale.scale,
                 "growth_count": state.loss_scale.growth_count,
-                "overflow_count": state.loss_scale.overflow_count}
+                "overflow_count": state.loss_scale.overflow_count,
+                "hysteresis_left": state.loss_scale.hysteresis_left}
 
     def load_state_dict(self, state: AmpState, sd) -> AmpState:
         return dataclasses.replace(
@@ -166,7 +167,11 @@ class Amp:
                 scale=jnp.asarray(sd["loss_scale"], jnp.float32),
                 growth_count=jnp.asarray(sd["growth_count"], jnp.int32),
                 overflow_count=jnp.asarray(sd["overflow_count"],
-                                           jnp.int32)))
+                                           jnp.int32),
+                hysteresis_left=jnp.asarray(
+                    sd.get("hysteresis_left",
+                           getattr(self.scaler, "hysteresis", 1)),
+                    jnp.int32)))
 
 
 def initialize(params, tx, opt_level: str = "O1", **overrides):

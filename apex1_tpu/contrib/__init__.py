@@ -17,6 +17,7 @@ Documented N/A on TPU (SURVEY.md §2.3): ``nccl_allocator`` (NVLS/SHARP),
 (2:4 structured sparsity — no TPU sparse units).
 """
 
+from apex1_tpu.contrib import openfold  # noqa: F401
 from apex1_tpu.contrib.focal_loss import focal_loss  # noqa: F401
 from apex1_tpu.contrib.group_norm import GroupNorm, group_norm  # noqa: F401
 from apex1_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401

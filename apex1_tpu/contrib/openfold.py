@@ -1,0 +1,76 @@
+"""OpenFold kernels — reference ``apex/contrib/openfold_triton/`` (the one
+*Triton* component of the reference: ``_layer_norm_*.py`` fwd/bwd LN,
+``mha.py :: _attention_core`` (softmax(s·q·kᵀ + bias₁ + bias₂)·v with
+sigmoid gating), ``fused_adam_swa.py``, and the DAP — dynamic axial
+parallelism — host glue).
+
+TPU-native mapping: the LN capability IS ``ops.layer_norm`` (same Pallas
+kernel as the core FusedLayerNorm); the Evoformer attention core is the
+pair-bias attention below (two additive biases — XLA fuses the bias adds
+into the softmax; for long sequences the flash kernel can't take dense
+pair biases, which matches the reference: its triton MHA also materializes
+the (…, S, S) bias); SwiGLU is an XLA one-fusion composite; DAP ≙
+``parallel.halo``/``parallel.ring_attention`` over a mesh axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.ops import NEG_INF
+from apex1_tpu.ops import layer_norm as _layer_norm_op
+from apex1_tpu.ops.softmax import scaled_masked_softmax
+
+__all__ = ["layer_norm", "attention_core", "swiglu", "swish"]
+
+
+def layer_norm(x, gamma, beta, *, eps: float = 1e-5):
+    """``openfold_triton._layer_norm_config :: LayerNormSmallShapeOptImpl``
+    capability — dispatches to the framework LN kernel (Pallas on TPU)."""
+    return _layer_norm_op(x, gamma, beta, eps=eps)
+
+
+def attention_core(q, k, v, *, bias1=None, bias2=None, mask=None,
+                   gate=None, sm_scale: Optional[float] = None):
+    """Evoformer attention — ``openfold_triton/mha.py :: _attention_core``:
+
+        out = softmax(scale·q·kᵀ [+ bias1] [+ bias2] [+ mask·-inf]) · v
+        [out = out * sigmoid(gate)]            (row-gating, MSA attention)
+
+    Shapes: ``q``/``k``/``v`` (..., H, S, D); ``bias1`` broadcastable to
+    (..., 1, 1, S) (MSA row mask bias), ``bias2`` to (..., 1, S, S)
+    (pair bias); ``mask`` boolean, True = attend. fp32 softmax.
+    """
+    scale = (1.0 / math.sqrt(q.shape[-1]) if sm_scale is None
+             else float(sm_scale))
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias1 is not None:
+        s = s + bias1.astype(jnp.float32)
+    if bias2 is not None:
+        s = s + bias2.astype(jnp.float32)
+    if mask is not None:
+        # boolean convention (True = attend) -> additive NEG_INF, the
+        # convention scaled_masked_softmax expects
+        s = jnp.where(mask, s, NEG_INF)
+    p = scaled_masked_softmax(s, None, scale=1.0)
+    out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+    if gate is not None:
+        out = out * jax.nn.sigmoid(gate.astype(out.dtype))
+    return out
+
+
+def swish(x):
+    """SiLU — ``openfold_triton/swish.py`` capability (XLA fuses it into
+    the surrounding matmul epilogue; no kernel needed on TPU)."""
+    return jax.nn.silu(x)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """Gated-SiLU MLP: ``silu(x·Wg) ⊙ (x·Wu) · Wd`` — one XLA fusion
+    group between the three matmuls."""
+    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down

@@ -38,6 +38,11 @@ class LossScaleState:
     scale: jnp.ndarray            # f32 scalar
     growth_count: jnp.ndarray     # i32 scalar: consecutive clean steps
     overflow_count: jnp.ndarray   # i32 scalar: total skipped steps (metrics)
+    # i32 scalar: overflows left before the scale actually halves
+    # (≙ csrc/update_scale_hysteresis.cu's device-side hysteresis counter;
+    # 1 ⇒ classic halve-on-every-overflow)
+    hysteresis_left: jnp.ndarray = dataclasses.field(
+        default_factory=lambda: jnp.int32(1))
 
 
 def all_finite(tree, axis_names: tuple[str, ...] = ()) -> jnp.ndarray:
@@ -87,7 +92,8 @@ class NoOpLossScale(_LossScaleBase):
     def init(self) -> LossScaleState:
         return LossScaleState(scale=jnp.float32(1.0),
                               growth_count=jnp.int32(0),
-                              overflow_count=jnp.int32(0))
+                              overflow_count=jnp.int32(0),
+                              hysteresis_left=jnp.int32(1))
 
     def scale(self, loss, state):
         return loss
@@ -108,7 +114,8 @@ class StaticLossScale(_LossScaleBase):
     def init(self) -> LossScaleState:
         return LossScaleState(scale=jnp.float32(self._scale),
                               growth_count=jnp.int32(0),
-                              overflow_count=jnp.int32(0))
+                              overflow_count=jnp.int32(0),
+                              hysteresis_left=jnp.int32(1))
 
     def adjust(self, state, grads_finite):
         return dataclasses.replace(
@@ -128,35 +135,55 @@ class DynamicLossScale(_LossScaleBase):
                  backoff_factor: float = 0.5,
                  growth_interval: int = 2000,
                  min_loss_scale: float = 1.0,
-                 max_loss_scale: float = 2.0 ** 24):
+                 max_loss_scale: float = 2.0 ** 24,
+                 hysteresis: int = 1):
         self.init_scale = float(init_scale)
         self.growth_factor = float(growth_factor)
         self.backoff_factor = float(backoff_factor)
         self.growth_interval = int(growth_interval)
         self.min_loss_scale = float(min_loss_scale)
         self.max_loss_scale = float(max_loss_scale)
+        self.hysteresis = int(hysteresis)
 
     def init(self) -> LossScaleState:
         return LossScaleState(scale=jnp.float32(self.init_scale),
                               growth_count=jnp.int32(0),
-                              overflow_count=jnp.int32(0))
+                              overflow_count=jnp.int32(0),
+                              hysteresis_left=jnp.int32(self.hysteresis))
 
     def adjust(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        """Reference semantics (``update_scale_hysteresis.cu``): a clean
+        step advances the growth tracker (×growth every
+        ``growth_interval``, which also REFILLS the hysteresis budget);
+        an overflow zeroes the tracker and spends one unit of budget —
+        the scale halves once the budget is exhausted, and KEEPS halving
+        on every further overflow until growth refills it (fast recovery
+        from a far-too-high scale). ``hysteresis=1`` ⇒ the classic
+        ``scaler.py :: LossScaler`` halve-on-every-overflow."""
         grads_finite = jnp.asarray(grads_finite)
         grew = state.growth_count + 1 >= self.growth_interval
         clean_scale = jnp.where(
             grew, state.scale * self.growth_factor, state.scale)
         clean_count = jnp.where(grew, 0, state.growth_count + 1)
+        hys_spent = jnp.maximum(state.hysteresis_left - 1, 0)
+        backoff = (~grads_finite) & (hys_spent <= 0)
         new_scale = jnp.where(
-            grads_finite, clean_scale, state.scale * self.backoff_factor)
+            grads_finite, clean_scale,
+            jnp.where(backoff, state.scale * self.backoff_factor,
+                      state.scale))
         new_scale = jnp.clip(new_scale, self.min_loss_scale,
                              self.max_loss_scale)
+        new_hys = jnp.where(
+            grads_finite,
+            jnp.where(grew, self.hysteresis, state.hysteresis_left),
+            hys_spent)
         return LossScaleState(
             scale=new_scale.astype(jnp.float32),
             growth_count=jnp.where(grads_finite, clean_count, 0)
             .astype(jnp.int32),
             overflow_count=(state.overflow_count
                             + jnp.where(grads_finite, 0, 1)).astype(jnp.int32),
+            hysteresis_left=new_hys.astype(jnp.int32),
         )
 
 

@@ -78,7 +78,8 @@ class TestEndToEnd:
             state, loss_scale=LossScaleState(
                 scale=jnp.float32(2.0 ** 126),
                 growth_count=jnp.int32(0),
-                overflow_count=jnp.int32(0)))
+                overflow_count=jnp.int32(0),
+                hysteresis_left=jnp.int32(1)))
         params_before = jax.tree_util.tree_leaves(state.params)[0]
         state, m = step(state, tokens)
         assert not bool(m["grads_finite"])

@@ -182,3 +182,42 @@ class TestPytree:
         names = []
         pt.named_tree_map(lambda n, x: names.append(n) or x, tree)
         assert names == ["layer/b", "layer/w"] or names == ["layer/w", "layer/b"]
+
+
+class TestHysteresis:
+    """``update_scale_hysteresis.cu`` semantics: the scale halves only when
+    the hysteresis budget is exhausted by overflows; clean steps don't
+    refill the budget (only an actual backoff does)."""
+
+    def test_halves_only_after_budget_exhausted(self):
+        import jax.numpy as jnp
+        from apex1_tpu.core.loss_scale import DynamicLossScale
+        sc = DynamicLossScale(init_scale=1024.0, hysteresis=3,
+                              growth_interval=4)
+        st = sc.init()
+        st = sc.adjust(st, jnp.bool_(False))      # overflow 1
+        assert float(st.scale) == 1024.0 and int(st.hysteresis_left) == 2
+        st = sc.adjust(st, jnp.bool_(True))       # clean: budget unchanged
+        assert int(st.hysteresis_left) == 2
+        st = sc.adjust(st, jnp.bool_(False))      # overflow 2
+        assert float(st.scale) == 1024.0 and int(st.hysteresis_left) == 1
+        st = sc.adjust(st, jnp.bool_(False))      # overflow 3 -> halve
+        assert float(st.scale) == 512.0
+        # exhausted budget does NOT refill on backoff (reference: keeps
+        # halving on every overflow until growth refills it)
+        assert int(st.hysteresis_left) == 0
+        st = sc.adjust(st, jnp.bool_(False))      # overflow 4 -> halve again
+        assert float(st.scale) == 256.0
+        assert int(st.overflow_count) == 4
+        # 4 clean steps -> growth fires: scale x2 AND budget refills
+        for _ in range(4):
+            st = sc.adjust(st, jnp.bool_(True))
+        assert float(st.scale) == 512.0
+        assert int(st.hysteresis_left) == 3
+
+    def test_default_hysteresis_is_classic(self):
+        import jax.numpy as jnp
+        from apex1_tpu.core.loss_scale import DynamicLossScale
+        sc = DynamicLossScale(init_scale=64.0)
+        st = sc.adjust(sc.init(), jnp.bool_(False))
+        assert float(st.scale) == 32.0
