@@ -48,16 +48,20 @@ def attention_core(q, k, v, *, bias1=None, bias2=None, mask=None,
     scale = (1.0 / math.sqrt(q.shape[-1]) if sm_scale is None
              else float(sm_scale))
     s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if bias1 is not None:
-        s = s + bias1.astype(jnp.float32)
-    if bias2 is not None:
-        s = s + bias2.astype(jnp.float32)
+                   k.astype(jnp.float32))
+    # fold both biases and the boolean mask (True = attend) into ONE
+    # additive mask consumed inside the softmax kernel — keeps broadcast
+    # dims size-1 into the kernel instead of materializing a biased
+    # (..., H, S, S) score copy on the XLA side of the kernel boundary
+    add = None
+    for b in (bias1, bias2):
+        if b is not None:
+            b = b.astype(jnp.float32)
+            add = b if add is None else add + b
     if mask is not None:
-        # boolean convention (True = attend) -> additive NEG_INF, the
-        # convention scaled_masked_softmax expects
-        s = jnp.where(mask, s, NEG_INF)
-    p = scaled_masked_softmax(s, None, scale=1.0)
+        neg = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+        add = neg if add is None else add + neg
+    p = scaled_masked_softmax(s, add, scale=scale)
     out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
     if gate is not None:
         out = out * jax.nn.sigmoid(gate.astype(out.dtype))

@@ -143,7 +143,8 @@ class BertPretrain(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types=None, attention_mask=None):
+    def __call__(self, tokens, token_types=None, attention_mask=None,
+                 return_mlm_hidden=False):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         bert = Bert(cfg, name="bert")
@@ -155,32 +156,59 @@ class BertPretrain(nn.Module):
         b = self.param("mlm_ln_bias", nn.initializers.zeros,
                        (cfg.hidden_size,), jnp.float32)
         h = layer_norm(h, g, b)
-        wte = self.variables["params"]["bert"]["word_embeddings"]
         mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
                               (cfg.vocab_size,), jnp.float32)
+        nsp_logits = nn.Dense(2, dtype=dtype, name="nsp")(pooled)
+        if return_mlm_hidden:
+            # fused LM-head+CE path: caller feeds (h, wte, mlm_bias) to
+            # ops.linear_cross_entropy — the (B, S, V) logits never
+            # materialize
+            return h.astype(dtype), nsp_logits.astype(jnp.float32)
+        wte = self.variables["params"]["bert"]["word_embeddings"]
         mlm_logits = jnp.matmul(
             h.astype(dtype), wte.T.astype(dtype),
             preferred_element_type=jnp.float32) + mlm_bias
-        nsp_logits = nn.Dense(2, dtype=dtype, name="nsp")(pooled)
         return mlm_logits, nsp_logits.astype(jnp.float32)
 
 
-def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1):
-    """MLM CE (fused xentropy, ``padding_idx``-masked) + NSP CE.
+def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1,
+                          fuse_head: bool = True):
+    """MLM CE (``padding_idx``-masked, fp32 in-kernel) + NSP CE.
+
+    ``fuse_head=True`` (default) runs the tied MLM head through
+    ``ops.linear_cross_entropy``: the decoder bias is folded into the
+    kernel by appending a ones-column to the hidden states and the bias
+    as one extra weight column, so the (B, S, V) logits never hit HBM.
+    ``False`` keeps the materialized-logits path (the parity gold).
 
     ``batch``: dict with tokens, mlm_labels (ignore_index where unmasked),
     nsp_labels, optional token_types/attention_mask."""
+    from apex1_tpu.ops import linear_cross_entropy
 
     def loss_fn(params, batch):
-        mlm_logits, nsp_logits = model.apply(
-            {"params": params}, batch["tokens"],
-            batch.get("token_types"), batch.get("attention_mask"))
         labels = batch["mlm_labels"]
-        mlm_losses = softmax_cross_entropy_loss(
-            mlm_logits.astype(jnp.float32),
-            jnp.maximum(labels, 0)) * (labels != ignore_index)
-        denom = jnp.maximum(jnp.sum(labels != ignore_index), 1)
-        mlm = jnp.sum(mlm_losses) / denom
+        n_masked = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        if fuse_head:
+            h, nsp_logits = model.apply(
+                {"params": params}, batch["tokens"],
+                batch.get("token_types"), batch.get("attention_mask"),
+                return_mlm_hidden=True)
+            wte = params["bert"]["word_embeddings"].astype(h.dtype)
+            w = jnp.concatenate(
+                [wte, params["mlm_bias"].astype(h.dtype)[:, None]], axis=1)
+            ones = jnp.ones(h.shape[:-1] + (1,), h.dtype)
+            mlm_losses = linear_cross_entropy(
+                jnp.concatenate([h, ones], axis=-1), w, labels,
+                padding_idx=ignore_index)
+            mlm = jnp.sum(mlm_losses) / n_masked
+        else:
+            mlm_logits, nsp_logits = model.apply(
+                {"params": params}, batch["tokens"],
+                batch.get("token_types"), batch.get("attention_mask"))
+            mlm_losses = softmax_cross_entropy_loss(
+                mlm_logits.astype(jnp.float32),
+                jnp.maximum(labels, 0)) * (labels != ignore_index)
+            mlm = jnp.sum(mlm_losses) / n_masked
         nsp = jnp.mean(softmax_cross_entropy_loss(
             nsp_logits, batch["nsp_labels"]))
         return mlm + nsp

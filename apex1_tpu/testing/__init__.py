@@ -1,0 +1,167 @@
+"""Testing harness — reference ``apex/transformer/testing/``
+(``commons.py``, ``distributed_test_base.py :: DistributedTestBase``,
+``standalone_gpt.py``, ``standalone_bert.py``, ``global_vars.py``).
+
+The reference spawns N NCCL processes per test
+(``NcclDistributedTestBase``); the TPU-native harness gets N devices in
+ONE process: ``--xla_force_host_platform_device_count`` yields a virtual
+CPU mesh where every collective (psum/all_gather/ppermute/…) runs for
+real (SURVEY.md §4.2.4). ``tests/conftest.py`` applies
+`force_virtual_cpu_devices` before any backend is initialized.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def force_virtual_cpu_devices(n: int = 8) -> None:
+    """Put N virtual CPU devices under this process — MUST run before the
+    first backend use (≙ ``DistributedTestBase.setUpClass`` spawning its
+    process group). The container's sitecustomize pins
+    ``jax_platforms=axon,cpu`` via jax.config, so the env var alone is
+    not enough — we also override through jax.config."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def set_random_seed(seed: int):
+    """``testing/commons.py :: set_random_seed`` — numpy + a JAX key."""
+    import jax
+
+    np.random.seed(seed)
+    return jax.random.key(seed)
+
+
+def assert_devices(n: int):
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} devices, have {len(devs)} — call "
+            "force_virtual_cpu_devices() before any backend use")
+    return devs[:n]
+
+
+@contextlib.contextmanager
+def distributed_mesh(dp: int = 1, tp: int = 1, pp: int = 1, cp: int = 1):
+    """``DistributedTestBase`` analog: a mesh over virtual devices plus
+    `transformer.parallel_state` initialized to match, torn down after."""
+    from apex1_tpu.transformer import parallel_state
+
+    n = dp * tp * pp * cp
+    devices = assert_devices(n)
+    if parallel_state.model_parallel_is_initialized():
+        have = (parallel_state.get_tensor_model_parallel_world_size(),
+                parallel_state.get_pipeline_model_parallel_world_size())
+        if have != (tp, pp):
+            raise RuntimeError(
+                f"parallel_state already initialized with (tp, pp)={have}"
+                f", requested ({tp}, {pp}) — destroy_model_parallel() "
+                "first (a previous test leaked global state)")
+        yield parallel_state.get_mesh()
+        return
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
+        context_parallel_size=cp, devices=devices)
+    try:
+        yield mesh
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+@dataclasses.dataclass
+class TestArgs:
+    """``testing/global_vars.py`` + ``arguments.py`` analog: the knobs the
+    reference's standalone models read from Megatron global args."""
+
+    micro_batch_size: int = 2
+    global_batch_size: int = 8
+    seq_length: int = 32
+    padded_vocab_size: int = 256
+    num_layers: int = 2
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    seed: int = 1234
+
+
+_GLOBAL_ARGS: Optional[TestArgs] = None
+
+
+def set_global_args(args: TestArgs) -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args() -> TestArgs:
+    """``global_vars.py :: get_args`` — defaults if unset."""
+    return _GLOBAL_ARGS if _GLOBAL_ARGS is not None else TestArgs()
+
+
+def standalone_gpt(args: Optional[TestArgs] = None):
+    """``testing/standalone_gpt.py`` analog: (model, synthetic batch,
+    params, loss_fn) at test scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+
+    a = args or get_args()
+    cfg = GPT2Config.tiny(
+        vocab_size=a.padded_vocab_size, max_seq_len=a.seq_length,
+        num_layers=a.num_layers, num_heads=a.num_attention_heads,
+        hidden_size=a.hidden_size, policy=get_policy("O1"))
+    model = GPT2(cfg)
+    rng = np.random.default_rng(a.seed)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size,
+                     (a.micro_batch_size, a.seq_length)), jnp.int32)
+    params = model.init(jax.random.key(a.seed), tokens)["params"]
+    return model, tokens, params, gpt2_loss_fn(model)
+
+
+def standalone_bert(args: Optional[TestArgs] = None):
+    """``testing/standalone_bert.py`` analog."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.bert import (BertConfig, BertPretrain,
+                                       bert_pretrain_loss_fn)
+
+    a = args or get_args()
+    cfg = BertConfig.tiny(
+        vocab_size=a.padded_vocab_size, max_seq_len=a.seq_length,
+        num_layers=a.num_layers, num_heads=a.num_attention_heads,
+        hidden_size=a.hidden_size, policy=get_policy("O1"))
+    model = BertPretrain(cfg)
+    rng = np.random.default_rng(a.seed)
+    B, S = a.micro_batch_size, a.seq_length
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                              jnp.int32),
+        "mlm_labels": jnp.asarray(
+            np.where(rng.random((B, S)) < 0.15,
+                     rng.integers(0, cfg.vocab_size, (B, S)), -1),
+            jnp.int32),
+        "nsp_labels": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32),
+    }
+    params = model.init(jax.random.key(a.seed), batch["tokens"])["params"]
+    return model, batch, params, bert_pretrain_loss_fn(model)
+
+
+def print_separator(message: str) -> None:
+    """``testing/commons.py :: print_separator``."""
+    print(f"{' ' + message + ' ':-^72}")

@@ -3,26 +3,16 @@
 Reference analogue: ``apex/transformer/testing/distributed_test_base.py``
 spawns N NCCL processes; on JAX a single process with
 ``--xla_force_host_platform_device_count=8`` provides 8 CPU devices for full
-mesh/pjit/shard_map/collective coverage (SURVEY.md §4.2.4).
-
-NOTE: the container's sitecustomize registers the 'axon' TPU platform and
-pins ``jax_platforms=axon,cpu`` via jax.config, so env vars alone don't
-switch backends — we must override through jax.config before any backend
-client is instantiated.
+mesh/pjit/shard_map/collective coverage (SURVEY.md §4.2.4). The mechanism
+(incl. the jax.config override the container's sitecustomize makes
+necessary) lives in `apex1_tpu.testing.force_virtual_cpu_devices`.
 """
 
-import os
+from apex1_tpu.testing import force_virtual_cpu_devices
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-)
+force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_threefry_partitionable", True)
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -46,6 +46,22 @@ class TestBert:
         for leaf in jax.tree.leaves(grads):
             assert np.all(np.isfinite(leaf))
 
+    def test_fused_head_matches_materialized(self):
+        """fuse_head folds the decoder bias into the linear-CE kernel via
+        the ones-column trick — loss and grads must match the
+        materialized-logits gold (incl. wte and mlm_bias grads)."""
+        cfg, model, batch, params = self._mk()
+        fused = bert_pretrain_loss_fn(model, fuse_head=True)
+        gold = bert_pretrain_loss_fn(model, fuse_head=False)
+        lf, gf = jax.value_and_grad(fused)(params, batch)
+        lg, gg = jax.value_and_grad(gold)(params, batch)
+        np.testing.assert_allclose(float(lf), float(lg), rtol=2e-5)
+        for a, b, path in zip(jax.tree.leaves(gf), jax.tree.leaves(gg),
+                              jax.tree_util.tree_flatten_with_path(gf)[0]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+                err_msg=str(path[0]))
+
     def test_padding_does_not_leak(self):
         """Changing pad-token content must not change real-token outputs."""
         cfg = BertConfig.tiny()

@@ -1,0 +1,37 @@
+"""`apex1_tpu.testing` — the importable test harness (≙
+``apex/transformer/testing``): distributed_mesh context, global args,
+standalone test models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex1_tpu import testing
+from apex1_tpu.transformer import parallel_state
+
+
+def test_distributed_mesh_context(devices):
+    parallel_state.destroy_model_parallel()
+    with testing.distributed_mesh(dp=2, tp=2, pp=2) as mesh:
+        assert set(mesh.axis_names) >= {"dp", "tp", "pp"}
+        assert parallel_state.get_tensor_model_parallel_world_size() == 2
+        assert parallel_state.model_parallel_is_initialized()
+    assert not parallel_state.model_parallel_is_initialized()
+
+
+def test_global_args_roundtrip():
+    a = testing.TestArgs(seq_length=16, hidden_size=32)
+    testing.set_global_args(a)
+    try:
+        assert testing.get_args().seq_length == 16
+    finally:
+        testing.set_global_args(None)  # type: ignore[arg-type]
+    assert testing.get_args().seq_length == 32  # defaults restored
+
+
+def test_standalone_models_train_one_step(devices):
+    for build in (testing.standalone_gpt, testing.standalone_bert):
+        model, batch, params, loss_fn = build()
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        assert np.isfinite(float(loss))
+        assert all(np.all(np.isfinite(g)) for g in jax.tree.leaves(grads))

@@ -78,6 +78,22 @@ def profile_ops():
         flash_attention(q, q, q, causal=True).astype(jnp.float32))))
     bench(f"flash attn f+b (B{B} H{H} S{S} D128)", f, q)
 
+    # fused LM-head+CE vs materialized logits+CE at GPT-2 head scale
+    from apex1_tpu.ops import linear_cross_entropy
+    h2 = jnp.asarray(rng.normal(size=(B * S, hid)) * 0.3, jnp.bfloat16)
+    w2 = jnp.asarray(rng.normal(size=(V, hid)) * 0.3, jnp.bfloat16)
+    f = jax.jit(jax.grad(lambda h, w: jnp.sum(linear_cross_entropy(
+        h, w, lbl, num_classes=50257)), argnums=(0, 1)))
+    bench(f"fused linear+CE f+b ({B*S}x{hid}x{V})", f, h2, w2)
+
+    def unfused(h, w):
+        logits = jnp.einsum("th,vh->tv", h, w,
+                            preferred_element_type=jnp.float32)
+        return jnp.sum(softmax_cross_entropy_loss(logits, lbl,
+                                                  num_classes=50257))
+    f = jax.jit(jax.grad(unfused, argnums=(0, 1)))
+    bench(f"matmul+xentropy f+b ({B*S}x{hid}x{V})", f, h2, w2)
+
 
 def profile_gpt2():
     from apex1_tpu.amp import Amp
