@@ -51,6 +51,20 @@ def _block(size: int, requested: int) -> int:
     return min(requested, max(16, ((size + 15) // 16) * 16))
 
 
+def _auto_blocks(D, block_q, block_k):
+    """Default block sizes. Small tiles (128×128) make the grid huge and
+    the per-step MXU work tiny — grid/DMA overheads then dominate (measured
+    ~5× on GPT-2 shapes, v5e). Defaults aim for ~2 MiB fp32 score tiles and
+    shrink with the padded head dim so q/k/v blocks + accumulators +
+    double-buffered operands stay inside ~16 MiB VMEM."""
+    Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
+    if block_q is None:
+        block_q = 256 if Dp > 512 else 512
+    if block_k is None:
+        block_k = 512 if Dp > 256 else 1024
+    return block_q, block_k
+
+
 def _mask_for(qi, ki, bq, bk, *, causal, true_sq, true_sk, q_off, k_off,
               qseg, kseg):
     """(bq, bk) validity mask for one score block. Padded rows/cols are
@@ -82,28 +96,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    # native-dtype operands: bf16 inputs ride the MXU's bf16 path with
-    # fp32 accumulation (an fp32 upcast before the dot would run the MXU
-    # ~8x slower); running statistics stay fp32
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
-                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
-                     qseg=qseg, kseg=kseg)
-    s = jnp.where(mask, s, NEG_INF)
-    m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    corr = jnp.exp(m_prev - m_new)
-    e = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-    l_new = l_prev * corr + jnp.sum(e, axis=1, keepdims=True)
-    v = v_ref[0, 0]
-    acc[...] = acc[...] * corr + jax.lax.dot_general(
-        e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        # native-dtype operands: bf16 inputs ride the MXU's bf16 path with
+        # fp32 accumulation (an fp32 upcast before the dot would run the MXU
+        # ~8x slower); running statistics stay fp32
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                         true_sk=true_sk, q_off=qo_ref[0, 0],
+                         k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        e = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_prev * corr + jnp.sum(e, axis=1, keepdims=True)
+        v = v_ref[0, 0]
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip blocks entirely above the diagonal (no valid positions):
+        # saves the strictly-upper-triangular ~half of the MXU work
+        pl.when((ki * bk + ko_ref[0, 0])
+                <= (qi * bq + bq - 1 + qo_ref[0, 0]))(compute)
+    else:
+        compute()
 
     @pl.when(ki == n_k - 1)
     def _():
@@ -131,22 +154,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     def _():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
-                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
-                     qseg=qseg, kseg=kseg)
-    p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
-    do = do_ref[0, 0]
-    v = v_ref[0, 0]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
-    dq_acc[...] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                         true_sk=true_sk, q_off=qo_ref[0, 0],
+                         k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+        do = do_ref[0, 0]
+        v = v_ref[0, 0]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((ki * bk + ko_ref[0, 0])
+                <= (qi * bq + bq - 1 + qo_ref[0, 0]))(compute)
+    else:
+        compute()
 
     @pl.when(ki == n_k - 1)
     def _():
@@ -170,25 +200,32 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
-                     true_sk=true_sk, q_off=qo_ref[0, 0], k_off=ko_ref[0, 0],
-                     qseg=qseg, kseg=kseg)
-    p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
-    do = do_ref[0, 0]
-    v = v_ref[0, 0]
-    dv_acc[...] += jax.lax.dot_general(                      # pᵀ · do
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
-    dk_acc[...] += jax.lax.dot_general(                      # dsᵀ · q
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                         true_sk=true_sk, q_off=qo_ref[0, 0],
+                         k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+        do = do_ref[0, 0]
+        v = v_ref[0, 0]
+        dv_acc[...] += jax.lax.dot_general(                  # pᵀ · do
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
+        dk_acc[...] += jax.lax.dot_general(                  # dsᵀ · q
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi * bq + bq - 1 + qo_ref[0, 0])
+                >= (ki * bk + ko_ref[0, 0]))(compute)
+    else:
+        compute()
 
     @pl.when(qi == n_q - 1)
     def _():
@@ -405,8 +442,13 @@ def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
         mask &= ((col + k_off) <= (row + q_off))[None, None]
     if qseg is not None:
         mask &= (qseg[:, None, :, None] == kseg[:, None, None, :])
-    m = jnp.max(jnp.where(mask, s, NEG_INF), axis=-1, keepdims=True)
-    e = jnp.where(mask, jnp.exp(s - m), 0.0)
+    # masked scores (not raw s) inside exp: for rows with NO valid keys
+    # m == NEG_INF and exp(s - m) would overflow to inf, poisoning the VJP
+    # with inf·0 = NaN; exp(sm - m) is exp(0) = 1 there (then zeroed), and
+    # the inner where blocks the masked-branch gradient entirely
+    sm = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(sm, axis=-1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(sm - m), 0.0)
     l = jnp.sum(e, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bhkd->bhqd", e / jnp.where(l > 0, l, 1.0),
                      v.astype(jnp.float32)).astype(q.dtype)
@@ -431,7 +473,7 @@ def _norm_segments(segment_ids, Sq, Sk):
 
 def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                     sm_scale: float | None = None, q_offset=0, k_offset=0,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int | None = None, block_k: int | None = None,
                     return_lse: bool = False):
     """Flash attention over (B, H, S, D) operands.
 
@@ -450,6 +492,7 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                          f"Hkv={k.shape[1]}")
     scale = (1.0 / float(np.sqrt(q.shape[-1]))
              if sm_scale is None else float(sm_scale))
+    block_q, block_k = _auto_blocks(q.shape[3], block_q, block_k)
     has_segs, qseg, kseg = _norm_segments(segment_ids, q.shape[2],
                                           k.shape[2])
     if use_pallas():

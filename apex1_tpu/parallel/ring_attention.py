@@ -51,7 +51,7 @@ def _merge(out_a, lse_a, out_b, lse_b):
 
 def ring_attention(q, k, v, axis_name, *, causal: bool = False,
                    sm_scale: float | None = None, segment_ids=None,
-                   block_q: int = 128, block_k: int = 128):
+                   block_q: int | None = None, block_k: int | None = None):
     """Attention over a sequence sharded on mesh axis ``axis_name``.
 
     ``q``: local shard (B, Hq, S_local, D); ``k``/``v``: (B, Hkv, S_local,

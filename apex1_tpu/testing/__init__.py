@@ -63,15 +63,12 @@ def distributed_mesh(dp: int = 1, tp: int = 1, pp: int = 1, cp: int = 1):
     n = dp * tp * pp * cp
     devices = assert_devices(n)
     if parallel_state.model_parallel_is_initialized():
-        have = (parallel_state.get_tensor_model_parallel_world_size(),
-                parallel_state.get_pipeline_model_parallel_world_size())
-        if have != (tp, pp):
-            raise RuntimeError(
-                f"parallel_state already initialized with (tp, pp)={have}"
-                f", requested ({tp}, {pp}) — destroy_model_parallel() "
-                "first (a previous test leaked global state)")
-        yield parallel_state.get_mesh()
-        return
+        # never adopt leaked state: a (tp, pp) match says nothing about
+        # dp/cp, and the documented postcondition (torn down on exit)
+        # could not hold for state this context didn't create
+        raise RuntimeError(
+            "parallel_state already initialized — a previous test leaked "
+            "global state; call destroy_model_parallel() first")
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
         context_parallel_size=cp, devices=devices)

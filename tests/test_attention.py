@@ -157,3 +157,26 @@ def test_fmha_packed(rng):
         want = fmha(qkv, causal=True)
     assert got.shape == (B, S, H, D)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("q_off,k_off", [(0, 0), (32, 0), (0, 32), (48, 16)])
+def test_multiblock_causal_skip(rng, q_off, k_off):
+    """Small explicit blocks force a multi-block grid so the causal
+    block-skip predicate (fully-above-diagonal blocks bypassed) is
+    exercised on every class of block: skipped, diagonal-partial, and
+    fully-live — including shifted diagonals from ring-style offsets."""
+    q, k, v = _qkv(rng, Sq=96, Sk=96)
+    kw = dict(causal=True, q_offset=q_off, k_offset=k_off,
+              block_q=16, block_k=32)
+
+    def loss(impl):
+        def f(q, k, v):
+            with force_impl(impl):
+                out = flash_attention(q, k, v, **kw)
+            return jnp.sum(jnp.square(out.astype(jnp.float32)))
+        return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    (lp, gp), (lx, gx) = loss("pallas"), loss("xla")
+    np.testing.assert_allclose(lp, lx, rtol=1e-5)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

@@ -51,6 +51,13 @@ class TestBert:
         the ones-column trick — loss and grads must match the
         materialized-logits gold (incl. wte and mlm_bias grads)."""
         cfg, model, batch, params = self._mk()
+        # init gives mlm_bias == 0, which would test the bias fold only at
+        # the one point where any scaling/rounding mistake vanishes — use
+        # trained-checkpoint-magnitude values
+        rng = np.random.default_rng(3)
+        params = dict(params)
+        params["mlm_bias"] = jnp.asarray(
+            rng.normal(size=params["mlm_bias"].shape) * 2.0, jnp.float32)
         fused = bert_pretrain_loss_fn(model, fuse_head=True)
         gold = bert_pretrain_loss_fn(model, fuse_head=False)
         lf, gf = jax.value_and_grad(fused)(params, batch)

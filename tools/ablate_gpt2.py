@@ -1,0 +1,64 @@
+"""Ablation timing for the GPT-2 headline bench — localize the bottleneck.
+
+Times per-step seconds for variants of the config-1 recipe on the current
+backend. Each timed region rides one dispatch (bench.timed_steps).
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from bench import timed_steps  # noqa: E402
+
+from apex1_tpu.amp import Amp  # noqa: E402
+from apex1_tpu.core.policy import get_policy  # noqa: E402
+from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn  # noqa: E402
+from apex1_tpu.optim.fused_adam import fused_adam  # noqa: E402
+
+B, S, iters = 8, 1024, 8
+tokens = jnp.asarray(
+    np.random.default_rng(0).integers(0, 50257, (B, S)), jnp.int32)
+
+
+def run(name, use_flash, fuse_head, opt_level="O2"):
+    cfg = GPT2Config(policy=get_policy(opt_level), use_flash=use_flash)
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
+    amp = Amp(tx=fused_adam(1e-4, weight_decay=0.01), opt_level=opt_level)
+    state = amp.init(params)
+    step = amp.make_train_step(gpt2_loss_fn(model, fuse_head=fuse_head))
+    t0 = time.time()
+    _, _, per_step = timed_steps(step, state, (tokens,), iters)
+    print(f"{name:40s} {per_step*1e3:8.1f} ms/step  "
+          f"{B*S/per_step:9.0f} tok/s  (compile+run {time.time()-t0:.0f}s)",
+          flush=True)
+    return per_step
+
+
+def fwd_only(name, use_flash, fuse_head):
+    cfg = GPT2Config(policy=get_policy("O2"), use_flash=use_flash)
+    model = GPT2(cfg)
+    params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
+    loss_fn = gpt2_loss_fn(model, fuse_head=fuse_head)
+
+    def step(params, tokens):
+        return params, {"loss": loss_fn(params, tokens)}
+
+    t0 = time.time()
+    _, _, per_step = timed_steps(step, params, (tokens,), iters)
+    print(f"{name:40s} {per_step*1e3:8.1f} ms/step  "
+          f"(compile+run {time.time()-t0:.0f}s)", flush=True)
+
+
+print(f"backend={jax.default_backend()}", flush=True)
+run("O2 flash fused-head (= bench)", True, True)
+run("O2 xla-attn fused-head", False, True)
+run("O2 flash materialized-logits", True, False)
+run("O2 xla-attn materialized-logits", False, False)
+run("O3(bf16) flash fused-head", True, True, "O3")
+fwd_only("fwd-only flash fused-head", True, True)
+fwd_only("fwd-only xla-attn fused-head", False, True)

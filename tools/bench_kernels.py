@@ -1,0 +1,132 @@
+"""Microbenchmarks for the Pallas kernels on the current backend.
+
+Times fwd and fwd+bwd for flash attention and linear_cross_entropy across
+block sizes, against their XLA-composite golds. Prints immediately
+(unbuffered) — safe to tail.
+
+Usage: python tools/bench_kernels.py [attn|xent|all] [--gpt2|--llama]
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, iters=20):
+    fn2 = jax.jit(fn)
+    out = fn2(*args)
+    jax.block_until_ready(out)
+    # single-dispatch loop to hide tunnel latency
+    def many(n, args):
+        def body(_, acc):
+            o = fn2(*args)
+            return jax.tree.map(lambda a, b: a + b.astype(a.dtype) * 0, acc,
+                                o) if False else o
+        return jax.lax.fori_loop(0, n, lambda i, c: fn2(*args), fn2(*args))
+    manyj = jax.jit(many, static_argnums=0)
+    out = manyj(iters, args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = manyj(iters, args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / (iters + 1)
+    return dt
+
+
+def bench_attn(shape):
+    from apex1_tpu.ops.attention import _xla_attention, flash_attention
+    B, H, S, D = shape
+    print(f"== flash attention (B,H,S,D)=({B},{H},{S},{D}) causal bf16 ==",
+          flush=True)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.bfloat16)
+
+    def xla_fn(q, k, v):
+        return _xla_attention(q, k, v, None, None, 0, 0, 0.125, True)
+
+    def xla_grad(q, k, v):
+        return jax.grad(lambda q, k, v: jnp.sum(
+            xla_fn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+
+    dt = timeit(xla_fn, q, k, v)
+    print(f"  xla fwd                  {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(xla_grad, q, k, v)
+    print(f"  xla fwd+bwd              {dt*1e3:8.2f} ms", flush=True)
+
+    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512),
+                   (512, 1024), (1024, 1024)]:
+        if bq > S or bk > S:
+            continue
+        f = functools.partial(flash_attention, causal=True,
+                              block_q=bq, block_k=bk)
+        def g(q, k, v):
+            return jax.grad(lambda q, k, v: jnp.sum(
+                f(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+        try:
+            dt = timeit(f, q, k, v)
+            dt2 = timeit(g, q, k, v)
+            print(f"  flash bq={bq:4d} bk={bk:4d}   fwd {dt*1e3:8.2f} ms   "
+                  f"fwd+bwd {dt2*1e3:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"  flash bq={bq} bk={bk}: {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+
+
+def bench_xent(T, H, V):
+    from apex1_tpu.ops.linear_xent import (_xla_linear_xent,
+                                           linear_cross_entropy)
+    print(f"== linear_xent T={T} H={H} V={V} bf16 ==", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, H)) * 0.02, jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.02, jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, V - 300, (T,)), jnp.int32)
+
+    def xla_fn(x, w):
+        return jnp.mean(_xla_linear_xent(x, w, t, 0.0, None, V - 300))
+
+    dt = timeit(xla_fn, x, w)
+    print(f"  xla fwd                  {dt*1e3:8.2f} ms", flush=True)
+    dt = timeit(jax.grad(xla_fn, argnums=(0, 1)), x, w)
+    print(f"  xla fwd+bwd              {dt*1e3:8.2f} ms", flush=True)
+
+    for bt, bv in [(256, 512), (512, 512), (512, 1024), (1024, 1024),
+                   (256, 2048), (512, 2048)]:
+        def f(x, w, bt=bt, bv=bv):
+            return jnp.mean(linear_cross_entropy(
+                x, w, t, num_classes=V - 300, block_t=bt, block_v=bv))
+        try:
+            dt = timeit(f, x, w)
+            dt2 = timeit(jax.grad(f, argnums=(0, 1)), x, w)
+            print(f"  fused bt={bt:4d} bv={bv:4d}   fwd {dt*1e3:8.2f} ms   "
+                  f"fwd+bwd {dt2*1e3:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"  fused bt={bt} bv={bv}: {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("what", nargs="?", default="all",
+                    choices=["attn", "xent", "all"])
+    ap.add_argument("--llama", action="store_true",
+                    help="long-context llama shapes instead of GPT-2")
+    args = ap.parse_args()
+    print(f"backend={jax.default_backend()}", flush=True)
+    if args.llama:
+        attn_shape, xent = (1, 32, 16384, 64), (4096, 2048, 32000)
+    else:
+        attn_shape, xent = (8, 12, 1024, 64), (8184, 768, 50432)
+    if args.what in ("attn", "all"):
+        bench_attn(attn_shape)
+    if args.what in ("xent", "all"):
+        bench_xent(*xent)

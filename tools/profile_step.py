@@ -1,0 +1,105 @@
+"""Capture a jax.profiler trace of one bench config's train step on the
+current backend and print per-op device-time totals (top N).
+
+Usage: python tools/profile_step.py [--config gpt2] [--top 40]
+"""
+
+import argparse
+import glob
+import gzip
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(config):
+    import bench
+    on_accel = jax.default_backend() not in ("cpu",)
+    state, step, batch, units, iters, metric, unit, proxy = \
+        bench.BENCHES[config](on_accel)
+    jstep = jax.jit(step)
+    # compile + warm
+    out = jstep(state, *batch)
+    jax.block_until_ready(out)
+    return jstep, state, batch
+
+
+def parse_xspace(path):
+    """Walk the XSpace proto: planes -> lines -> events; return
+    [(plane_name, line_name, event_name, total_ps, count)] aggregated."""
+    try:
+        from tensorboard_plugin_profile.protobuf import xplane_pb2
+    except ImportError:
+        from xprof.protobuf import xplane_pb2  # type: ignore
+    data = open(path, "rb").read()
+    if path.endswith(".gz"):
+        data = gzip.decompress(data)
+    space = xplane_pb2.XSpace()
+    space.ParseFromString(data)
+    rows = []
+    for plane in space.planes:
+        emeta = {m.id: m.name for m in plane.event_metadata.values()}
+        agg = defaultdict(lambda: [0, 0])
+        for line in plane.lines:
+            for ev in line.events:
+                name = emeta.get(ev.metadata_id, str(ev.metadata_id))
+                a = agg[(line.name, name)]
+                a[0] += ev.duration_ps
+                a[1] += 1
+        for (ln, name), (ps, n) in agg.items():
+            rows.append((plane.name, ln, name, ps, n))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="gpt2")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()}", flush=True)
+    jstep, state, batch = build_step(args.config)
+    print("compiled; tracing...", flush=True)
+
+    tmp = tempfile.mkdtemp(prefix="jaxprof_")
+    with jax.profiler.trace(tmp):
+        for _ in range(args.steps):
+            out = jstep(state, *batch)
+        jax.block_until_ready(out)
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    print(f"trace files: {paths}", flush=True)
+    rows = []
+    for p in paths:
+        rows.extend(parse_xspace(p))
+
+    # device planes only; aggregate across lines by event name
+    dev = defaultdict(lambda: [0, 0])
+    total = 0
+    for plane, line, name, ps, n in rows:
+        if "TPU" in plane or "/device:" in plane or "gpu" in plane.lower():
+            if "XLA Ops" in line or "XLA Op" in line or line.startswith("XLA"):
+                dev[name][0] += ps
+                dev[name][1] += n
+                total += ps
+    if not dev:
+        # fallback: dump line names so we can adapt
+        seen = sorted({(p, l) for p, l, *_ in rows})
+        for p, l in seen[:50]:
+            print("plane/line:", p, "|", l)
+        return
+    print(f"total device op time: {total/1e9/args.steps:.2f} ms/step")
+    items = sorted(dev.items(), key=lambda kv: -kv[1][0])
+    for name, (ps, n) in items[:args.top]:
+        print(f"{ps/1e9/args.steps:9.3f} ms  {n//args.steps:5d}x  "
+              f"{ps/total*100:5.1f}%  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
