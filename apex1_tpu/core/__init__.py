@@ -12,6 +12,14 @@ from apex1_tpu.core.mesh import (  # noqa: F401
     make_mesh,
     local_mesh,
 )
+from apex1_tpu.core.capability import (  # noqa: F401
+    CapabilityError,
+    TpuCapability,
+    detect_generation,
+    get_capability,
+    require,
+    vmem_budget,
+)
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy  # noqa: F401
 from apex1_tpu.core.loss_scale import (  # noqa: F401
     LossScaleState,

@@ -1,0 +1,134 @@
+"""TPU-generation capability table — the gating layer that replaces the
+reference's build-time flag registry.
+
+Reference: ``setup.py`` (≈800 lines) is apex's de-facto feature-flag
+system — every native extension is an opt-in ``--flag`` build gated on the
+CUDA version and compute capability (sm70/80/90 lists per extension), and
+kernels check ``torch.cuda.get_device_capability`` at runtime
+(e.g. fmha requires sm80, head-dim 64). On TPU there is nothing to build —
+Pallas kernels ship with the package and lower through Mosaic for whatever
+chip is attached — so the *capability* that survives is the per-generation
+hardware table: block-shape heuristics read VMEM size, precision policies
+check native-dtype support, and ``require()`` gives contrib modules the
+same "this kernel needs sm80" style guard (as data, not compiled-out code).
+
+Generation detection prefers the explicit ``PALLAS_AXON_TPU_GEN`` env (set
+by the axon tunnel), then ``jax.devices()[0].device_kind``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuCapability:
+    """Public per-generation facts that gate or tune framework behavior."""
+
+    generation: str           # canonical name: "v4", "v5e", "v5p", "v6e"
+    mxu: tuple[int, int]      # systolic array shape
+    vmem_bytes: int           # per-core VMEM the kernel block planner sees
+    hbm_bytes: int            # per-chip HBM
+    hbm_gbps: float           # per-chip HBM bandwidth (GB/s)
+    bf16_tflops: float        # peak dense bf16 TFLOP/s per chip
+    cores_per_chip: int       # TensorCores per chip (megacore counts as 1)
+    ici_axes: int             # torus dimensionality (2 = 2D, 3 = 3D)
+    native_fp8: bool          # fp8 matmul support
+    sparsecore: bool          # embedding SparseCore present
+
+
+_TABLE = {
+    # Public spec-sheet numbers (cloud.google.com/tpu/docs system specs);
+    # vmem_bytes is the conservative planning figure, not a spec claim.
+    "v2": TpuCapability("v2", (128, 128), 16 * 2**20, 16 * 2**30, 600.0,
+                        45.0, 2, 2, False, False),
+    "v3": TpuCapability("v3", (128, 128), 16 * 2**20, 32 * 2**30, 900.0,
+                        123.0, 2, 2, False, False),
+    "v4": TpuCapability("v4", (128, 128), 32 * 2**20, 32 * 2**30, 1200.0,
+                        275.0, 1, 3, False, True),
+    "v5e": TpuCapability("v5e", (128, 128), 32 * 2**20, 16 * 2**30, 819.0,
+                         197.0, 1, 2, False, False),
+    "v5p": TpuCapability("v5p", (128, 128), 64 * 2**20, 95 * 2**30, 2765.0,
+                         459.0, 1, 3, False, True),
+    "v6e": TpuCapability("v6e", (256, 256), 64 * 2**20, 32 * 2**30, 1640.0,
+                         918.0, 1, 2, False, True),
+}
+
+_KIND_PATTERNS = [
+    (re.compile(r"v6e|trillium", re.I), "v6e"),
+    (re.compile(r"v5p", re.I), "v5p"),
+    (re.compile(r"v5 ?lite|v5e", re.I), "v5e"),
+    (re.compile(r"v4", re.I), "v4"),
+    (re.compile(r"v3", re.I), "v3"),
+    (re.compile(r"v2", re.I), "v2"),
+]
+
+
+def _canonical(kind: str) -> str | None:
+    for pat, gen in _KIND_PATTERNS:
+        if pat.search(kind):
+            return gen
+    return None
+
+
+@functools.cache
+def detect_generation() -> str | None:
+    """Best-effort generation of the attached TPU; None off-TPU."""
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if env:
+        got = _canonical(env)
+        if got:
+            return got
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform in ("tpu",) or "TPU" in dev.device_kind:
+            return _canonical(dev.device_kind)
+    except Exception:
+        pass
+    return None
+
+
+def get_capability(generation: str | None = None) -> TpuCapability:
+    """Capability row for ``generation`` (default: detected chip). Off-TPU
+    returns the v5e row — the conservative tuning target the CPU interpret
+    path should agree with."""
+    gen = generation or detect_generation() or "v5e"
+    try:
+        return _TABLE[gen]
+    except KeyError:
+        raise ValueError(
+            f"unknown TPU generation {gen!r}; known: {sorted(_TABLE)}"
+        ) from None
+
+
+class CapabilityError(RuntimeError):
+    """≙ the reference's '<ext> requires compute capability >= sm80'."""
+
+
+def require(feature: str, *, generation: str | None = None) -> None:
+    """Assert the attached chip supports ``feature`` — the runtime analog
+    of setup.py's per-extension sm gating. Features: "fp8", "sparsecore",
+    "ici_3d", "megacore"."""
+    cap = get_capability(generation)
+    ok = {
+        "fp8": cap.native_fp8,
+        "sparsecore": cap.sparsecore,
+        "ici_3d": cap.ici_axes >= 3,
+        "megacore": cap.cores_per_chip == 1,
+    }
+    if feature not in ok:
+        raise ValueError(f"unknown feature {feature!r}; known: {sorted(ok)}")
+    if not ok[feature]:
+        raise CapabilityError(
+            f"feature {feature!r} requires a newer TPU generation than "
+            f"{cap.generation} (≙ apex setup.py sm-arch gate)")
+
+
+def vmem_budget(generation: str | None = None) -> int:
+    """VMEM bytes the Pallas block planners should assume (leaves headroom
+    for Mosaic's own double buffering)."""
+    return get_capability(generation).vmem_bytes // 2

@@ -56,12 +56,16 @@ def _auto_blocks(D, block_q, block_k):
     the per-step MXU work tiny — grid/DMA overheads then dominate (measured
     ~5× on GPT-2 shapes, v5e). Defaults aim for ~2 MiB fp32 score tiles and
     shrink with the padded head dim so q/k/v blocks + accumulators +
-    double-buffered operands stay inside ~16 MiB VMEM."""
+    double-buffered operands stay inside the generation's VMEM budget
+    (`core.capability.vmem_budget` — the runtime analog of the reference's
+    per-sm kernel specialization in csrc/fmha)."""
+    from apex1_tpu.core.capability import vmem_budget
     Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
+    small_vmem = vmem_budget() < 12 * 2**20
     if block_q is None:
-        block_q = 256 if Dp > 512 else 512
+        block_q = 256 if (Dp > 512 or small_vmem) else 512
     if block_k is None:
-        block_k = 512 if Dp > 256 else 1024
+        block_k = 512 if (Dp > 256 or small_vmem) else 1024
     return block_q, block_k
 
 

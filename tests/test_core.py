@@ -221,3 +221,38 @@ class TestHysteresis:
         sc = DynamicLossScale(init_scale=64.0)
         st = sc.adjust(sc.init(), jnp.bool_(False))
         assert float(st.scale) == 32.0
+
+
+class TestCapability:
+    """≙ the reference's setup.py sm-arch gating, as a runtime data table
+    (SURVEY.md §2 #62, §5.6)."""
+
+    def test_table_lookup_and_detection(self):
+        from apex1_tpu.core import capability as cap
+        c = cap.get_capability("v5e")
+        assert c.mxu == (128, 128) and not c.sparsecore
+        assert cap.get_capability("v5p").sparsecore
+        # env PALLAS_AXON_TPU_GEN=v5e is set in this image; on the CPU
+        # harness detection may return None — get_capability defaults v5e
+        assert cap.get_capability().generation in (
+            "v2", "v3", "v4", "v5e", "v5p", "v6e")
+        assert cap.vmem_budget("v5p") > cap.vmem_budget("v3")
+
+    def test_require_gates(self):
+        import pytest as _pytest
+
+        from apex1_tpu.core import capability as cap
+        cap.require("sparsecore", generation="v5p")
+        with _pytest.raises(cap.CapabilityError):
+            cap.require("sparsecore", generation="v5e")
+        with _pytest.raises(cap.CapabilityError):
+            cap.require("ici_3d", generation="v5e")
+        with _pytest.raises(ValueError):
+            cap.require("warp_specialization", generation="v5e")
+
+    def test_unknown_generation(self):
+        import pytest as _pytest
+
+        from apex1_tpu.core import capability as cap
+        with _pytest.raises(ValueError):
+            cap.get_capability("v99")

@@ -4,6 +4,7 @@ Times per-step seconds for variants of the config-1 recipe on the current
 backend. Each timed region rides one dispatch (bench.timed_steps).
 """
 
+import os
 import sys
 import time
 
@@ -11,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import timed_steps  # noqa: E402
 
 from apex1_tpu.amp import Amp  # noqa: E402
