@@ -173,10 +173,13 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, off_ref, lse_ref, dl_ref,
 
 def _auto_blocks(Hp, block_t, block_v):
     """Shrink default blocks so the fp32 accumulators (dx_acc (bt, Hp),
-    dw_acc (bv, Hp)) + operand blocks stay within ~a quarter of VMEM at
-    large hidden sizes (Llama-3 8B: H=4096; 70B: 8192). Explicitly
-    requested blocks are honored as-is."""
-    cap = max(16, (4 * 1024 * 1024) // (4 * Hp) // 16 * 16)  # ≤4 MiB fp32
+    dw_acc (bv, Hp)) + operand blocks stay within ~a quarter of the
+    generation's VMEM budget (`core.capability.vmem_budget`) at large
+    hidden sizes (Llama-3 8B: H=4096; 70B: 8192). Explicitly requested
+    blocks are honored as-is."""
+    from apex1_tpu.core.capability import vmem_budget
+    acc_budget = vmem_budget() // 4
+    cap = max(16, acc_budget // (4 * Hp) // 16 * 16)
     bt = min(block_t, cap) if block_t is not None else min(256, cap)
     bv = min(block_v, cap) if block_v is not None else min(512, cap)
     return bt, bv

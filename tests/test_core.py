@@ -232,11 +232,17 @@ class TestCapability:
         c = cap.get_capability("v5e")
         assert c.mxu == (128, 128) and not c.sparsecore
         assert cap.get_capability("v5p").sparsecore
-        # env PALLAS_AXON_TPU_GEN=v5e is set in this image; on the CPU
-        # harness detection may return None — get_capability defaults v5e
-        assert cap.get_capability().generation in (
-            "v2", "v3", "v4", "v5e", "v5p", "v6e")
         assert cap.vmem_budget("v5p") > cap.vmem_budget("v3")
+
+    def test_env_detection(self, monkeypatch):
+        from apex1_tpu.core import capability as cap
+        monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5p")
+        cap.detect_generation.cache_clear()
+        try:
+            assert cap.detect_generation() == "v5p"
+            assert cap.get_capability().generation == "v5p"
+        finally:
+            cap.detect_generation.cache_clear()
 
     def test_require_gates(self):
         import pytest as _pytest
