@@ -52,6 +52,12 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(path)
+        # gate BEFORE touching any symbol: a stale .so from an older source
+        # must fall back to NumPy, and ctypes raises AttributeError (not
+        # OSError) for missing symbols
+        lib.apex1_runtime_abi_version.restype = ctypes.c_int
+        if lib.apex1_runtime_abi_version() != 2:
+            return None
         i64, vp = ctypes.c_int64, ctypes.c_void_p
         lib.apex1_flatten.argtypes = [ctypes.POINTER(vp),
                                       ctypes.POINTER(i64), i64, vp,
@@ -63,11 +69,17 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), i64, ctypes.c_int]
         lib.apex1_f32_to_bf16.argtypes = [vp, vp, i64, ctypes.c_int]
         lib.apex1_bf16_to_f32.argtypes = [vp, vp, i64, ctypes.c_int]
-        lib.apex1_runtime_abi_version.restype = ctypes.c_int
-        if lib.apex1_runtime_abi_version() != 1:
-            return None
+        lib.apex1_loader_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          i64, i64, ctypes.c_uint64,
+                                          ctypes.c_int]
+        lib.apex1_loader_open.restype = vp
+        lib.apex1_loader_num_sequences.argtypes = [vp]
+        lib.apex1_loader_num_sequences.restype = i64
+        lib.apex1_loader_next.argtypes = [vp, i64, vp, ctypes.c_int]
+        lib.apex1_loader_next.restype = ctypes.c_int
+        lib.apex1_loader_close.argtypes = [vp]
         return lib
-    except OSError:
+    except (OSError, AttributeError):
         return None
 
 
@@ -176,6 +188,137 @@ def bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
     else:
         out.view(np.uint32)[:] = bits.astype(np.uint32) << 16
     return out
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 over uint64 — must match ``mix64`` in `_runtime.cpp`."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(30)))
+             * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+        x = ((x ^ (x >> np.uint64(27)))
+             * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+        return x ^ (x >> np.uint64(31))
+
+
+class TokenDataset:
+    """Deterministic LM-pretraining batches from a flat binary token file.
+
+    TPU-native design (vs. the reference's stateful torch DataLoader
+    iterators): ``batch_at(step)`` is a pure function of (file, seed,
+    step) — checkpoint/resume stores only the step counter, matching the
+    framework's functional train-state story, and prefetch workers can
+    fetch any step. Shuffling is an exact per-epoch permutation (affine
+    map over the next power of two with cycle-walking — O(1) memory for
+    arbitrarily large corpora). Backed by the memory-mapped native loader
+    in `_runtime.cpp`; the NumPy fallback reproduces the identical
+    permutation bit-for-bit.
+
+    The file is raw little-endian tokens, uint16 (vocab < 65536) or
+    int32/uint32. For next-token training use ``seq_len = S + 1`` and
+    shift in the loss.
+    """
+
+    def __init__(self, path: str, *, seq_len: int, batch_size: int,
+                 dtype=np.uint16, seed: int = 0, shuffle: bool = True):
+        self.path = str(path)
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.itemsize not in (2, 4):
+            raise ValueError("token dtype must be 2 or 4 bytes")
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self._handle = None
+        if _LIB is not None:
+            self._handle = _LIB.apex1_loader_open(
+                self.path.encode(), self.dtype.itemsize, self.seq_len,
+                self.batch_size, ctypes.c_uint64(self.seed),
+                int(self.shuffle))
+        if self._handle:
+            self.num_sequences = int(
+                _LIB.apex1_loader_num_sequences(self._handle))
+            self._tokens = None
+        else:
+            self._tokens = np.memmap(self.path, dtype=self.dtype, mode="r")
+            self.num_sequences = len(self._tokens) // self.seq_len
+        if self.num_sequences < 1:
+            raise ValueError(
+                f"{path}: fewer than one {seq_len}-token sequence")
+        self._pow2 = 1
+        while self._pow2 < self.num_sequences:
+            self._pow2 <<= 1
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def steps_per_epoch(self) -> int:
+        return self.num_sequences // self.batch_size
+
+    def _perm(self, epoch: np.ndarray, i: np.ndarray) -> np.ndarray:
+        """Vectorized epoch permutation — mirrors TokenLoader::perm."""
+        if not self.shuffle:
+            return i.astype(np.int64)
+        seed = np.uint64(self.seed)
+        a = (_mix64(seed ^ _mix64(epoch)) | np.uint64(1))
+        c = _mix64(seed ^ _mix64(epoch ^ np.uint64(0xD1B54A32D192ED03)))
+        m = np.uint64(self._pow2 - 1)
+        x = i.astype(np.uint64)
+        with np.errstate(over="ignore"):
+            x = (a * x + c) & m
+            todo = x >= np.uint64(self.num_sequences)
+            while np.any(todo):
+                x[todo] = (a[todo] * x[todo] + c[todo]) & m
+                todo = x >= np.uint64(self.num_sequences)
+        return x.astype(np.int64)
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """(batch_size, seq_len) int32 tokens of global step ``step``."""
+        if step < 0:
+            raise ValueError("step must be >= 0")
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        if self._handle:
+            rc = _LIB.apex1_loader_next(self._handle, step,
+                                        out.ctypes.data, _N_THREADS)
+            if rc != 0:
+                raise RuntimeError(f"loader_next failed (step={step})")
+            return out
+        g = np.uint64(step) * np.uint64(self.batch_size) + np.arange(
+            self.batch_size, dtype=np.uint64)
+        epoch = g // np.uint64(self.num_sequences)
+        s = self._perm(epoch, g % np.uint64(self.num_sequences))
+        for r in range(self.batch_size):
+            lo = int(s[r]) * self.seq_len
+            out[r] = self._tokens[lo:lo + self.seq_len]
+        return out
+
+    def iter_from(self, step: int = 0) -> Iterator[np.ndarray]:
+        """Endless step-indexed batch stream (wrap in `PrefetchLoader` to
+        overlap host work with device compute)."""
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+    def close(self):
+        if self._handle:
+            _LIB.apex1_loader_close(self._handle)
+            self._handle = None
+        self._tokens = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a flat token file `TokenDataset` can read (little-endian)."""
+    arr = np.asarray(tokens)
+    if arr.dtype.itemsize not in (2, 4):
+        raise ValueError("token dtype must be 2 or 4 bytes")
+    arr.astype(arr.dtype.newbyteorder("<")).tofile(path)
 
 
 class PrefetchLoader:

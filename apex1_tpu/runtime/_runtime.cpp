@@ -129,6 +129,130 @@ void apex1_bf16_to_f32(const uint16_t* src, float* dst, int64_t n,
   });
 }
 
-int apex1_runtime_abi_version() { return 1; }
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Token-dataset loader: memory-mapped LM pretraining data.
+//
+// Reference capability: the examples' input pipelines (imagenet
+// data_prefetcher lineage) generalized to the LM-pretrain configs this
+// framework benches. TPU-native design choice: batches are addressed by
+// STEP INDEX, not by iterator state — `next(step)` is a pure function of
+// (file, seed, step), so checkpoint/resume needs only the step counter
+// (matching the framework's functional checkpoint story) and any worker
+// can prefetch any step. Shuffling is an exact per-epoch permutation via
+// an LCG over the next power of two with cycle-walking (no index table,
+// O(1) memory for arbitrarily large corpora).
+// ---------------------------------------------------------------------------
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// splitmix64 — per-(seed, epoch) parameter derivation.
+uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+struct TokenLoader {
+  const uint8_t* map = nullptr;
+  size_t map_len = 0;
+  int64_t n_tokens = 0;
+  int dtype_size = 0;   // 2 (uint16) or 4 (int32/uint32)
+  int64_t seq_len = 0;
+  int64_t batch = 0;
+  uint64_t seed = 0;
+  int shuffle = 0;
+  int64_t n_seqs = 0;   // sequences per epoch
+  uint64_t pow2 = 1;    // next power of two >= n_seqs
+
+  // exact permutation of [0, n_seqs) for one epoch: affine step over the
+  // pow2 ring, walking past out-of-range points. a must be odd (unit mod
+  // 2^k); a fixed small number of extra walks amortizes to O(1).
+  int64_t perm(uint64_t epoch, uint64_t i) const {
+    if (!shuffle) return static_cast<int64_t>(i);
+    uint64_t a = mix64(seed ^ mix64(epoch)) | 1ull;
+    uint64_t c = mix64(seed ^ mix64(epoch ^ 0xD1B54A32D192ED03ull));
+    uint64_t m = pow2 - 1;
+    uint64_t x = i;
+    do {
+      x = (a * x + c) & m;
+    } while (x >= static_cast<uint64_t>(n_seqs));
+    return static_cast<int64_t>(x);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* apex1_loader_open(const char* path, int dtype_size, int64_t seq_len,
+                        int64_t batch, uint64_t seed, int shuffle) {
+  if ((dtype_size != 2 && dtype_size != 4) || seq_len <= 0 || batch <= 0)
+    return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < dtype_size * seq_len) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);  // mapping keeps the file alive
+  if (map == MAP_FAILED) return nullptr;
+  auto* L = new TokenLoader();
+  L->map = static_cast<const uint8_t*>(map);
+  L->map_len = st.st_size;
+  L->n_tokens = st.st_size / dtype_size;
+  L->dtype_size = dtype_size;
+  L->seq_len = seq_len;
+  L->batch = batch;
+  L->seed = seed;
+  L->shuffle = shuffle;
+  L->n_seqs = L->n_tokens / seq_len;
+  while (static_cast<int64_t>(L->pow2) < L->n_seqs) L->pow2 <<= 1;
+  return L;
+}
+
+int64_t apex1_loader_num_sequences(void* h) {
+  return h ? static_cast<TokenLoader*>(h)->n_seqs : -1;
+}
+
+// Fill out (batch, seq_len) int32 with the tokens of global step `step`.
+// Row r reads epoch-permuted sequence ((step*batch + r) % n_seqs) of epoch
+// ((step*batch + r) / n_seqs). Returns 0 on success.
+int apex1_loader_next(void* h, int64_t step, int32_t* out, int threads) {
+  if (!h || step < 0) return 1;
+  auto* L = static_cast<TokenLoader*>(h);
+  parallel_for(L->batch, threads, [&](int64_t r) {
+    uint64_t g = static_cast<uint64_t>(step) * L->batch + r;
+    uint64_t epoch = g / L->n_seqs;
+    int64_t s = L->perm(epoch, g % L->n_seqs);
+    const uint8_t* src = L->map + s * L->seq_len * L->dtype_size;
+    int32_t* dst = out + r * L->seq_len;
+    if (L->dtype_size == 2) {
+      auto* p = reinterpret_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < L->seq_len; ++i) dst[i] = p[i];
+    } else {
+      std::memcpy(dst, src, L->seq_len * 4);
+    }
+  });
+  return 0;
+}
+
+void apex1_loader_close(void* h) {
+  if (!h) return;
+  auto* L = static_cast<TokenLoader*>(h);
+  munmap(const_cast<uint8_t*>(L->map), L->map_len);
+  delete L;
+}
+
+int apex1_runtime_abi_version() { return 2; }
 
 }  // extern "C"

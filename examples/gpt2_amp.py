@@ -1,12 +1,19 @@
 """GPT-2 mixed-precision training — the amp half of reference
 ``examples/imagenet/main_amp.py`` applied to BASELINE config 1 ("GPT-2
 125M, amp O1 + Adam"): opt-level presets, dynamic loss scaling with
-skip-on-overflow, fused Adam. Synthetic tokens.
+skip-on-overflow, fused Adam. Data rides the native runtime: a
+memory-mapped `TokenDataset` (step-indexed, resumable) behind a
+`PrefetchLoader` (host work + H2D transfer overlapped with device
+compute — the reference prefetcher's side-stream overlap). Without
+``--data`` a synthetic token file is generated.
 
-``python examples/gpt2_amp.py [--opt-level O1|O1_fp16|O2] [--tiny]``
+``python examples/gpt2_amp.py [--opt-level O1|O1_fp16|O2] [--tiny]
+                              [--data tokens.bin]``
 """
 
 import argparse
+import os
+import tempfile
 import time
 
 import jax
@@ -17,6 +24,7 @@ from apex1_tpu.amp import Amp
 from apex1_tpu.core.policy import get_policy
 from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
 from apex1_tpu.optim.fused_adam import fused_adam
+from apex1_tpu.runtime import PrefetchLoader, TokenDataset, write_token_file
 from apex1_tpu.utils.observability import MetricsLogger
 
 
@@ -27,6 +35,8 @@ def main():
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--opt-level", default="O1")
     ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--data", default=None,
+                    help="flat uint16 token file (default: synthetic)")
     args = ap.parse_args()
 
     policy = get_policy(args.opt_level)
@@ -44,15 +54,29 @@ def main():
     step = jax.jit(amp.make_train_step(gpt2_loss_fn(model)),
                    donate_argnums=0)
 
+    data_path = args.data
+    if data_path is None:
+        n_tok = max(args.batch * args.seq * 8, 1 << 18)
+        data_path = os.path.join(
+            tempfile.gettempdir(),
+            f"gpt2_amp_synth_{cfg.vocab_size}_{n_tok}_{os.getuid()}.bin")
+        if not os.path.exists(data_path):
+            write_token_file(data_path, rng.integers(
+                0, cfg.vocab_size, n_tok).astype(np.uint16))
+
     logger = MetricsLogger()
     t0 = time.time()
-    for i in range(args.steps):
-        batch = jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (args.batch, args.seq)),
-            jnp.int32)
-        state, metrics = step(state, batch)
-        if i % 5 == 0 or i == args.steps - 1:
-            logger.log(i, metrics, tokens=args.batch * args.seq)
+    with TokenDataset(data_path, seq_len=args.seq,
+                      batch_size=args.batch) as ds:
+        it = iter(PrefetchLoader(ds.iter_from(0), prefetch=2))
+        try:
+            for i, batch in zip(range(args.steps), it):
+                state, metrics = step(state, jnp.asarray(batch))
+                if i % 5 == 0 or i == args.steps - 1:
+                    logger.log(i, metrics, tokens=args.batch * args.seq)
+        finally:
+            # stop the prefetch worker BEFORE the dataset's mmap goes away
+            it.close()
     jax.block_until_ready(state.params)
     print(f"done in {time.time() - t0:.1f}s; final loss-scale "
           f"{float(state.loss_scale.scale)}, "
