@@ -208,12 +208,15 @@ class FusedScaleMaskSoftmax:
     fused_softmax.py :: FusedScaleMaskSoftmax`` (chooses kernel vs fallback
     via ``is_kernel_available``; here dispatch is `_common.use_pallas`).
 
-    ``attn_mask_type``: "causal" or "padding".
+    ``attn_mask_type``: "causal" or "padding" (or the
+    `transformer.enums.AttnMaskType` enum).
     """
 
-    def __init__(self, attn_mask_type: str = "padding",
+    def __init__(self, attn_mask_type="padding",
                  scale: float | None = None,
                  scaled_masked_softmax_fusion: bool = True):
+        if hasattr(attn_mask_type, "name"):  # AttnMaskType enum
+            attn_mask_type = attn_mask_type.name
         self.attn_mask_type = attn_mask_type
         self.scale = 1.0 if scale is None else scale
         self.fusion = scaled_masked_softmax_fusion

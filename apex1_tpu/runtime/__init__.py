@@ -227,14 +227,22 @@ class TokenDataset:
         self.dtype = np.dtype(dtype)
         if self.dtype.itemsize not in (2, 4):
             raise ValueError("token dtype must be 2 or 4 bytes")
-        self.seed = int(seed)
+        # wrap to uint64 so native (C cast) and NumPy fallback agree for
+        # negative / oversized seeds
+        self.seed = int(seed) & ((1 << 64) - 1)
         self.shuffle = bool(shuffle)
+        self._closed = False
         self._handle = None
+        self._finalizer = None
         if _LIB is not None:
             self._handle = _LIB.apex1_loader_open(
                 self.path.encode(), self.dtype.itemsize, self.seq_len,
                 self.batch_size, ctypes.c_uint64(self.seed),
                 int(self.shuffle))
+            if self._handle:
+                import weakref
+                self._finalizer = weakref.finalize(
+                    self, _LIB.apex1_loader_close, self._handle)
         if self._handle:
             self.num_sequences = int(
                 _LIB.apex1_loader_num_sequences(self._handle))
@@ -275,6 +283,8 @@ class TokenDataset:
 
     def batch_at(self, step: int) -> np.ndarray:
         """(batch_size, seq_len) int32 tokens of global step ``step``."""
+        if self._closed:
+            raise RuntimeError("TokenDataset is closed")
         if step < 0:
             raise ValueError("step must be >= 0")
         out = np.empty((self.batch_size, self.seq_len), np.int32)
@@ -301,9 +311,11 @@ class TokenDataset:
             step += 1
 
     def close(self):
-        if self._handle:
-            _LIB.apex1_loader_close(self._handle)
-            self._handle = None
+        self._closed = True
+        if self._finalizer is not None:
+            self._finalizer()  # idempotent: detaches + closes the handle
+            self._finalizer = None
+        self._handle = None
         self._tokens = None
 
     def __enter__(self):
@@ -382,11 +394,15 @@ class PrefetchLoader:
                 yield item
         finally:
             # consumer stopped early (break/exception): unblock the worker
-            # so it exits instead of pinning the source + buffered batches
+            # and wait until it is actually DEAD — callers (e.g. the
+            # TokenDataset example) may tear down resources the worker
+            # reads (an mmap) right after this returns, so a timed-out
+            # join must not be swallowed
             stop.set()
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            t.join(timeout=5)
+            while t.is_alive():
+                while not q.empty():
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+                t.join(timeout=0.1)

@@ -2,8 +2,13 @@
 Megatron core): parallel topology state, tensor parallelism, pipeline
 schedules, microbatch calculators."""
 
+from apex1_tpu.transformer import enums  # noqa: F401
+from apex1_tpu.transformer import log_util  # noqa: F401
 from apex1_tpu.transformer import parallel_state  # noqa: F401
 from apex1_tpu.transformer import tensor_parallel  # noqa: F401
 from apex1_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex1_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType, AttnType, ModelType)
+from apex1_tpu.transformer.log_util import set_logging_level  # noqa: F401
 from apex1_tpu.transformer.microbatches import (  # noqa: F401
     build_num_microbatches_calculator)

@@ -61,8 +61,12 @@ def main():
             tempfile.gettempdir(),
             f"gpt2_amp_synth_{cfg.vocab_size}_{n_tok}_{os.getuid()}.bin")
         if not os.path.exists(data_path):
-            write_token_file(data_path, rng.integers(
+            # write-then-rename: an interrupted write must never leave a
+            # truncated file at the cached name
+            tmp = f"{data_path}.tmp.{os.getpid()}"
+            write_token_file(tmp, rng.integers(
                 0, cfg.vocab_size, n_tok).astype(np.uint16))
+            os.replace(tmp, data_path)
 
     logger = MetricsLogger()
     t0 = time.time()

@@ -198,3 +198,17 @@ def test_token_dataset_resume_and_iter(tmp_path):
         # step 1, row 0 -> global sequence index step*batch = 2
         np.testing.assert_array_equal(
             seq.batch_at(1)[0], toks[32:48].astype(np.int32))
+
+
+def test_token_dataset_closed_and_seed_wrap(tmp_path):
+    path, _ = _token_file(tmp_path)
+    ds = rt.TokenDataset(path, seq_len=16, batch_size=2, seed=-1)
+    b0 = ds.batch_at(0)
+    # -1 wraps to 2^64-1 identically on native and NumPy paths
+    with rt.TokenDataset(path, seq_len=16, batch_size=2,
+                         seed=(1 << 64) - 1) as same:
+        np.testing.assert_array_equal(b0, same.batch_at(0))
+    ds.close()
+    ds.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        ds.batch_at(0)
