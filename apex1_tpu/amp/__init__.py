@@ -57,7 +57,7 @@ class AmpState:
     step: jnp.ndarray
     params: Any
     opt_state: Any
-    loss_scale: LossScaleState
+    loss_scale: Any  # LossScaleState, or a tuple of them (num_losses > 1)
 
 
 class Amp:
@@ -71,47 +71,92 @@ class Amp:
                  opt_level: str | PrecisionPolicy = "O1",
                  max_grad_norm: float | None = None,
                  grad_psum_axes: tuple[str, ...] = (),
+                 num_losses: int = 1,
+                 cast_model_outputs=None,
+                 min_loss_scale: float | None = None,
+                 max_loss_scale: float | None = None,
                  **policy_overrides):
         self.tx = tx
         self.policy = get_policy(opt_level, **policy_overrides)
         self.scaler = make_loss_scale(self.policy.loss_scale)
+        # ≙ amp.initialize(min_loss_scale=, max_loss_scale=) clamps
+        if min_loss_scale is not None or max_loss_scale is not None:
+            from apex1_tpu.core.loss_scale import DynamicLossScale
+            if not isinstance(self.scaler, DynamicLossScale):
+                raise ValueError("min/max_loss_scale require a dynamic "
+                                 "loss scale")
+            import copy
+            self.scaler = copy.copy(self.scaler)  # never mutate a
+            if min_loss_scale is not None:        # caller-supplied scaler
+                self.scaler.min_loss_scale = float(min_loss_scale)
+            if max_loss_scale is not None:
+                self.scaler.max_loss_scale = float(max_loss_scale)
         self.max_grad_norm = max_grad_norm
         # mesh axes to pmean grads over (shard_map DDP; pjit needs none)
         self.grad_psum_axes = tuple(grad_psum_axes)
+        # ≙ amp.initialize(num_losses=N): independent scaler state per
+        # loss; steps pick one via loss_id (GAN D/G, multi-task)
+        if num_losses < 1:
+            raise ValueError("num_losses must be >= 1")
+        self.num_losses = int(num_losses)
+        # ≙ amp.initialize(cast_model_outputs=dtype) for make_forward
+        self.cast_model_outputs = cast_model_outputs
 
     # -- setup (≙ amp.initialize) ------------------------------------------
     def init(self, params) -> AmpState:
         params = self.policy.cast_to_param(params)
+        ls = (self.scaler.init() if self.num_losses == 1
+              else tuple(self.scaler.init()
+                         for _ in range(self.num_losses)))
         return AmpState(step=jnp.zeros([], jnp.int32),
                         params=params,
                         opt_state=self.tx.init(params),
-                        loss_scale=self.scaler.init())
+                        loss_scale=ls)
+
+    def _get_ls(self, state: AmpState, loss_id: int) -> LossScaleState:
+        if self.num_losses == 1:
+            return state.loss_scale
+        return state.loss_scale[loss_id]
+
+    def _set_ls(self, state_ls, loss_id: int, new: LossScaleState):
+        if self.num_losses == 1:
+            return new
+        return tuple(new if i == loss_id else s
+                     for i, s in enumerate(state_ls))
 
     # -- per-step (≙ scale_loss + optimizer.step) --------------------------
     def make_train_step(self, loss_fn: Callable, *,
-                        has_aux: bool = False) -> Callable:
+                        has_aux: bool = False,
+                        loss_id: int = 0) -> Callable:
         """``loss_fn(params_compute, *batch) -> loss`` (or ``(loss, aux)``).
 
         The returned function is pure — wrap it in ``jax.jit`` / ``pjit`` /
         ``shard_map``. Under data parallelism with pjit, gradient psums come
         from sharding; under shard_map pass ``grad_psum_axes=("dp",)``.
+        ``loss_id`` selects the scaler when ``num_losses > 1``
+        (≙ ``amp.scale_loss(loss, opt, loss_id=i)``).
         """
+        if not 0 <= loss_id < self.num_losses:
+            raise ValueError(f"loss_id {loss_id} outside num_losses="
+                             f"{self.num_losses}")
         policy, scaler = self.policy, self.scaler
 
         def train_step(state: AmpState, *batch):
+            ls = self._get_ls(state, loss_id)
+
             def scaled_loss_fn(master_params):
                 compute_params = policy.cast_to_compute(master_params)
                 out = loss_fn(compute_params, *batch)
                 loss, aux = out if has_aux else (out, None)
                 return scaler.scale(loss.astype(jnp.float32),
-                                    state.loss_scale), (loss, aux)
+                                    ls), (loss, aux)
 
             grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
                 state.params)
             for ax in self.grad_psum_axes:
                 grads = jax.lax.pmean(grads, ax)
                 loss = jax.lax.pmean(loss, ax)  # report the GLOBAL mean
-            grads = scaler.unscale(grads, state.loss_scale)
+            grads = scaler.unscale(grads, ls)
             finite = all_finite(grads, axis_names=self.grad_psum_axes)
             gnorm = global_norm(grads)
             if self.max_grad_norm is not None:
@@ -125,18 +170,19 @@ class Amp:
             new_params = select_tree(finite, new_params, state.params)
             new_opt_state = select_tree(finite, new_opt_state,
                                         state.opt_state)
+            new_ls = scaler.adjust(ls, finite)
             new_state = AmpState(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt_state,
-                loss_scale=scaler.adjust(state.loss_scale, finite),
+                loss_scale=self._set_ls(state.loss_scale, loss_id, new_ls),
             )
             metrics = {
                 "loss": loss.astype(jnp.float32),
                 "grad_norm": gnorm,
-                "loss_scale": state.loss_scale.scale,
+                "loss_scale": ls.scale,
                 "grads_finite": finite,
-                "skipped_steps": new_state.loss_scale.overflow_count,
+                "skipped_steps": new_ls.overflow_count,
             }
             if has_aux:
                 metrics["aux"] = aux
@@ -153,25 +199,63 @@ class Amp:
         """The compute-dtype view the model consumes (O2's fp16 model)."""
         return self.policy.cast_to_compute(state.params)
 
+    def make_forward(self, forward_fn: Callable) -> Callable:
+        """O2-style patched forward for eval/inference: casts params (and
+        float inputs) to the compute dtype, and the outputs to
+        ``cast_model_outputs`` if set
+        (≙ ``_initialize.py :: patch_forward`` + ``cast_model_outputs``)."""
+        policy = self.policy
+
+        def fwd(state_or_params, *inputs):
+            params = (state_or_params.params
+                      if isinstance(state_or_params, AmpState)
+                      else state_or_params)
+            params = policy.cast_to_compute(params)
+            inputs = jax.tree_util.tree_map(
+                lambda x: (x.astype(policy.compute_dtype)
+                           if hasattr(x, "dtype")
+                           and jnp.issubdtype(x.dtype, jnp.floating)
+                           else x), inputs)
+            out = forward_fn(params, *inputs)
+            if self.cast_model_outputs is not None:
+                out = jax.tree_util.tree_map(
+                    lambda x: x.astype(self.cast_model_outputs), out)
+            return out
+
+        return fwd
+
+    @staticmethod
+    def _one_sd(ls: LossScaleState):
+        return {"loss_scale": ls.scale,
+                "growth_count": ls.growth_count,
+                "overflow_count": ls.overflow_count,
+                "hysteresis_left": ls.hysteresis_left}
+
+    def _one_ls(self, sd) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(sd["loss_scale"], jnp.float32),
+            growth_count=jnp.asarray(sd["growth_count"], jnp.int32),
+            overflow_count=jnp.asarray(sd["overflow_count"], jnp.int32),
+            hysteresis_left=jnp.asarray(
+                sd.get("hysteresis_left",
+                       getattr(self.scaler, "hysteresis", 1)),
+                jnp.int32))
+
     def state_dict(self, state: AmpState):
-        """≙ ``amp.state_dict()`` — loss-scaler state for checkpointing."""
-        return {"loss_scale": state.loss_scale.scale,
-                "growth_count": state.loss_scale.growth_count,
-                "overflow_count": state.loss_scale.overflow_count,
-                "hysteresis_left": state.loss_scale.hysteresis_left}
+        """≙ ``amp.state_dict()`` — loss-scaler state for checkpointing
+        (``loss_scaler{i}`` sub-dicts when ``num_losses > 1``, like the
+        reference's per-loss scalers)."""
+        if self.num_losses == 1:
+            return self._one_sd(state.loss_scale)
+        return {f"loss_scaler{i}": self._one_sd(s)
+                for i, s in enumerate(state.loss_scale)}
 
     def load_state_dict(self, state: AmpState, sd) -> AmpState:
-        return dataclasses.replace(
-            state,
-            loss_scale=LossScaleState(
-                scale=jnp.asarray(sd["loss_scale"], jnp.float32),
-                growth_count=jnp.asarray(sd["growth_count"], jnp.int32),
-                overflow_count=jnp.asarray(sd["overflow_count"],
-                                           jnp.int32),
-                hysteresis_left=jnp.asarray(
-                    sd.get("hysteresis_left",
-                           getattr(self.scaler, "hysteresis", 1)),
-                    jnp.int32)))
+        if self.num_losses == 1:
+            return dataclasses.replace(state, loss_scale=self._one_ls(sd))
+        ls = tuple(self._one_ls(sd[f"loss_scaler{i}"])
+                   for i in range(self.num_losses))
+        return dataclasses.replace(state, loss_scale=ls)
 
 
 def initialize(params, tx, opt_level: str = "O1", **overrides):

@@ -16,6 +16,7 @@ import os
 import queue
 import subprocess
 import threading
+import time as _time
 from typing import Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -399,10 +400,20 @@ class PrefetchLoader:
             # reads (an mmap) right after this returns, so a timed-out
             # join must not be swallowed
             stop.set()
-            while t.is_alive():
+            deadline = _time.monotonic() + 60.0
+            while t.is_alive() and _time.monotonic() < deadline:
                 while not q.empty():
                     try:
                         q.get_nowait()
                     except queue.Empty:
                         break
                 t.join(timeout=0.1)
+            if t.is_alive():
+                # a source blocked in next() can never observe `stop`;
+                # warn loudly instead of hanging teardown forever — the
+                # caller must keep resources the worker reads alive
+                import warnings
+                warnings.warn(
+                    "PrefetchLoader worker did not stop within 60s (source "
+                    "blocked?); resources it reads must outlive it",
+                    RuntimeWarning, stacklevel=2)
