@@ -6,6 +6,7 @@ from apex1_tpu.parallel.ddp import (  # noqa: F401
 from apex1_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm, convert_syncbn_model, sync_batch_stats)
 from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
-    distributed_fused_adam, distributed_fused_lamb, shard_opt_state_specs)
+    distributed_fused_adam, distributed_fused_lamb, fsdp_param_specs,
+    shard_opt_state_specs)
 from apex1_tpu.parallel.halo import halo_exchange, spatial_conv2d  # noqa: F401
 from apex1_tpu.parallel.ring_attention import ring_attention  # noqa: F401

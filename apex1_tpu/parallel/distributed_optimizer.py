@@ -48,6 +48,38 @@ def shard_opt_state_specs(opt_state, *, axis=AXIS_FSDP):
     return jax.tree_util.tree_map(spec, opt_state)
 
 
+def fsdp_param_specs(params, *, axis=AXIS_FSDP, min_size: int = 2 ** 12,
+                     divisor: int | None = None):
+    """ZeRO-3 as data: PartitionSpecs sharding one dim of each param over
+    ``axis`` — the largest dim divisible by ``divisor`` (pass the fsdp
+    mesh-axis size to avoid GSPMD shard padding), else simply the largest.
+    With params (and `shard_opt_state_specs` state) handed to pjit this
+    way, GSPMD emits the reference DistributedFusedAdam dataflow —
+    all-gather params before use, reduce-scatter grads, shard-local
+    update — scheduled/overlapped by XLA instead of the reference's side
+    streams and buckets.
+
+    Small params (< ``min_size`` elements) stay replicated: gathering
+    them costs more latency than their shard saves (the same reason the
+    reference packs params into fixed-size blocks before sharding).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def spec(leaf):
+        shape = jnp.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) < min_size:
+            return P()
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        if divisor:
+            divisible = [i for i in order if shape[i] % divisor == 0]
+            d = divisible[0] if divisible else order[0]
+        else:
+            d = order[0]
+        return P(*[axis if i == d else None for i in range(len(shape))])
+
+    return jax.tree_util.tree_map(spec, params)
+
+
 class DistributedAdamState(NamedTuple):
     step: jnp.ndarray
     exp_avg_shard: jnp.ndarray     # (flat/N,) this rank's slice
@@ -57,6 +89,8 @@ class DistributedAdamState(NamedTuple):
 def distributed_fused_adam(
     learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
     adam_w_mode=True, bias_correction=True, *, axis_name=AXIS_FSDP,
+    overlap_grad_sync: bool = True, bucket_cap_mb: float | None = None,
+    process_group_size: int | None = None,
 ):
     """Explicit-dataflow sharded Adam for the shard_map path.
 
@@ -66,11 +100,28 @@ def distributed_fused_adam(
         flat grads --psum_scatter--> grad shard        (≙ bucket RS hooks)
         shard-local fused Adam on (param shard, m, v)  (≙ per-shard kernel)
         updated param shard --all_gather--> new params (≙ AG of shards)
+
+    ``overlap_grad_sync`` / ``bucket_cap_mb`` / ``process_group_size`` are
+    accepted for reference-signature parity
+    (``DistributedFusedAdam(overlap_grad_sync, bucket_cap_mb,
+    process_group_size)``) and stored on the returned object, but have no
+    mechanism here: the XLA latency-hiding scheduler overlaps the RS/AG
+    with compute and chooses transfer granularity itself, and the
+    "process group" is the mesh axis (``axis_name``). They exist so
+    reference configs port 1:1.
     """
     inner = fused_adam(learning_rate, b1, b2, eps, weight_decay,
                        adam_w_mode, bias_correction)
 
+    _ogs, _bcm, _pgs = overlap_grad_sync, bucket_cap_mb, process_group_size
+
     class _DistAdam:
+        # reference-signature knobs, recorded for config round-tripping
+        # (no mechanism on TPU — see docstring)
+        overlap_grad_sync = _ogs
+        bucket_cap_mb = _bcm
+        process_group_size = _pgs
+
         @staticmethod
         def _flat_len(params):
             flat, _ = flatten_tree(params, dtype=jnp.float32)

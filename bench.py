@@ -78,15 +78,15 @@ def _amp_state_step(model_loss_fn, params, lr=1e-4):
     return amp.init(params), amp.make_train_step(model_loss_fn)
 
 
-def bench_gpt2(on_accel):
+def bench_gpt2(on_accel, batch=None, seq=None):
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
 
     if on_accel:
-        B, S, iters = 8, 1024, 10
+        B, S, iters = batch or 8, seq or 1024, 10
         cfg = GPT2Config(policy=get_policy("O2"))
     else:
-        B, S, iters = 2, 128, 3
+        B, S, iters = batch or 2, seq or 128, 3
         cfg = GPT2Config.tiny(policy=get_policy("O2"))
     model = GPT2(cfg)
     tokens = jnp.asarray(
@@ -213,12 +213,19 @@ BENCHES = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="gpt2", choices=sorted(BENCHES))
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override batch size (gpt2 config only)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="override sequence length (gpt2 config only)")
     args = ap.parse_args()
 
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
+    kw = {}
+    if args.config == "gpt2":
+        kw = dict(batch=args.batch, seq=args.seq)
     (state, step, batch, units_per_step, iters, metric, unit,
-     proxy) = BENCHES[args.config](on_accel)
+     proxy) = BENCHES[args.config](on_accel, **kw)
 
     _, _, per_step = timed_steps(step, state, batch, iters)
     rate = units_per_step / per_step

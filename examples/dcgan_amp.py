@@ -5,7 +5,10 @@ amp machinery, ``num_losses=3`` there — errD_real/errD_fake/errG).
 TPU-native shape of the same thing: one `Amp` per network (generator and
 discriminator each carry their own fp32 masters + loss-scale state, as the
 reference allocates one loss-scaler per loss), NHWC conv stacks (TPU conv
-layout), synthetic data.
+layout), synthetic data. The literal-parity alternative — ONE ``Amp`` with
+``num_losses=3`` and ``make_train_step(loss_fn, loss_id=i)`` per loss —
+is also supported (see ``docs/amp.md``); separate Amps per network are the
+cleaner functional design when the two nets have disjoint params.
 
 ``python examples/dcgan_amp.py [--opt-level O2] [--steps N]``
 """
