@@ -1,0 +1,16 @@
+"""Model zoo for the BASELINE configs (the reference has no model zoo —
+its test transformers live in ``apex/transformer/testing/standalone_*``;
+these are the standalone equivalents built from this framework's ops).
+
+Every model ships a ``param_specs`` (TP PartitionSpec rules for GSPMD) and
+a ``tiny()`` config for tests.
+"""
+
+from apex1_tpu.models.bert import (  # noqa: F401
+    Bert, BertConfig, BertPretrain, bert_pretrain_loss_fn)
+from apex1_tpu.models.gpt2 import (  # noqa: F401
+    GPT2, GPT2Config, gpt2_loss_fn)
+from apex1_tpu.models.llama import (  # noqa: F401
+    Llama, LlamaConfig, llama_loss_fn)
+from apex1_tpu.models.resnet import (  # noqa: F401
+    ResNet, ResNetConfig)

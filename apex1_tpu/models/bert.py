@@ -22,6 +22,7 @@ import math
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
 from apex1_tpu.ops import layer_norm, softmax_cross_entropy_loss
@@ -169,6 +170,27 @@ class BertPretrain(nn.Module):
             h.astype(dtype), wte.T.astype(dtype),
             preferred_element_type=jnp.float32) + mlm_bias
         return mlm_logits, nsp_logits.astype(jnp.float32)
+
+
+# Megatron-style TP rules (see parallel/specs.py): qkv/ffn_in column-
+# parallel, attn_out/ffn_out row-parallel, word embeddings (and the tied
+# MLM head + its bias) vocab-sharded; pooler/nsp heads replicated.
+_TP_RULES = (
+    (r"word_embeddings$", P("tp", None)),
+    (r"(position|token_type)_embeddings$", P()),
+    (r"(qkv|ffn_in)/kernel$", P(None, "tp")),
+    (r"(qkv|ffn_in)/bias$", P("tp")),
+    (r"(attn_out|ffn_out)/kernel$", P("tp", None)),
+    (r"(attn_out|ffn_out)/bias$", P()),
+    (r"mlm_bias$", P("tp")),
+)
+
+
+def param_specs(params, *, rules=_TP_RULES, default=P()):
+    """PartitionSpec tree for a Bert/BertPretrain param tree (TP over the
+    ``tp`` mesh axis) — ≙ ``set_tensor_model_parallel_attributes``."""
+    from apex1_tpu.parallel.specs import specs_from_rules
+    return specs_from_rules(params, rules, default=default)
 
 
 def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1,

@@ -19,6 +19,7 @@ import math
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
 from apex1_tpu.ops import (layer_norm, linear_cross_entropy,
@@ -141,6 +142,27 @@ class GPT2(nn.Module):
         # returned over padded_vocab — slice-free; consumers mask with
         # num_classes=cfg.vocab_size (the CE kernel does it in-lane)
         return logits
+
+
+# Megatron-style TP sharding as path-regex rules (see parallel/specs.py):
+# attention qkv + MLP fc_in are column-parallel (output dim sharded, bias
+# sharded with it), proj + fc_out row-parallel (input dim sharded, bias
+# replicated), embeddings vocab-sharded, positions/norms replicated.
+_TP_RULES = (
+    (r"wte$", P("tp", None)),
+    (r"wpe$", P()),
+    (r"(qkv|fc_in)/kernel$", P(None, "tp")),
+    (r"(qkv|fc_in)/bias$", P("tp")),
+    (r"(proj|fc_out)/kernel$", P("tp", None)),
+    (r"(proj|fc_out)/bias$", P()),
+)
+
+
+def param_specs(params, *, rules=_TP_RULES, default=P()):
+    """PartitionSpec tree for a GPT-2 param tree (TP over the ``tp`` mesh
+    axis) — ≙ ``set_tensor_model_parallel_attributes`` as data."""
+    from apex1_tpu.parallel.specs import specs_from_rules
+    return specs_from_rules(params, rules, default=default)
 
 
 def gpt2_loss_fn(model: GPT2, *, fuse_head: bool = True):

@@ -25,7 +25,6 @@ TPU-first design notes:
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Optional
 
 import flax.linen as nn
@@ -184,18 +183,8 @@ def param_specs(params, *, rules=_TP_RULES, default=P()):
     ≙ reference ``set_tensor_model_parallel_attributes`` on
     Column/RowParallelLinear weights — here a spec tree handed to pjit,
     GSPMD inserts the collectives."""
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-
-    def spec_for(path):
-        name = "/".join(str(getattr(p, "key", p)) for p in path)
-        for pat, spec in rules:
-            if re.search(pat, name):
-                return spec
-        return default
-
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(params),
-        [spec_for(path) for path, _ in flat])
+    from apex1_tpu.parallel.specs import specs_from_rules
+    return specs_from_rules(params, rules, default=default)
 
 
 def llama_loss_fn(model: Llama, *, fuse_head: bool = True):

@@ -138,3 +138,13 @@ def SpatialBottleneck(cfg: ResNetConfig, features: int,
     ``spatial_axis_name`` set (one implementation, no divergence)."""
     return Bottleneck(cfg, features, strides=1,
                       spatial_axis_name=spatial_axis_name, **kw)
+
+
+def param_specs(params, *, default=None):
+    """PartitionSpec tree for ResNet — all-replicated: conv nets scale by
+    data parallelism (+ SyncBN stats psum) and by spatial parallelism
+    (`SpatialBottleneck` H-sharding with halo exchange), not by weight
+    sharding. Provided so every model in the zoo exposes the same API."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(lambda _: default or P(), params)

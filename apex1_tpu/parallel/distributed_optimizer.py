@@ -34,18 +34,44 @@ from apex1_tpu.core.pytree import flatten_tree
 from apex1_tpu.optim.fused_adam import fused_adam
 
 
-def shard_opt_state_specs(opt_state, *, axis=AXIS_FSDP):
-    """PartitionSpecs sharding every ≥1-D float leaf of the optimizer state
-    over ``axis`` (dim 0) — ZeRO-1 as data. Scalars stay replicated."""
+def shard_opt_state_specs(opt_state, *, axis=AXIS_FSDP, param_specs=None):
+    """PartitionSpecs for optimizer state — ZeRO-1 as data.
+
+    With ``param_specs`` (the tree `fsdp_param_specs` returned): any
+    sub-tree of ``opt_state`` with the params' structure (optax moment
+    trees: ``exp_avg``, ``exp_avg_sq``, …) gets the params' specs
+    verbatim, so moments shard on the SAME dim as their param and the
+    update stays shard-local (no per-step resharding). Without it, every
+    ≥1-D float leaf shards dim 0. Scalars stay replicated."""
     from jax.sharding import PartitionSpec as P
 
-    def spec(leaf):
+    def dim0(leaf):
         shape = jnp.shape(leaf)
         if len(shape) == 0:
             return P()
         return P(axis, *([None] * (len(shape) - 1)))
 
-    return jax.tree_util.tree_map(spec, opt_state)
+    if param_specs is None:
+        return jax.tree_util.tree_map(dim0, opt_state)
+
+    pstruct = jax.tree_util.tree_structure(
+        param_specs, is_leaf=lambda v: isinstance(v, P))
+
+    def walk(node):
+        try:
+            if jax.tree_util.tree_structure(node) == pstruct:
+                return param_specs
+        except Exception:
+            pass
+        if isinstance(node, dict):
+            return type(node)({k: walk(v) for k, v in node.items()})
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[walk(v) for v in node])
+        if isinstance(node, (tuple, list)):
+            return type(node)(walk(v) for v in node)
+        return dim0(node)
+
+    return walk(opt_state)
 
 
 def fsdp_param_specs(params, *, axis=AXIS_FSDP, min_size: int = 2 ** 12,

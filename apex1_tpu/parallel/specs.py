@@ -1,0 +1,38 @@
+"""Regex-rule PartitionSpec trees — the GSPMD face of tensor parallelism.
+
+Reference counterpart: ``apex/transformer/tensor_parallel/layers.py ::
+set_tensor_model_parallel_attributes`` — the reference marks each weight
+with (is_parallel, partition_dim, stride) and its Column/RowParallel
+autograd Functions issue the matching collectives by hand. Here the same
+information is a `PartitionSpec` per param, produced by path-regex rules
+(pattern: SNIPPETS.md [1]); pjit/GSPMD then inserts identical collectives.
+
+`specs_from_rules` is the generic engine; each model module ships its rule
+table (`models.llama.param_specs`, `models.gpt2.param_specs`,
+`models.bert.param_specs`).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def specs_from_rules(params, rules, *, default=P()):
+    """PartitionSpec tree for ``params``: each leaf's flattened path
+    (``"layer0/qkv/kernel"``) is matched against ``rules`` —
+    ``((regex, spec), ...)`` — first match wins, else ``default``."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        for pat, spec in rules:
+            if re.search(pat, name):
+                return spec
+        return default
+
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [spec_for(path) for path, _ in flat])

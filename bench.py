@@ -84,10 +84,12 @@ def bench_gpt2(on_accel, batch=None, seq=None):
 
     if on_accel:
         B, S, iters = batch or 8, seq or 1024, 10
-        cfg = GPT2Config(policy=get_policy("O2"))
+        cfg = GPT2Config(policy=get_policy("O2"),
+                         max_seq_len=max(S, 1024))
     else:
         B, S, iters = batch or 2, seq or 128, 3
-        cfg = GPT2Config.tiny(policy=get_policy("O2"))
+        cfg = GPT2Config.tiny(policy=get_policy("O2"),
+                              max_seq_len=max(S, 128))
     model = GPT2(cfg)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S)),

@@ -180,3 +180,68 @@ class TestSpatialBottleneck:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=2e-4, atol=2e-4,
                                        err_msg=f"train={train}")
+
+
+class TestParamSpecs:
+    """TP PartitionSpec rules per model: sharding the params with
+    `param_specs` on a tp mesh must not change the math (GSPMD inserts
+    the reference's Column/RowParallel collectives)."""
+
+    def _tp_mesh(self):
+        import jax
+        from apex1_tpu.core.mesh import make_mesh
+        return make_mesh(tp=4, devices=jax.devices()[:4])
+
+    def _check(self, loss_fn, params, specs, mesh, *batch):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        ref = float(jax.jit(loss_fn)(params, *batch))
+        sharded = jax.device_put(
+            params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                 is_leaf=lambda v: isinstance(v, P)))
+        got = float(jax.jit(loss_fn)(sharded, *batch))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_gpt2_specs(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from apex1_tpu.models import gpt2 as g
+        from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                             jnp.int32)
+        params = model.init(jax.random.key(0), tokens)["params"]
+        specs = g.param_specs(params)
+        assert specs["wte"] == P("tp", None)
+        assert specs["h0"]["qkv"]["kernel"] == P(None, "tp")
+        assert specs["h0"]["proj"]["kernel"] == P("tp", None)
+        assert specs["lnf_scale"] == P()
+        self._check(gpt2_loss_fn(model), params, specs, self._tp_mesh(),
+                    tokens)
+
+    def test_bert_specs(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from apex1_tpu.models import bert as b
+        cfg = BertConfig.tiny()
+        model = BertPretrain(cfg)
+        rng = np.random.default_rng(0)
+        batch = {
+            "tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                                  jnp.int32),
+            "mlm_labels": jnp.asarray(
+                np.where(rng.random((2, 32)) < 0.15,
+                         rng.integers(0, cfg.vocab_size, (2, 32)), -1),
+                jnp.int32),
+            "nsp_labels": jnp.asarray(rng.integers(0, 2, (2,)), jnp.int32),
+        }
+        params = model.init(jax.random.key(0), batch["tokens"])["params"]
+        specs = b.param_specs(params)
+        assert specs["bert"]["word_embeddings"] == P("tp", None)
+        assert specs["bert"]["layer0"]["qkv"]["kernel"] == P(None, "tp")
+        assert specs["mlm_bias"] == P("tp")
+        self._check(bert_pretrain_loss_fn(model), params, specs,
+                    self._tp_mesh(), batch)

@@ -321,9 +321,11 @@ class TestFsdpParamSpecs:
         from apex1_tpu.optim.fused_adam import fused_adam
 
         tx = fused_adam(1e-2)
-        params = {"w1": jnp.asarray(rng.normal(size=(64, 32)), jnp.float32),
-                  "w2": jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)}
-        x = jnp.asarray(rng.normal(size=(16, 64)), jnp.float32)
+        # w1's LARGEST dim is dim 1: exercises moment specs following the
+        # param specs (dim-1 sharded) instead of blanket dim-0 sharding
+        params = {"w1": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+                  "w2": jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)}
+        x = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
         y = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
 
         def loss_fn(p):
@@ -339,9 +341,13 @@ class TestFsdpParamSpecs:
         ref_p, ref_l = jax.jit(train)(params, tx.init(params))
 
         pspecs = parallel.fsdp_param_specs(params, min_size=64)
-        assert pspecs["w1"] == P("fsdp", None)
+        assert pspecs["w1"] == P(None, "fsdp")
         sspecs = parallel.shard_opt_state_specs(tx.init(params),
-                                                axis="fsdp")
+                                                axis="fsdp",
+                                                param_specs=pspecs)
+        # moments shard the SAME dim as their param (shard-local update)
+        assert sspecs.exp_avg["w1"] == P(None, "fsdp")
+        assert sspecs.step == P()
         shard = lambda t, s: jax.device_put(
             t, jax.tree.map(lambda sp: NamedSharding(fsdp_mesh, sp), s,
                             is_leaf=lambda v: isinstance(v, P)))
