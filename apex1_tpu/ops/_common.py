@@ -94,3 +94,22 @@ def as_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, tuple[int, ...]]:
 
 
 NEG_INF = -1e30  # finite mask value, reference kernels use -10000/-inf
+
+
+def row_block(lanes: int, *, rows: int | None = None,
+              budget_bytes: int = 1 << 20, lo: int = 8,
+              hi: int = 512) -> int:
+    """Rows per grid step for row-wise kernels (LN, softmax, xentropy…).
+
+    Tiny fixed blocks make the grid huge and per-step DMA/launch overheads
+    dominate (measured ~5× on GPT-2 shapes); this targets ``budget_bytes``
+    of fp32 per row-block operand (keep it ≤1 MiB — Pallas double-buffers
+    every operand and bwd kernels carry 3+ row blocks), clamped to
+    [``lo``, ``hi``] and — when ``rows`` is given — to the actual row
+    count (8-aligned) so small inputs aren't padded up to dead work.
+    ``lanes`` is the RAW last-dim size; rounded to 128 internally."""
+    lanes_p = max(128, ((lanes + 127) // 128) * 128)
+    br = max(lo, min(hi, budget_bytes // (4 * lanes_p) // 8 * 8))
+    if rows is not None:
+        br = min(br, max(lo, ((rows + 7) // 8) * 8))
+    return br

@@ -34,9 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import as_rows, interpret_mode, pad_to, use_pallas
-
-_BLOCK_ROWS = 8
+from apex1_tpu.ops._common import (as_rows, interpret_mode, pad_to,
+                                   row_block, use_pallas)
 
 
 # --------------------------------------------------------------------------
@@ -97,18 +96,19 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
         db_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
 
 
-def _specs(h):
-    row = pl.BlockSpec((_BLOCK_ROWS, h), lambda i: (i, 0),
+def _specs(h, br):
+    row = pl.BlockSpec((br, h), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     vec = pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    stat = pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0),
+    stat = pl.BlockSpec((br, 1), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     return row, vec, stat
 
 
 def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms):
     rows, h = x2.shape
-    row, vec, stat = _specs(h)
+    br = row_block(h, rows=rows)
+    row, vec, stat = _specs(h, br)
     if beta2 is not None:
         kernel = functools.partial(_fwd_kernel, eps=eps, true_h=true_h,
                                    rms=rms)
@@ -121,7 +121,7 @@ def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms):
         in_specs, args = [row, vec], (x2, gamma2)
     return pl.pallas_call(
         kernel,
-        grid=(pl.cdiv(rows, _BLOCK_ROWS),),
+        grid=(pl.cdiv(rows, br),),
         in_specs=in_specs,
         out_specs=(row, stat, stat),
         out_shape=(jax.ShapeDtypeStruct((rows, h), x2.dtype),
@@ -133,7 +133,8 @@ def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms):
 
 def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta):
     rows, h = x2.shape
-    row, vec, stat = _specs(h)
+    br = row_block(h, rows=rows)
+    row, vec, stat = _specs(h, br)
     if with_beta:
         kernel = functools.partial(_bwd_kernel, true_h=true_h, rms=rms)
         out_specs = (row, vec, vec)
@@ -150,7 +151,7 @@ def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta):
                      jax.ShapeDtypeStruct((1, h), jnp.float32))
     return pl.pallas_call(
         kernel,
-        grid=(pl.cdiv(rows, _BLOCK_ROWS),),
+        grid=(pl.cdiv(rows, br),),
         in_specs=[row, vec, stat, stat, row],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -165,7 +166,7 @@ def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta):
 def _prep(x, gamma, beta):
     x2, shape = as_rows(x)
     h = x2.shape[-1]
-    x2p, rows = pad_to(x2, 0, _BLOCK_ROWS)
+    x2p, rows = pad_to(x2, 0, row_block(h, rows=x2.shape[0]))
     x2p, _ = pad_to(x2p, 1, 128)
     g2 = pad_to(gamma.reshape(1, -1), 1, 128)[0]
     b2 = pad_to(beta.reshape(1, -1), 1, 128)[0] if beta is not None else None
@@ -188,7 +189,7 @@ def _fused_norm_bwd(eps, rms, res, dy):
     x, gamma, beta, mean, rstd = res
     x2p, g2, _, shape, h, rows = _prep(x, gamma, beta)
     dy2, _ = as_rows(dy)
-    dy2p, _ = pad_to(dy2, 0, _BLOCK_ROWS)
+    dy2p, _ = pad_to(dy2, 0, row_block(h, rows=dy2.shape[0]))
     dy2p, _ = pad_to(dy2p, 1, 128)
     outs = _pallas_bwd(x2p, g2, mean, rstd, dy2p, h, rms,
                        with_beta=beta is not None)

@@ -29,9 +29,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex1_tpu.ops._common import (NEG_INF, interpret_mode, pad_to,
-                                   use_pallas)
-
-_BLOCK_Q = 8
+                                   row_block, use_pallas)
 
 
 def _fwd_kernel(x_ref, mask_ref, y_ref, *, scale, causal, true_k):
@@ -60,19 +58,21 @@ def _bwd_kernel(y_ref, dy_ref, dx_ref, *, scale):
 
 def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k):
     b, h, sq, k = x4.shape
-    x_spec = pl.BlockSpec((1, 1, _BLOCK_Q, k),
+    bq = row_block(k, rows=sq)
+    x_spec = pl.BlockSpec((1, 1, bq, k),
                           lambda bi, hi, qi: (bi, hi, qi, 0),
                           memory_space=pltpu.VMEM)
-    grid = (b, h, pl.cdiv(sq, _BLOCK_Q))
+    grid = (b, h, pl.cdiv(sq, bq))
     if mask4 is not None:
-        mb, mh, msq, _ = mask4.shape
-        mq_block = _BLOCK_Q if msq != 1 else 1
+        mb, mh, msq, msk = mask4.shape
+        mq_block = bq if msq != 1 else 1
+        mk_block = k if msk != 1 else 1  # size-1 key dim stays broadcast
 
         def mask_index(bi, hi, qi):
             return (bi if mb != 1 else 0, hi if mh != 1 else 0,
                     qi if msq != 1 else 0, 0)
 
-        m_spec = pl.BlockSpec((1, 1, mq_block, k), mask_index,
+        m_spec = pl.BlockSpec((1, 1, mq_block, mk_block), mask_index,
                               memory_space=pltpu.VMEM)
         kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                    true_k=true_k)
@@ -94,11 +94,12 @@ def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k):
 
 def _pallas_softmax_bwd(y2, dy2, scale):
     rows, k = y2.shape
-    row = pl.BlockSpec((_BLOCK_Q, k), lambda i: (i, 0),
+    bq = row_block(k, rows=rows)
+    row = pl.BlockSpec((bq, k), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale),
-        grid=(pl.cdiv(rows, _BLOCK_Q),),
+        grid=(pl.cdiv(rows, bq),),
         in_specs=[row, row],
         out_specs=row,
         out_shape=jax.ShapeDtypeStruct((rows, k), y2.dtype),
@@ -141,13 +142,15 @@ def _fused_softmax(x, mask, scale, causal):
 def _fused_softmax_fwd(x, mask, scale, causal):
     x4, shape = _as4d(x)
     true_k = x4.shape[-1]
-    x4p, sq = pad_to(x4, 2, _BLOCK_Q)
+    bq = row_block(x4.shape[3], rows=x4.shape[2])
+    x4p, sq = pad_to(x4, 2, bq)
     x4p, _ = pad_to(x4p, 3, 128)
     if mask is not None:
         m4 = _mask4d(mask, x4.shape)
         if m4.shape[2] != 1:
-            m4, _ = pad_to(m4, 2, _BLOCK_Q)
-        m4, _ = pad_to(m4, 3, 128)
+            m4, _ = pad_to(m4, 2, bq)
+        if m4.shape[3] != 1:  # size-1 key dim rides kernel broadcast
+            m4, _ = pad_to(m4, 3, 128)
     else:
         m4 = None
     y = _pallas_softmax_fwd(x4p, m4, scale, causal, true_k)
@@ -158,10 +161,11 @@ def _fused_softmax_fwd(x, mask, scale, causal):
 def _fused_softmax_bwd(scale, causal, y, dy):
     y2 = y.reshape(-1, y.shape[-1])
     true_k = y2.shape[1]
-    y2p, rows = pad_to(y2, 0, _BLOCK_Q)
+    bq = row_block(y2.shape[1], rows=y2.shape[0])
+    y2p, rows = pad_to(y2, 0, bq)
     y2p, _ = pad_to(y2p, 1, 128)
     dy2 = dy.reshape(-1, dy.shape[-1])
-    dy2p, _ = pad_to(dy2, 0, _BLOCK_Q)
+    dy2p, _ = pad_to(dy2, 0, bq)
     dy2p, _ = pad_to(dy2p, 1, 128)
     dx = _pallas_softmax_bwd(y2p, dy2p, scale)
     dx = dx[:rows, :true_k].reshape(y.shape)

@@ -134,6 +134,45 @@ class TestSoftmax:
 
         check_fwd_bwd(pallas_fn, gold_fn, (x, mask))
 
+    def test_broadcast_key_mask(self, rng):
+        """A mask whose KEY dim is size 1 must broadcast in-kernel (lane
+        padding would silently unmask keys 1..Sk-1)."""
+        x = jnp.asarray(rng.normal(size=(2, 2, 8, 24)), jnp.float32)
+        mask = jnp.where(
+            jnp.asarray(rng.random((2, 1, 8, 1)) < 0.5), ops.NEG_INF, 0.0)
+
+        def pallas_fn(x, m):
+            with _common.force_impl("pallas"):
+                return ops.scaled_masked_softmax(x, m, scale=1.5)
+
+        def gold_fn(x, m):
+            with _common.force_impl("xla"):
+                return ops.scaled_masked_softmax(x, m, scale=1.5)
+
+        check_fwd_bwd(pallas_fn, gold_fn, (x, mask))
+
+    def test_single_row_inputs(self, rng):
+        """Decode-path shapes (sq=1, single rows) parity — the adaptive
+        block clamp must not pad tiny inputs up to dead work, and the
+        results must still match the gold."""
+        from apex1_tpu.ops._common import row_block
+        assert row_block(128, rows=1) == 8
+        x = jnp.asarray(rng.normal(size=(2, 4, 1, 128)), jnp.float32)
+        with _common.force_impl("pallas"):
+            got = ops.scaled_masked_softmax(x, None, scale=1.0)
+        with _common.force_impl("xla"):
+            want = ops.scaled_masked_softmax(x, None, scale=1.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        z = jnp.asarray(rng.normal(size=(1, 1024)), jnp.float32)
+        g = jnp.ones((1024,), jnp.float32)
+        with _common.force_impl("pallas"):
+            got = ops.layer_norm(z, g, jnp.zeros_like(g))
+        with _common.force_impl("xla"):
+            want = ops.layer_norm(z, g, jnp.zeros_like(g))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_rows_sum_to_one(self, rng):
         x = jnp.asarray(rng.normal(size=(3, 2, 8, 40)), jnp.float32)
         with _common.force_impl("pallas"):
