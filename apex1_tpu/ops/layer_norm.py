@@ -105,9 +105,8 @@ def _specs(h, br):
     return row, vec, stat
 
 
-def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms):
+def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms, br):
     rows, h = x2.shape
-    br = row_block(h, rows=rows)
     row, vec, stat = _specs(h, br)
     if beta2 is not None:
         kernel = functools.partial(_fwd_kernel, eps=eps, true_h=true_h,
@@ -131,9 +130,8 @@ def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms):
     )(*args)
 
 
-def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta):
+def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta, br):
     rows, h = x2.shape
-    br = row_block(h, rows=rows)
     row, vec, stat = _specs(h, br)
     if with_beta:
         kernel = functools.partial(_bwd_kernel, true_h=true_h, rms=rms)
@@ -166,11 +164,12 @@ def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta):
 def _prep(x, gamma, beta):
     x2, shape = as_rows(x)
     h = x2.shape[-1]
-    x2p, rows = pad_to(x2, 0, row_block(h, rows=x2.shape[0]))
+    br = row_block(h, rows=x2.shape[0])  # computed ONCE; launchers take it
+    x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
     g2 = pad_to(gamma.reshape(1, -1), 1, 128)[0]
     b2 = pad_to(beta.reshape(1, -1), 1, 128)[0] if beta is not None else None
-    return x2p, g2, b2, shape, h, rows
+    return x2p, g2, b2, shape, h, rows, br
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -179,20 +178,20 @@ def _fused_norm(x, gamma, beta, eps, rms):
 
 
 def _fused_norm_fwd(x, gamma, beta, eps, rms):
-    x2p, g2, b2, shape, h, rows = _prep(x, gamma, beta)
-    y, mean, rstd = _pallas_fwd(x2p, g2, b2, eps, h, rms)
+    x2p, g2, b2, shape, h, rows, br = _prep(x, gamma, beta)
+    y, mean, rstd = _pallas_fwd(x2p, g2, b2, eps, h, rms, br)
     y = y[:rows, :h].reshape(shape)
     return y, (x, gamma, beta, mean, rstd)
 
 
 def _fused_norm_bwd(eps, rms, res, dy):
     x, gamma, beta, mean, rstd = res
-    x2p, g2, _, shape, h, rows = _prep(x, gamma, beta)
+    x2p, g2, _, shape, h, rows, br = _prep(x, gamma, beta)
     dy2, _ = as_rows(dy)
-    dy2p, _ = pad_to(dy2, 0, row_block(h, rows=dy2.shape[0]))
+    dy2p, _ = pad_to(dy2, 0, br)
     dy2p, _ = pad_to(dy2p, 1, 128)
     outs = _pallas_bwd(x2p, g2, mean, rstd, dy2p, h, rms,
-                       with_beta=beta is not None)
+                       with_beta=beta is not None, br=br)
     dx = outs[0][:rows, :h].reshape(shape)
     dg = outs[1][0, :h].astype(gamma.dtype)
     if beta is not None:

@@ -26,9 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import interpret_mode, use_pallas
-
-_BLOCK_ROWS = 8
+from apex1_tpu.ops._common import interpret_mode, row_block, use_pallas
 
 
 def rope_tables(positions, head_dim: int, *, base: float = 10000.0,
@@ -51,11 +49,12 @@ def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, o1_ref, o2_ref):
 
 def _pallas_rope(x1, x2, cos_r, sin_r):
     rows, half = x1.shape
-    row = pl.BlockSpec((_BLOCK_ROWS, half), lambda i: (i, 0),
+    br = row_block(half, rows=rows)  # 4 ins + 2 outs double-buffered
+    row = pl.BlockSpec((br, half), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _rope_kernel,
-        grid=(pl.cdiv(rows, _BLOCK_ROWS),),
+        grid=(pl.cdiv(rows, br),),
         in_specs=[row, row, row, row],
         out_specs=(row, row),
         out_shape=(jax.ShapeDtypeStruct(x1.shape, x1.dtype),

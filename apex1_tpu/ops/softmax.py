@@ -56,9 +56,8 @@ def _bwd_kernel(y_ref, dy_ref, dx_ref, *, scale):
     dx_ref[...] = (scale * y * (dy - dot)).astype(dx_ref.dtype)
 
 
-def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k):
+def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k, bq):
     b, h, sq, k = x4.shape
-    bq = row_block(k, rows=sq)
     x_spec = pl.BlockSpec((1, 1, bq, k),
                           lambda bi, hi, qi: (bi, hi, qi, 0),
                           memory_space=pltpu.VMEM)
@@ -92,9 +91,8 @@ def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k):
     )(*args)
 
 
-def _pallas_softmax_bwd(y2, dy2, scale):
+def _pallas_softmax_bwd(y2, dy2, scale, bq):
     rows, k = y2.shape
-    bq = row_block(k, rows=rows)
     row = pl.BlockSpec((bq, k), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     return pl.pallas_call(
@@ -153,7 +151,7 @@ def _fused_softmax_fwd(x, mask, scale, causal):
             m4, _ = pad_to(m4, 3, 128)
     else:
         m4 = None
-    y = _pallas_softmax_fwd(x4p, m4, scale, causal, true_k)
+    y = _pallas_softmax_fwd(x4p, m4, scale, causal, true_k, bq)
     y = y[:, :, :sq, :true_k].reshape(shape)
     return y, y
 
@@ -167,7 +165,7 @@ def _fused_softmax_bwd(scale, causal, y, dy):
     dy2 = dy.reshape(-1, dy.shape[-1])
     dy2p, _ = pad_to(dy2, 0, bq)
     dy2p, _ = pad_to(dy2p, 1, 128)
-    dx = _pallas_softmax_bwd(y2p, dy2p, scale)
+    dx = _pallas_softmax_bwd(y2p, dy2p, scale, bq)
     dx = dx[:rows, :true_k].reshape(y.shape)
     return dx, None
 

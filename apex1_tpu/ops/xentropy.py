@@ -27,9 +27,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import NEG_INF, interpret_mode, pad_to, use_pallas
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, pad_to,
+                                   row_block, use_pallas)
 
-_BLOCK_ROWS = 8
 
 
 def _fwd_kernel(x_ref, t_ref, loss_ref, lse_ref, *,
@@ -69,10 +69,10 @@ def _bwd_kernel(x_ref, t_ref, lse_ref, dloss_ref, dx_ref, *,
     dx_ref[...] = (grad * dloss).astype(dx_ref.dtype)
 
 
-def _specs(k):
-    row = pl.BlockSpec((_BLOCK_ROWS, k), lambda i: (i, 0),
+def _specs(k, br):
+    row = pl.BlockSpec((br, k), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
-    stat = pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0),
+    stat = pl.BlockSpec((br, 1), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     return row, stat
 
@@ -88,14 +88,15 @@ def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes):
     k = shape[-1] if num_classes is None else num_classes
     x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
-    x2p, rows = pad_to(x2, 0, _BLOCK_ROWS)
+    br = row_block(x2.shape[1], rows=x2.shape[0])
+    x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
-    t2p, _ = pad_to(t2, 0, _BLOCK_ROWS, value=-1)
-    row, stat = _specs(x2p.shape[1])
+    t2p, _ = pad_to(t2, 0, br, value=-1)
+    row, stat = _specs(x2p.shape[1], br)
     loss, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, smoothing=smoothing, true_k=k,
                           padding_idx=padding_idx),
-        grid=(pl.cdiv(x2p.shape[0], _BLOCK_ROWS),),
+        grid=(pl.cdiv(x2p.shape[0], br),),
         in_specs=[row, stat],
         out_specs=(stat, stat),
         out_shape=(jax.ShapeDtypeStruct((x2p.shape[0], 1), jnp.float32),
@@ -113,15 +114,16 @@ def _fused_xent_bwd(smoothing, padding_idx, num_classes, res, dloss):
     x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
     d2 = dloss.reshape(-1, 1).astype(jnp.float32)
-    x2p, rows = pad_to(x2, 0, _BLOCK_ROWS)
+    br = row_block(x2.shape[1], rows=x2.shape[0])
+    x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
-    t2p, _ = pad_to(t2, 0, _BLOCK_ROWS, value=-1)
-    d2p, _ = pad_to(d2, 0, _BLOCK_ROWS)
-    row, stat = _specs(x2p.shape[1])
+    t2p, _ = pad_to(t2, 0, br, value=-1)
+    d2p, _ = pad_to(d2, 0, br)
+    row, stat = _specs(x2p.shape[1], br)
     dx = pl.pallas_call(
         functools.partial(_bwd_kernel, smoothing=smoothing, true_k=k,
                           padding_idx=padding_idx),
-        grid=(pl.cdiv(x2p.shape[0], _BLOCK_ROWS),),
+        grid=(pl.cdiv(x2p.shape[0], br),),
         in_specs=[row, stat, stat, stat],
         out_specs=row,
         out_shape=jax.ShapeDtypeStruct(x2p.shape, logits.dtype),
