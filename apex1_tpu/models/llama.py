@@ -78,7 +78,7 @@ class LlamaBlock(nn.Module):
     seq_shard_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, segment_ids=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         E, H, Hkv, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
@@ -103,9 +103,11 @@ class LlamaBlock(nn.Module):
         k = apply_rotary_pos_emb(k, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         if self.seq_shard_axis is not None:
-            attn = ring_attention(q, k, v, self.seq_shard_axis, causal=True)
+            attn = ring_attention(q, k, v, self.seq_shard_axis, causal=True,
+                                  segment_ids=segment_ids)
         else:
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=True,
+                                   segment_ids=segment_ids)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * D)
         wo = self.param("wo", init, (H * D, E), jnp.float32).astype(dtype)
         x = x + (attn @ wo).astype(x.dtype)
@@ -128,27 +130,40 @@ class Llama(nn.Module):
     seq_shard_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None,
+    def __call__(self, tokens, *, positions=None, segment_ids=None,
                  return_hidden=False):
+        """``segment_ids`` (B, S) enables PACKED batches (≙ the reference
+        fmha's cu_seqlens varlen): tokens attend only within their own
+        segment. Pass per-segment ``positions`` (B, S) so RoPE restarts
+        at each document (see `pack_documents`)."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
         emb = self.param("tok_embeddings", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         x = emb[tokens].astype(dtype)
+        per_row_pos = positions is not None and jnp.ndim(positions) == 2
         if positions is None:
             positions = jnp.arange(S)
             if self.seq_shard_axis is not None:
                 # local shard's global positions along the ring
                 positions = positions + jax.lax.axis_index(
                     self.seq_shard_axis) * S
-        cos, sin = rope_tables(positions, cfg.head_dim, base=cfg.rope_base)
+        if per_row_pos:
+            # (B, S) per-segment positions -> per-row (B, S, half) tables
+            cos, sin = rope_tables(positions.reshape(-1), cfg.head_dim,
+                                   base=cfg.rope_base)
+            cos = cos.reshape(B, S, -1)
+            sin = sin.reshape(B, S, -1)
+        else:
+            cos, sin = rope_tables(positions, cfg.head_dim,
+                                   base=cfg.rope_base)
         block = LlamaBlock
         if cfg.remat:
             block = nn.remat(LlamaBlock, static_argnums=())
         for i in range(cfg.num_layers):
             x = block(cfg, self.seq_shard_axis, name=f"layer{i}")(
-                x, cos, sin)
+                x, cos, sin, segment_ids)
         g = self.param("norm", nn.initializers.ones, (cfg.hidden_size,),
                        jnp.float32)
         if not cfg.policy.keep_norms_fp32:
@@ -193,15 +208,24 @@ def llama_loss_fn(model: Llama, *, fuse_head: bool = True):
     kernel (``ops.linear_cross_entropy``); ``fuse_head=False`` keeps the
     materialized-logits gold."""
 
-    def loss_fn(params, tokens):
+    def loss_fn(params, tokens, segment_ids=None, positions=None):
+        kw = dict(segment_ids=segment_ids, positions=positions)
         if fuse_head:
-            h = model.apply({"params": params}, tokens, return_hidden=True)
+            h = model.apply({"params": params}, tokens, return_hidden=True,
+                            **kw)
             losses = linear_cross_entropy(
                 h[:, :-1], params["output"].astype(h.dtype), tokens[:, 1:])
         else:
-            logits = model.apply({"params": params}, tokens)
+            logits = model.apply({"params": params}, tokens, **kw)
             losses = softmax_cross_entropy_loss(
                 logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
+        if segment_ids is not None:
+            # packed batches: a next-token target in a DIFFERENT segment
+            # (document boundary, or padding segment -1) is not a target
+            valid = ((segment_ids[:, :-1] == segment_ids[:, 1:])
+                     & (segment_ids[:, :-1] >= 0)).astype(losses.dtype)
+            return jnp.sum(losses * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0)
         return jnp.mean(losses)
 
     return loss_fn

@@ -98,6 +98,10 @@ def _apply(x, cos, sin, interleaved, seq_axis):
     bshape = [1] * x.ndim
     bshape[seq_axis] = seq
     bshape[-1] = half
+    if cos.ndim == 3:
+        # per-row tables (B, seq, half) — packed/varlen batches where
+        # positions restart per segment (≙ the reference's thd variant)
+        bshape[0] = cos.shape[0]
     c = jnp.broadcast_to(cos.astype(jnp.float32).reshape(bshape),
                          x1.shape).reshape(-1, half)
     s = jnp.broadcast_to(sin.astype(jnp.float32).reshape(bshape),
@@ -137,13 +141,20 @@ _rope.defvjp(_rope_fwd, _rope_bwd)
 def apply_rotary_pos_emb(x, cos, sin, *, interleaved: bool = False,
                          seq_axis: int | None = None):
     """Apply RoPE. ``x``: (..., seq, heads, head_dim) or (..., seq,
-    head_dim); ``cos/sin``: (seq, head_dim/2) from `rope_tables`. The
-    sequence axis is inferred from the table length (prefer -3, then -2);
-    pass ``seq_axis`` when ambiguous."""
+    head_dim); ``cos/sin``: (seq, head_dim/2) from `rope_tables`, or
+    (B, seq, head_dim/2) per-row tables for packed/varlen batches
+    (positions restarting per segment — the reference's thd variant).
+    The sequence axis is inferred from the table length (prefer -3, then
+    -2); pass ``seq_axis`` when ambiguous."""
     if x.shape[-1] % 2:
         raise ValueError("head_dim must be even for RoPE")
+    if cos.ndim == 3 and cos.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"per-row tables {cos.shape} need leading dim == batch "
+            f"{x.shape[0]}")
+    seq_len = cos.shape[1] if cos.ndim == 3 else cos.shape[0]
     if seq_axis is None:
-        seq_axis = _infer_seq_axis(x, cos.shape[0])
+        seq_axis = _infer_seq_axis(x, seq_len)
     else:
         seq_axis = seq_axis % x.ndim
     return _rope(x, cos, sin, interleaved, seq_axis)

@@ -326,6 +326,56 @@ class TokenDataset:
         self.close()
 
 
+def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
+                   *, pad_id: int = 0):
+    """Greedy first-fit packing of variable-length documents into fixed
+    (rows, seq_len) batches — the data-side half of varlen attention
+    (≙ the reference fmha's cu_seqlens packed QKV batches; the model side
+    is ``segment_ids`` on the flash/ring attention kernels).
+
+    Returns ``(tokens, segment_ids, positions)``, each (rows, seq_len)
+    int32. ``segment_ids`` are unique per document within a row, ``-1`` on
+    padding (never matches a real segment in the kernels' equality mask);
+    ``positions`` restart at 0 per document (feed per-row RoPE tables).
+    Documents longer than ``seq_len`` are split into ``seq_len`` chunks
+    (each chunk its own segment, positions continuing within the doc).
+    """
+    rows: list[list[tuple[np.ndarray, int]]] = []  # [(chunk, pos0), ...]
+    space: list[int] = []
+    open_rows: list[int] = []  # bounded first-fit window: corpus-scale
+    MAX_OPEN = 256             # packing stays O(chunks · MAX_OPEN)
+    for doc in docs:
+        doc = np.asarray(doc)
+        for lo in range(0, len(doc), seq_len):
+            chunk = doc[lo:lo + seq_len]
+            for r in open_rows:
+                if space[r] >= len(chunk):
+                    rows[r].append((chunk, lo))
+                    space[r] -= len(chunk)
+                    if space[r] == 0:
+                        open_rows.remove(r)
+                    break
+            else:
+                rows.append([(chunk, lo)])
+                space.append(seq_len - len(chunk))
+                open_rows.append(len(rows) - 1)
+                if len(open_rows) > MAX_OPEN:
+                    open_rows.pop(0)  # close the oldest (fullest) row
+    n = len(rows)
+    tokens = np.full((n, seq_len), pad_id, np.int32)
+    segs = np.full((n, seq_len), -1, np.int32)
+    pos = np.zeros((n, seq_len), np.int32)
+    for r, chunks in enumerate(rows):
+        off = 0
+        for sid, (chunk, pos0) in enumerate(chunks):
+            ln = len(chunk)
+            tokens[r, off:off + ln] = chunk
+            segs[r, off:off + ln] = sid
+            pos[r, off:off + ln] = np.arange(pos0, pos0 + ln)
+            off += ln
+    return tokens, segs, pos
+
+
 def write_token_file(path: str, tokens: np.ndarray) -> None:
     """Write a flat token file `TokenDataset` can read (little-endian)."""
     arr = np.asarray(tokens)
