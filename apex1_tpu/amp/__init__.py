@@ -127,7 +127,8 @@ class Amp:
     # -- per-step (≙ scale_loss + optimizer.step) --------------------------
     def make_train_step(self, loss_fn: Callable, *,
                         has_aux: bool = False,
-                        loss_id: int = 0) -> Callable:
+                        loss_id: int = 0,
+                        accum_steps: int = 1) -> Callable:
         """``loss_fn(params_compute, *batch) -> loss`` (or ``(loss, aux)``).
 
         The returned function is pure — wrap it in ``jax.jit`` / ``pjit`` /
@@ -135,24 +136,60 @@ class Amp:
         from sharding; under shard_map pass ``grad_psum_axes=("dp",)``.
         ``loss_id`` selects the scaler when ``num_losses > 1``
         (≙ ``amp.scale_loss(loss, opt, loss_id=i)``).
+
+        ``accum_steps > 1``: gradient accumulation — every batch leaf must
+        lead with the accumulation axis (``(accum_steps, ...)``); the
+        microbatch loop rides ONE ``lax.scan`` (grads averaged, one
+        optimizer step — ≙ the reference's grad-accumulation recipe and
+        ``fwd_bwd_no_pipelining``'s grad-sync-on-last semantics under jit;
+        activation memory is one microbatch's).
         """
         if not 0 <= loss_id < self.num_losses:
             raise ValueError(f"loss_id {loss_id} outside num_losses="
                              f"{self.num_losses}")
+        if accum_steps < 1:
+            raise ValueError("accum_steps must be >= 1")
         policy, scaler = self.policy, self.scaler
 
         def train_step(state: AmpState, *batch):
             ls = self._get_ls(state, loss_id)
 
-            def scaled_loss_fn(master_params):
+            def scaled_loss_fn(master_params, *mb):
                 compute_params = policy.cast_to_compute(master_params)
-                out = loss_fn(compute_params, *batch)
+                out = loss_fn(compute_params, *mb)
                 loss, aux = out if has_aux else (out, None)
                 return scaler.scale(loss.astype(jnp.float32),
                                     ls), (loss, aux)
 
-            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
-                state.params)
+            if accum_steps == 1:
+                grads, (loss, aux) = jax.grad(
+                    scaled_loss_fn, has_aux=True)(state.params, *batch)
+            else:
+                def body(carry, mb):
+                    gacc, lacc = carry
+                    g, (l, aux_mb) = jax.grad(scaled_loss_fn,
+                                              has_aux=True)(
+                        state.params, *mb)
+                    return (jax.tree_util.tree_map(jnp.add, gacc, g),
+                            lacc + l), aux_mb
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                    state.params)
+                (grads, loss), aux = jax.lax.scan(
+                    body, (zeros, jnp.zeros([], jnp.float32)), batch)
+                inv = 1.0 / accum_steps
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+                if has_aux:
+                    # keep metrics["aux"] shape-stable across accum_steps:
+                    # float aux leaves average over microbatches
+                    aux = jax.tree_util.tree_map(
+                        lambda a: (jnp.mean(a, axis=0)
+                                   if jnp.issubdtype(a.dtype, jnp.floating)
+                                   else a), aux)
+                else:
+                    aux = None
             for ax in self.grad_psum_axes:
                 grads = jax.lax.pmean(grads, ax)
                 loss = jax.lax.pmean(loss, ax)  # report the GLOBAL mean

@@ -358,9 +358,10 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
             else:
                 rows.append([(chunk, lo)])
                 space.append(seq_len - len(chunk))
-                open_rows.append(len(rows) - 1)
-                if len(open_rows) > MAX_OPEN:
-                    open_rows.pop(0)  # close the oldest (fullest) row
+                if space[-1] > 0:  # full rows never enter the window
+                    open_rows.append(len(rows) - 1)
+                    if len(open_rows) > MAX_OPEN:
+                        open_rows.pop(0)  # evict by age to stay bounded
     n = len(rows)
     tokens = np.full((n, seq_len), pad_id, np.int32)
     segs = np.full((n, seq_len), -1, np.int32)

@@ -188,3 +188,70 @@ class TestMultiLossAndForward:
         out = jax.eval_shape(a2.make_forward(lambda p, x: p["w"] @ x),
                              a2.init(raw).params, x)
         assert out.dtype == jnp.bfloat16
+
+
+class TestGradAccumulation:
+    """accum_steps=k over k microbatches ≡ one step on the concatenated
+    batch (mean-loss semantics average the grads either way)."""
+
+    def test_matches_big_batch(self):
+        # fp32 + plain SGD so param delta == -lr * grad: the accumulation
+        # contract (mean of microbatch grads == big-batch grad) shows up
+        # directly, without Adam amplifying near-zero-grad sign noise to
+        # +-lr per element
+        from apex1_tpu.optim import fused_sgd
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        rng = np.random.default_rng(0)
+        A, B, S = 4, 2, 16
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (A * B, S)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens[:B])["params"]
+        a = amp_lib.Amp(tx=fused_sgd(0.1), opt_level="O0")
+
+        big = jax.jit(a.make_train_step(gpt2_loss_fn(model)))
+        acc = jax.jit(a.make_train_step(gpt2_loss_fn(model),
+                                        accum_steps=A))
+        s1, m1 = big(a.init(params), tokens)
+        s2, m2 = acc(a.init(params), tokens.reshape(A, B, S))
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-6)
+        for x, y in zip(jax.tree.leaves(s1.params),
+                        jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_fp16_overflow_skips_whole_step(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 2, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens[0])["params"]
+        a = amp_lib.Amp(tx=fused_adam(1e-3), opt_level="O1_fp16")
+        bad = lambda p, t: gpt2_loss_fn(model)(p, t) * 1e38
+        step = jax.jit(a.make_train_step(bad, accum_steps=2))
+        st = a.init(params)
+        st2, m = step(st, tokens)
+        assert float(m["grads_finite"]) == 0.0
+        for x, y in zip(jax.tree.leaves(st.params),
+                        jax.tree.leaves(st2.params)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_aux_shape_stable_across_accum(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 2, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens[0])["params"]
+        base = gpt2_loss_fn(model)
+        loss_aux = lambda p, t: (base(p, t), {"acc": base(p, t) * 0 + 1.0})
+        a = amp_lib.Amp(tx=fused_adam(1e-3), opt_level="O0")
+        s1, m1 = jax.jit(a.make_train_step(loss_aux, has_aux=True))(
+            a.init(params), tokens[0])
+        s2, m2 = jax.jit(a.make_train_step(loss_aux, has_aux=True,
+                                           accum_steps=2))(
+            a.init(params), tokens)
+        assert m1["aux"]["acc"].shape == m2["aux"]["acc"].shape == ()
+        np.testing.assert_allclose(float(m2["aux"]["acc"]), 1.0)

@@ -122,10 +122,31 @@ def bench_xent(T, H, V):
                   f"{str(e)[:120]}", flush=True)
 
 
+def bench_norm(R, H):
+    from apex1_tpu.ops import layer_norm, rms_norm
+    from apex1_tpu.ops._common import force_impl
+    print(f"== layer_norm rows={R} H={H} bf16 ==", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(R, H)), jnp.bfloat16)
+    g = jnp.ones((H,), jnp.float32)
+    b = jnp.zeros((H,), jnp.float32)
+
+    for name, op in (("ln", lambda x, impl: layer_norm(x, g, b)),
+                     ("rms", lambda x, impl: rms_norm(x, g))):
+        for impl in ("xla", "pallas"):
+            def f(x, name=name, op=op, impl=impl):
+                with force_impl(impl):
+                    return jnp.sum(op(x, impl).astype(jnp.float32))
+            dt = timeit(f, x)
+            dt2 = timeit(jax.grad(f), x)
+            print(f"  {name:4s} {impl:6s} fwd {dt*1e3:8.3f} ms   fwd+bwd "
+                  f"{dt2*1e3:8.3f} ms", flush=True)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
-                    choices=["attn", "xent", "all"])
+                    choices=["attn", "xent", "norm", "all"])
     ap.add_argument("--llama", action="store_true",
                     help="long-context llama shapes instead of GPT-2")
     args = ap.parse_args()
@@ -138,3 +159,6 @@ if __name__ == "__main__":
         bench_attn(attn_shape)
     if args.what in ("xent", "all"):
         bench_xent(*xent)
+    if args.what in ("norm", "all"):
+        bench_norm(8192 if not args.llama else 16384,
+                   768 if not args.llama else 2048)
