@@ -183,11 +183,12 @@ class Amp:
                 loss = loss * inv
                 if has_aux:
                     # keep metrics["aux"] shape-stable across accum_steps:
-                    # float aux leaves average over microbatches
+                    # float leaves average over microbatches, other dtypes
+                    # (counters/flags) keep the LAST microbatch's value
                     aux = jax.tree_util.tree_map(
                         lambda a: (jnp.mean(a, axis=0)
                                    if jnp.issubdtype(a.dtype, jnp.floating)
-                                   else a), aux)
+                                   else a[-1]), aux)
                 else:
                     aux = None
             for ax in self.grad_psum_axes:

@@ -64,7 +64,7 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True):
+    def __call__(self, x, *, deterministic=True, segment_ids=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         h = cfg.hidden_size
@@ -92,8 +92,11 @@ class Block(nn.Module):
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         if cfg.use_flash:
             attn = flash_attention(q, k, v, causal=True,
+                                   segment_ids=segment_ids,
                                    sm_scale=1.0 / math.sqrt(hd))
         else:
+            if segment_ids is not None:
+                raise ValueError("packed batches need use_flash=True")
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32)
             probs = scaled_upper_triang_masked_softmax(
@@ -116,7 +119,11 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic=True, return_hidden=False):
+    def __call__(self, tokens, *, deterministic=True, return_hidden=False,
+                 segment_ids=None, positions=None):
+        """``segment_ids``/(B, S) ``positions`` enable packed batches
+        (≙ fmha cu_seqlens varlen; see `runtime.pack_documents`) — tokens
+        attend within their segment, learned positions gather per row."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -124,9 +131,14 @@ class GPT2(nn.Module):
                          (cfg.padded_vocab, cfg.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-        x = wte[tokens].astype(dtype) + wpe[:S].astype(dtype)[None]
+        if positions is None:
+            pos_emb = wpe[:S].astype(dtype)[None]
+        else:
+            pos_emb = wpe[positions].astype(dtype)
+        x = wte[tokens].astype(dtype) + pos_emb
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"h{i}")(x, deterministic=deterministic)
+            x = Block(cfg, name=f"h{i}")(x, deterministic=deterministic,
+                                         segment_ids=segment_ids)
         gamma = self.param("lnf_scale", nn.initializers.ones,
                            (cfg.hidden_size,), jnp.float32)
         beta = self.param("lnf_bias", nn.initializers.zeros,
@@ -174,18 +186,23 @@ def gpt2_loss_fn(model: GPT2, *, fuse_head: bool = True):
     (B, S, V) logits in HBM. ``False`` keeps the materialized-logits path
     (the parity gold; also what inference uses)."""
 
-    def loss_fn(params, tokens):
+    def loss_fn(params, tokens, segment_ids=None, positions=None):
+        kw = dict(segment_ids=segment_ids, positions=positions)
         if fuse_head:
-            h = model.apply({"params": params}, tokens, return_hidden=True)
+            h = model.apply({"params": params}, tokens, return_hidden=True,
+                            **kw)
             w = params["wte"].astype(h.dtype)
             losses = linear_cross_entropy(
                 h[:, :-1], w, tokens[:, 1:],
                 num_classes=model.cfg.vocab_size)
         else:
-            logits = model.apply({"params": params}, tokens)
+            logits = model.apply({"params": params}, tokens, **kw)
             losses = softmax_cross_entropy_loss(
                 logits[:, :-1].astype(jnp.float32), tokens[:, 1:],
                 num_classes=model.cfg.vocab_size)
+        if segment_ids is not None:
+            from apex1_tpu.ops import masked_next_token_mean
+            return masked_next_token_mean(losses, segment_ids)
         return jnp.mean(losses)
 
     return loss_fn

@@ -220,12 +220,8 @@ def llama_loss_fn(model: Llama, *, fuse_head: bool = True):
             losses = softmax_cross_entropy_loss(
                 logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
         if segment_ids is not None:
-            # packed batches: a next-token target in a DIFFERENT segment
-            # (document boundary, or padding segment -1) is not a target
-            valid = ((segment_ids[:, :-1] == segment_ids[:, 1:])
-                     & (segment_ids[:, :-1] >= 0)).astype(losses.dtype)
-            return jnp.sum(losses * valid) / jnp.maximum(
-                jnp.sum(valid), 1.0)
+            from apex1_tpu.ops import masked_next_token_mean
+            return masked_next_token_mean(losses, segment_ids)
         return jnp.mean(losses)
 
     return loss_fn

@@ -12,7 +12,8 @@ from apex1_tpu.ops.layer_norm import (  # noqa: F401
 from apex1_tpu.ops.softmax import (  # noqa: F401
     FusedScaleMaskSoftmax, scaled_masked_softmax,
     scaled_upper_triang_masked_softmax)
-from apex1_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+from apex1_tpu.ops.xentropy import (  # noqa: F401
+    masked_next_token_mean, softmax_cross_entropy_loss)
 from apex1_tpu.ops.linear_xent import linear_cross_entropy  # noqa: F401
 from apex1_tpu.ops.rope import (  # noqa: F401
     apply_rotary_pos_emb, rope_tables)

@@ -171,3 +171,14 @@ def softmax_cross_entropy_loss(logits, labels, *, smoothing: float = 0.0,
         return _fused_xent(logits, labels, float(smoothing), padding_idx,
                            num_classes)
     return _xla_xent(logits, labels, smoothing, padding_idx, num_classes)
+
+
+def masked_next_token_mean(losses, segment_ids):
+    """Mean of next-token losses over VALID targets in a packed batch:
+    a target in a different segment than its input token (document
+    boundary) or in the padding segment (< 0) is not a target.
+    ``losses``: (B, S-1) per-position CE of predicting token t+1;
+    ``segment_ids``: (B, S). Shared by the packed GPT-2/Llama loss fns."""
+    valid = ((segment_ids[:, :-1] == segment_ids[:, 1:])
+             & (segment_ids[:, :-1] >= 0)).astype(losses.dtype)
+    return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)

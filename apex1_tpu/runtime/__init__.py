@@ -327,7 +327,8 @@ class TokenDataset:
 
 
 def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
-                   *, pad_id: int = 0):
+                   *, pad_id: int = 0,
+                   restart_chunk_positions: bool = False):
     """Greedy first-fit packing of variable-length documents into fixed
     (rows, seq_len) batches — the data-side half of varlen attention
     (≙ the reference fmha's cu_seqlens packed QKV batches; the model side
@@ -338,7 +339,11 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
     padding (never matches a real segment in the kernels' equality mask);
     ``positions`` restart at 0 per document (feed per-row RoPE tables).
     Documents longer than ``seq_len`` are split into ``seq_len`` chunks
-    (each chunk its own segment, positions continuing within the doc).
+    (each chunk its own segment); their positions continue within the doc
+    (RoPE models — no table bound) unless ``restart_chunk_positions`` is
+    set, which restarts every chunk at 0 (REQUIRED for learned-position
+    models like GPT-2, whose position table would otherwise be indexed
+    out of bounds and silently clamped).
     """
     rows: list[list[tuple[np.ndarray, int]]] = []  # [(chunk, pos0), ...]
     space: list[int] = []
@@ -350,13 +355,15 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
             chunk = doc[lo:lo + seq_len]
             for r in open_rows:
                 if space[r] >= len(chunk):
-                    rows[r].append((chunk, lo))
+                    rows[r].append(
+                        (chunk, 0 if restart_chunk_positions else lo))
                     space[r] -= len(chunk)
                     if space[r] == 0:
                         open_rows.remove(r)
                     break
             else:
-                rows.append([(chunk, lo)])
+                rows.append(
+                    [(chunk, 0 if restart_chunk_positions else lo)])
                 space.append(seq_len - len(chunk))
                 if space[-1] > 0:  # full rows never enter the window
                     open_rows.append(len(rows) - 1)

@@ -212,3 +212,18 @@ def test_token_dataset_closed_and_seed_wrap(tmp_path):
     ds.close()  # idempotent
     with pytest.raises(RuntimeError, match="closed"):
         ds.batch_at(0)
+
+
+def test_pack_documents_long_doc_positions():
+    """Docs longer than seq_len split into chunks; positions continue by
+    default (RoPE) and restart with restart_chunk_positions (learned PE,
+    which would otherwise silently clamp the table gather)."""
+    doc = np.arange(20, dtype=np.int32)
+    t, s, p = rt.pack_documents([doc], seq_len=8)
+    assert t.shape[0] >= 2 and p.max() == 19  # continues within the doc
+    t2, s2, p2 = rt.pack_documents([doc], seq_len=8,
+                                   restart_chunk_positions=True)
+    assert p2.max() <= 7                      # always table-safe
+    # chunks are distinct segments either way (no cross-chunk attention)
+    row0 = s[0][s[0] >= 0]
+    assert len(np.unique(row0)) >= 1
