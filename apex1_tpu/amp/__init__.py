@@ -262,6 +262,17 @@ class Amp:
 
         return fwd
 
+    # ≙ amp.half_function / float_function / promote_function, bound to
+    # THIS Amp's policy (the module-level forms take the policy explicitly)
+    def half_function(self, fn):
+        return self.policy.half_function(fn)
+
+    def float_function(self, fn):
+        return self.policy.float_function(fn)
+
+    def promote_function(self, fn):
+        return self.policy.promote_function(fn)
+
     @staticmethod
     def _one_sd(ls: LossScaleState):
         return {"loss_scale": ls.scale,
@@ -301,6 +312,26 @@ def initialize(params, tx, opt_level: str = "O1", **overrides):
     opt_level)``: returns ``(amp, state)``."""
     amp = Amp(tx=tx, opt_level=opt_level, **overrides)
     return amp, amp.init(params)
+
+
+def half_function(fn, policy):
+    """≙ ``amp.half_function`` (O1 FP16_FUNCS registration): returns
+    ``fn`` with float inputs cast to the policy's compute dtype. Pass the
+    policy (or opt-level name) you train with — or use the bound form
+    ``Amp.half_function`` which uses the Amp's own policy."""
+    return get_policy(policy).half_function(fn)
+
+
+def float_function(fn, policy="O0"):
+    """≙ ``amp.float_function`` (FP32_FUNCS): float inputs cast fp32
+    (policy-independent — fp32 is fp32 under every opt level)."""
+    return get_policy(policy).float_function(fn)
+
+
+def promote_function(fn, policy="O0"):
+    """≙ ``amp.promote_function`` (CASTS): promote-widest inputs
+    (policy-independent — promotion looks only at the input dtypes)."""
+    return get_policy(policy).promote_function(fn)
 
 
 def scale_loss(loss, loss_scale_state: LossScaleState):

@@ -86,6 +86,39 @@ class PrecisionPolicy:
     def is_mixed(self) -> bool:
         return self.compute_dtype != self.param_dtype
 
+    # ---- O1 op-registration surface ---------------------------------------
+    # ≙ apex/amp/amp.py :: half_function / float_function / promote_function
+    # (the user-facing way to extend the FP16_FUNCS/FP32_FUNCS/CASTS lists).
+    # No monkey-patching under jit: these return a wrapped callable whose
+    # float array inputs are cast per the policy before the op runs.
+    def half_function(self, fn):
+        """Run ``fn`` with float inputs cast to the compute dtype
+        (whitelist ≙ FP16_FUNCS)."""
+        def wrapped(*args, **kw):
+            args, kw = _cast_floats((args, kw), self.compute_dtype)
+            return fn(*args, **kw)
+        return wrapped
+
+    def float_function(self, fn):
+        """Run ``fn`` with float inputs cast to fp32 (blacklist ≙
+        FP32_FUNCS — numerically fragile ops)."""
+        def wrapped(*args, **kw):
+            args, kw = _cast_floats((args, kw), jnp.float32)
+            return fn(*args, **kw)
+        return wrapped
+
+    def promote_function(self, fn):
+        """Run ``fn`` with float inputs promoted to the WIDEST float dtype
+        among them (≙ CASTS promote-widest for ambiguous ops)."""
+        def wrapped(*args, **kw):
+            leaves = [x for x in jax.tree_util.tree_leaves((args, kw))
+                      if _is_float(x)]
+            if leaves:
+                args, kw = _cast_floats(
+                    (args, kw), jnp.result_type(*leaves))
+            return fn(*args, **kw)
+        return wrapped
+
 
 def _cast_floats(tree, dtype):
     return jax.tree_util.tree_map(

@@ -262,3 +262,42 @@ class TestCapability:
         from apex1_tpu.core import capability as cap
         with _pytest.raises(ValueError):
             cap.get_capability("v99")
+
+
+class TestO1OpRegistration:
+    """≙ amp.half_function / float_function / promote_function — the O1
+    op-list extension surface (SURVEY #3), as policy-bound wrappers."""
+
+    def test_casts(self):
+        import jax
+        import jax.numpy as jnp
+
+        from apex1_tpu.core.policy import get_policy
+        p = get_policy("O1")  # bf16 compute
+        dtype_of = lambda f, *a: jax.eval_shape(f, *a).dtype
+        x32 = jnp.zeros((4, 4), jnp.float32)
+        xb = jnp.zeros((4, 4), jnp.bfloat16)
+        matmul = lambda a, b: a @ b
+        assert dtype_of(p.half_function(matmul), x32, x32) == jnp.bfloat16
+        assert dtype_of(p.float_function(matmul), xb, xb) == jnp.float32
+        # promote-widest: bf16 + fp32 -> fp32
+        assert dtype_of(p.promote_function(matmul), xb, x32) == jnp.float32
+        assert dtype_of(p.promote_function(matmul), xb, xb) == jnp.bfloat16
+        # non-float args pass through untouched
+        take = lambda a, i: a[i]
+        got = p.half_function(take)(x32, jnp.int32(1))
+        assert got.dtype == jnp.bfloat16
+
+    def test_module_level_and_bound(self):
+        import jax.numpy as jnp
+
+        from apex1_tpu import amp as amp_lib
+        from apex1_tpu.optim import fused_adam
+        f = amp_lib.float_function(lambda x: x)
+        assert f(jnp.zeros((2,), jnp.bfloat16)).dtype == jnp.float32
+        # bound form follows the Amp's OWN policy (fp16 here, not bf16)
+        a = amp_lib.Amp(tx=fused_adam(1e-3), opt_level="O1_fp16")
+        g = a.half_function(lambda x: x)
+        assert g(jnp.zeros((2,), jnp.float32)).dtype == jnp.float16
+        h = amp_lib.half_function(lambda x: x, "O1_fp16")
+        assert h(jnp.zeros((2,), jnp.float32)).dtype == jnp.float16
