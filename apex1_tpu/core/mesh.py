@@ -15,6 +15,8 @@ Axis names (canonical, innermost last):
                                           ZeRO-style)
     pp    — pipeline stages              (reference: pipeline_parallel)
     cp    — context/sequence parallel    (reference: [absent]; ring attention)
+    ep    — expert parallel              (reference: [absent]; transformer.moe
+                                          all_to_all dispatch)
     tp    — tensor model parallel        (reference: tensor_parallel; innermost
                                           = contiguous devices, like Megatron's
                                           contiguous TP ranks)
@@ -36,8 +38,9 @@ AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_PP = "pp"
 AXIS_CP = "cp"
+AXIS_EP = "ep"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_PP, AXIS_CP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_FSDP, AXIS_PP, AXIS_CP, AXIS_EP, AXIS_TP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +57,7 @@ class MeshConfig:
     fsdp: int = 1
     pp: int = 1
     cp: int = 1
+    ep: int = 1
     tp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
@@ -78,7 +82,7 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.pp, self.cp, self.tp)
+        return (self.dp, self.fsdp, self.pp, self.cp, self.ep, self.tp)
 
 
 def make_mesh(
@@ -88,7 +92,7 @@ def make_mesh(
     allow_split_physical_axes: bool = False,
     **axis_sizes: int,
 ) -> Mesh:
-    """Build a ``Mesh`` with the canonical five axes.
+    """Build a ``Mesh`` with the canonical six axes.
 
     ``make_mesh(dp=2, tp=4)`` or ``make_mesh(MeshConfig(dp=2, tp=4))``.
     Uses ``mesh_utils.create_device_mesh`` so the physical ICI topology is

@@ -4,6 +4,7 @@ schedules, microbatch calculators."""
 
 from apex1_tpu.transformer import enums  # noqa: F401
 from apex1_tpu.transformer import log_util  # noqa: F401
+from apex1_tpu.transformer import moe  # noqa: F401
 from apex1_tpu.transformer import parallel_state  # noqa: F401
 from apex1_tpu.transformer import tensor_parallel  # noqa: F401
 from apex1_tpu.transformer import pipeline_parallel  # noqa: F401

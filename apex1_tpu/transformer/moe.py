@@ -1,0 +1,173 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+**Beyond-reference capability** (SURVEY.md §2.6 marks EP *[absent]* in
+apex): provided because expert parallelism is a first-class distributed
+strategy on TPU pods. The design is the canonical TPU MoE dataflow
+(Mesh-TensorFlow / Switch-Transformer lineage, via PAPERS.md patterns):
+
+- **Router**: dense gate → softmax → top-k (k ∈ {1, 2}); combine weights
+  renormalized over the selected experts; Switch-style load-balance aux
+  loss ``E · Σ_e f_e · p̄_e`` (fraction routed × mean prob).
+- **Capacity-based dispatch**: each expert processes at most
+  ``capacity = ceil(k · T / E · capacity_factor)`` tokens; overflow
+  tokens are DROPPED from that expert (identity residual still carries
+  them — Switch semantics). Dispatch/combine are one-hot einsum tensors,
+  so the whole layer is static-shaped and MXU-friendly — no sorting, no
+  dynamic shapes under jit.
+- **Expert parallelism**: two forms, same math:
+  1. **GSPMD**: stacked expert weights (E, ...) sharded over ``ep`` via
+     `param_specs`; XLA inserts the all-to-alls.
+  2. **Explicit shard_map** (`moe_shard_map_apply`): tokens sharded over
+     ``ep``; ``jax.lax.all_to_all`` routes (expert, capacity) slots to
+     the expert's device and back — the NCCL-alltoall dataflow the
+     reference never had, on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.mesh import AXIS_EP
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2                 # 1 = Switch, 2 = GShard-style
+    capacity_factor: float = 1.25
+    hidden_size: int = 64
+    ffn_size: int = 256
+    aux_loss_weight: float = 1e-2
+
+
+def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    # ceil, per the docstring: capacity_factor=1.0 must not drop tokens
+    # under perfectly balanced routing
+    cap = math.ceil(cfg.capacity_factor * cfg.top_k * n_tokens
+                    / cfg.num_experts)
+    return max(1, cap)
+
+
+def router(x2, wg, cfg: MoEConfig):
+    """Top-k routing for flat tokens ``x2`` (T, H) with gate ``wg`` (H, E).
+
+    Returns ``(dispatch (T, E, C) bool-as-float, combine (T, E, C) float,
+    aux_loss scalar)``. Everything static-shaped: position-in-expert is a
+    masked cumsum, tokens beyond capacity get zero dispatch/combine.
+    """
+    T = x2.shape[0]
+    E, k = cfg.num_experts, cfg.top_k
+    C = _capacity(cfg, T)
+    logits = (x2.astype(jnp.float32) @ wg.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)            # (T, E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)      # (T, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # Switch aux loss over the TOP-1 assignment fraction
+    top1_hot = jax.nn.one_hot(gate_idx[:, 0], E)       # (T, E)
+    f = jnp.mean(top1_hot, axis=0)                     # fraction per expert
+    p = jnp.mean(probs, axis=0)                        # mean router prob
+    aux = cfg.aux_loss_weight * E * jnp.sum(f * p)
+
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    # priority: k-th choices claim capacity after all (k-1)-th choices —
+    # GShard ordering; positions via exclusive cumsum per expert
+    used = jnp.zeros((E,), jnp.float32)
+    for j in range(k):
+        hot = jax.nn.one_hot(gate_idx[:, j], E)        # (T, E)
+        pos = (jnp.cumsum(hot, axis=0) - hot) + used[None, :]  # (T, E)
+        within = (pos < C) & (hot > 0)
+        pos_c = jax.nn.one_hot(pos.astype(jnp.int32), C) * within[..., None]
+        dispatch = dispatch + hot[..., None] * pos_c
+        combine = combine + (gate_vals[:, j, None, None]
+                             * hot[..., None] * pos_c)
+        used = used + jnp.sum(hot * within, axis=0)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Dense-dispatch MoE FFN (GSPMD form): stacked expert weights
+    (E, H, F)/(E, F, H); shard dim 0 over ``ep`` via `param_specs` and
+    pjit does the rest. Returns ``(y, aux_loss)``."""
+
+    cfg: MoEConfig
+    dtype: jnp.dtype = jnp.float32
+    act: Callable = jax.nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        lead = x.shape[:-1]
+        H = x.shape[-1]
+        x2 = x.reshape(-1, H)
+        init = nn.initializers.normal(0.02)
+        wg = self.param("router", init, (H, cfg.num_experts), jnp.float32)
+        w1 = self.param("w1", init, (cfg.num_experts, H, cfg.ffn_size),
+                        jnp.float32)
+        w2 = self.param("w2", init, (cfg.num_experts, cfg.ffn_size, H),
+                        jnp.float32)
+        dispatch, combine, aux = router(x2, wg, cfg)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
+                        x2.astype(self.dtype))          # (E, C, H)
+        h = self.act(jnp.einsum("ech,ehf->ecf", xe,
+                                w1.astype(self.dtype)))
+        ye = jnp.einsum("ecf,efh->ech", h, w2.astype(self.dtype))
+        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
+        return y.reshape(*lead, H).astype(x.dtype), aux
+
+
+def param_specs(params, *, axis=AXIS_EP):
+    """PartitionSpecs for a `MoEMLP` param tree: expert-stacked weights
+    shard dim 0 over ``ep``; the router stays replicated."""
+    def spec(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if name.endswith("w1") or name.endswith("w2"):
+            return P(axis, *([None] * (jnp.ndim(leaf) - 1)))
+        return P()
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [spec(path, leaf) for path, leaf in flat])
+
+
+def moe_shard_map_apply(x_local, wg, w1_local, w2_local, cfg: MoEConfig,
+                        *, axis_name=AXIS_EP, act=jax.nn.gelu):
+    """Explicit expert-parallel dataflow — call inside ``shard_map`` with
+    tokens sharded over ``axis_name`` (x_local: (T_local, H)) and expert
+    weights sharded over dim 0 (w1_local: (E_local, H, F)).
+
+    Per device: route the LOCAL tokens against all E experts, build the
+    local dispatch (T_l, E, C_l from the local token count), then
+    ``all_to_all`` the (E, C_l, H) expert inputs so each device holds its
+    own experts' slots from EVERY device — (E_l, ep·C_l, H) — runs its
+    expert FFNs, and all_to_alls back. Two all-to-alls per layer over
+    ICI, ≙ the NCCL alltoall in GPU MoE stacks.
+    """
+    ep = jax.lax.axis_size(axis_name)
+    E = cfg.num_experts
+    if E % ep:
+        raise ValueError(f"num_experts {E} must divide by ep={ep}")
+    dispatch, combine, aux = router(x_local, wg, cfg)   # (T_l, E, C_l)
+    dtype = x_local.dtype
+    xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x_local)
+    # (E, C_l, H) -> split expert axis across devices, gather capacity:
+    # each device ends with (E_l, ep*C_l, H)
+    xe = jax.lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)
+    h = act(jnp.einsum("ech,ehf->ecf", xe, w1_local.astype(dtype)))
+    ye = jnp.einsum("ecf,efh->ech", h, w2_local.astype(dtype))
+    # route results back: split capacity, gather experts
+    ye = jax.lax.all_to_all(ye, axis_name, split_axis=1, concat_axis=0,
+                            tiled=True)
+    y = jnp.einsum("tec,ech->th", combine.astype(dtype), ye)
+    # aux is a per-shard mean over local tokens; callers pmean it
+    return y, aux

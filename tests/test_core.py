@@ -18,11 +18,11 @@ class TestMesh:
     def test_resolve_wildcard(self):
         cfg = MeshConfig(dp=-1, tp=2).resolve(8)
         assert cfg.dp == 4 and cfg.tp == 2 and cfg.pp == 1
-        assert cfg.shape == (4, 1, 1, 1, 2)
+        assert cfg.shape == (4, 1, 1, 1, 1, 2)
 
     def test_resolve_exact(self):
         cfg = MeshConfig(dp=2, pp=2, tp=2).resolve(8)
-        assert cfg.shape == (2, 1, 2, 1, 2)
+        assert cfg.shape == (2, 1, 2, 1, 1, 2)
 
     def test_resolve_errors(self):
         with pytest.raises(ValueError):
@@ -32,7 +32,8 @@ class TestMesh:
 
     def test_make_mesh_axes(self, devices):
         m = make_mesh(dp=2, tp=4)
-        assert m.shape == {"dp": 2, "fsdp": 1, "pp": 1, "cp": 1, "tp": 4}
+        assert m.shape == {"dp": 2, "fsdp": 1, "pp": 1, "cp": 1,
+                           "ep": 1, "tp": 4}
         assert mesh_lib.data_parallel_size(m) == 2
 
     def test_tp_ranks_contiguous(self, devices):
