@@ -52,6 +52,12 @@ class LlamaConfig:
     rope_base: float = 500000.0
     norm_eps: float = 1e-5
     remat: bool = False
+    # MoE (beyond-reference, `transformer.moe`): every N-th block swaps
+    # its dense FFN for a top-k-routed expert FFN; 0 = dense everywhere.
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 
@@ -76,6 +82,7 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
     # mesh axis carrying the sequence shard (ring/context parallel), or None
     seq_shard_axis: Optional[str] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None):
@@ -113,6 +120,19 @@ class LlamaBlock(nn.Module):
         x = x + (attn @ wo).astype(x.dtype)
 
         h = norm("mlp_norm", x).astype(dtype)
+        if self.use_moe:
+            from apex1_tpu.transformer.moe import MoEConfig, MoEMLP
+            y, aux = MoEMLP(
+                MoEConfig(num_experts=cfg.num_experts,
+                          top_k=cfg.moe_top_k,
+                          capacity_factor=cfg.moe_capacity_factor,
+                          hidden_size=E, ffn_size=cfg.ffn_size),
+                dtype=dtype, act=jax.nn.silu, name="moe")(
+                h, token_mask=(None if segment_ids is None
+                               else segment_ids >= 0))
+            # surfaced via flax collections; llama_loss_fn adds it
+            self.sow("losses", "moe_aux", aux)
+            return x + y.astype(x.dtype)
         wg = self.param("w_gate", init, (E, cfg.ffn_size),
                         jnp.float32).astype(dtype)
         wu = self.param("w_up", init, (E, cfg.ffn_size),
@@ -162,8 +182,10 @@ class Llama(nn.Module):
         if cfg.remat:
             block = nn.remat(LlamaBlock, static_argnums=())
         for i in range(cfg.num_layers):
-            x = block(cfg, self.seq_shard_axis, name=f"layer{i}")(
-                x, cos, sin, segment_ids)
+            use_moe = (cfg.moe_every > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, self.seq_shard_axis, use_moe,
+                      name=f"layer{i}")(x, cos, sin, segment_ids)
         g = self.param("norm", nn.initializers.ones, (cfg.hidden_size,),
                        jnp.float32)
         if not cfg.policy.keep_norms_fp32:
@@ -188,6 +210,8 @@ _TP_RULES = (
     (r"wo$", P("tp", None)),                       # row-parallel out proj
     (r"w_(gate|up)$", P(None, "tp")),              # column-parallel ffn in
     (r"w_down$", P("tp", None)),                   # row-parallel ffn out
+    (r"moe/w[12]$", P("ep", None, None)),          # expert-parallel FFNs
+    (r"moe/router$", P()),
     (r".*norm$", P()),                             # replicated norms
 )
 
@@ -208,20 +232,32 @@ def llama_loss_fn(model: Llama, *, fuse_head: bool = True):
     kernel (``ops.linear_cross_entropy``); ``fuse_head=False`` keeps the
     materialized-logits gold."""
 
+    moe = model.cfg.moe_every > 0
+
     def loss_fn(params, tokens, segment_ids=None, positions=None):
         kw = dict(segment_ids=segment_ids, positions=positions)
+        mut = ["losses"] if moe else False
         if fuse_head:
-            h = model.apply({"params": params}, tokens, return_hidden=True,
-                            **kw)
+            out = model.apply({"params": params}, tokens,
+                              return_hidden=True, mutable=mut, **kw)
+            h, aux_vars = out if moe else (out, {})
             losses = linear_cross_entropy(
                 h[:, :-1], params["output"].astype(h.dtype), tokens[:, 1:])
         else:
-            logits = model.apply({"params": params}, tokens, **kw)
+            out = model.apply({"params": params}, tokens, mutable=mut, **kw)
+            logits, aux_vars = out if moe else (out, {})
             losses = softmax_cross_entropy_loss(
                 logits[:, :-1].astype(jnp.float32), tokens[:, 1:])
         if segment_ids is not None:
             from apex1_tpu.ops import masked_next_token_mean
-            return masked_next_token_mean(losses, segment_ids)
-        return jnp.mean(losses)
+            loss = masked_next_token_mean(losses, segment_ids)
+        else:
+            loss = jnp.mean(losses)
+        if moe:
+            # sowed Switch aux losses, one per MoE block
+            loss = loss + sum(jnp.sum(jnp.asarray(v)) for v in
+                              jax.tree_util.tree_leaves(
+                                  aux_vars.get("losses", {})))
+        return loss
 
     return loss_fn

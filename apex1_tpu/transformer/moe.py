@@ -55,12 +55,14 @@ def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
     return max(1, cap)
 
 
-def router(x2, wg, cfg: MoEConfig):
+def router(x2, wg, cfg: MoEConfig, token_mask=None):
     """Top-k routing for flat tokens ``x2`` (T, H) with gate ``wg`` (H, E).
 
     Returns ``(dispatch (T, E, C) bool-as-float, combine (T, E, C) float,
     aux_loss scalar)``. Everything static-shaped: position-in-expert is a
     masked cumsum, tokens beyond capacity get zero dispatch/combine.
+    ``token_mask`` (T,) bool: False tokens (padding in packed batches)
+    claim no capacity and are excluded from the load-balance statistics.
     """
     T = x2.shape[0]
     E, k = cfg.num_experts, cfg.top_k
@@ -70,11 +72,14 @@ def router(x2, wg, cfg: MoEConfig):
     gate_vals, gate_idx = jax.lax.top_k(probs, k)      # (T, k)
     gate_vals = gate_vals / jnp.maximum(
         jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    mask = (jnp.ones((T,), jnp.float32) if token_mask is None
+            else token_mask.astype(jnp.float32))
 
-    # Switch aux loss over the TOP-1 assignment fraction
-    top1_hot = jax.nn.one_hot(gate_idx[:, 0], E)       # (T, E)
-    f = jnp.mean(top1_hot, axis=0)                     # fraction per expert
-    p = jnp.mean(probs, axis=0)                        # mean router prob
+    # Switch aux loss over the TOP-1 assignment fraction (valid tokens)
+    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+    top1_hot = jax.nn.one_hot(gate_idx[:, 0], E) * mask[:, None]
+    f = jnp.sum(top1_hot, axis=0) / n_valid            # fraction per expert
+    p = (jnp.sum(probs * mask[:, None], axis=0) / n_valid)  # mean prob
     aux = cfg.aux_loss_weight * E * jnp.sum(f * p)
 
     dispatch = jnp.zeros((T, E, C), jnp.float32)
@@ -83,7 +88,7 @@ def router(x2, wg, cfg: MoEConfig):
     # GShard ordering; positions via exclusive cumsum per expert
     used = jnp.zeros((E,), jnp.float32)
     for j in range(k):
-        hot = jax.nn.one_hot(gate_idx[:, j], E)        # (T, E)
+        hot = jax.nn.one_hot(gate_idx[:, j], E) * mask[:, None]  # (T, E)
         pos = (jnp.cumsum(hot, axis=0) - hot) + used[None, :]  # (T, E)
         within = (pos < C) & (hot > 0)
         pos_c = jax.nn.one_hot(pos.astype(jnp.int32), C) * within[..., None]
@@ -104,18 +109,20 @@ class MoEMLP(nn.Module):
     act: Callable = jax.nn.gelu
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_mask=None):
         cfg = self.cfg
         lead = x.shape[:-1]
         H = x.shape[-1]
         x2 = x.reshape(-1, H)
+        if token_mask is not None:
+            token_mask = token_mask.reshape(-1)
         init = nn.initializers.normal(0.02)
         wg = self.param("router", init, (H, cfg.num_experts), jnp.float32)
         w1 = self.param("w1", init, (cfg.num_experts, H, cfg.ffn_size),
                         jnp.float32)
         w2 = self.param("w2", init, (cfg.num_experts, cfg.ffn_size, H),
                         jnp.float32)
-        dispatch, combine, aux = router(x2, wg, cfg)
+        dispatch, combine, aux = router(x2, wg, cfg, token_mask)
         xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
                         x2.astype(self.dtype))          # (E, C, H)
         h = self.act(jnp.einsum("ech,ehf->ecf", xe,
@@ -128,19 +135,14 @@ class MoEMLP(nn.Module):
 def param_specs(params, *, axis=AXIS_EP):
     """PartitionSpecs for a `MoEMLP` param tree: expert-stacked weights
     shard dim 0 over ``ep``; the router stays replicated."""
-    def spec(path, leaf):
-        name = "/".join(str(getattr(p, "key", p)) for p in path)
-        if name.endswith("w1") or name.endswith("w2"):
-            return P(axis, *([None] * (jnp.ndim(leaf) - 1)))
-        return P()
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(params),
-        [spec(path, leaf) for path, leaf in flat])
+    from apex1_tpu.parallel.specs import specs_from_rules
+    return specs_from_rules(
+        params, ((r"w[12]$", P(axis, None, None)),), default=P())
 
 
 def moe_shard_map_apply(x_local, wg, w1_local, w2_local, cfg: MoEConfig,
-                        *, axis_name=AXIS_EP, act=jax.nn.gelu):
+                        *, axis_name=AXIS_EP, act=jax.nn.gelu,
+                        token_mask=None):
     """Explicit expert-parallel dataflow — call inside ``shard_map`` with
     tokens sharded over ``axis_name`` (x_local: (T_local, H)) and expert
     weights sharded over dim 0 (w1_local: (E_local, H, F)).
@@ -156,7 +158,8 @@ def moe_shard_map_apply(x_local, wg, w1_local, w2_local, cfg: MoEConfig,
     E = cfg.num_experts
     if E % ep:
         raise ValueError(f"num_experts {E} must divide by ep={ep}")
-    dispatch, combine, aux = router(x_local, wg, cfg)   # (T_l, E, C_l)
+    dispatch, combine, aux = router(x_local, wg, cfg,
+                                    token_mask)     # (T_l, E, C_l)
     dtype = x_local.dtype
     xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x_local)
     # (E, C_l, H) -> split expert axis across devices, gather capacity:
