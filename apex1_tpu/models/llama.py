@@ -58,6 +58,7 @@ class LlamaConfig:
     num_experts: int = 8
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 1e-2
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 
@@ -126,6 +127,7 @@ class LlamaBlock(nn.Module):
                 MoEConfig(num_experts=cfg.num_experts,
                           top_k=cfg.moe_top_k,
                           capacity_factor=cfg.moe_capacity_factor,
+                          aux_loss_weight=cfg.moe_aux_loss_weight,
                           hidden_size=E, ffn_size=cfg.ffn_size),
                 dtype=dtype, act=jax.nn.silu, name="moe")(
                 h, token_mask=(None if segment_ids is None

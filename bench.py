@@ -7,6 +7,7 @@ the north-star's optimizer/precision recipe.
 
 Other BASELINE configs are measurable with ``--config``:
   bert           config 2: BERT-base pretrain (MLM+NSP), fused LN + Adam
+  bert_large     the north-star model size (BERT-large, 340M) at B=4
   resnet         config 3: ResNet-50 train step (BN; SyncBN's collective
                  parity is covered by tests — single-chip bench has dp=1)
   llama_longctx  config 5: long-context decoder, Pallas flash attention +
@@ -28,6 +29,7 @@ reduction.
 """
 
 import argparse
+import functools
 import json
 import math
 import time
@@ -102,14 +104,15 @@ def bench_gpt2(on_accel, batch=None, seq=None):
             150_000.0)
 
 
-def bench_bert(on_accel):
+def bench_bert(on_accel, large=False):
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.bert import (BertConfig, BertPretrain,
                                        bert_pretrain_loss_fn)
 
     if on_accel:
-        B, S, iters = 8, 512, 10
-        cfg = BertConfig.bert_base(policy=get_policy("O2"))
+        B, S, iters = (4, 512, 8) if large else (8, 512, 10)
+        mk = BertConfig.bert_large if large else BertConfig.bert_base
+        cfg = mk(policy=get_policy("O2"))
     else:
         B, S, iters = 2, 64, 3
         cfg = BertConfig.tiny(policy=get_policy("O2"))
@@ -123,10 +126,12 @@ def bench_bert(on_accel):
              "nsp_labels": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)}
     params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
     state, step = _amp_state_step(bert_pretrain_loss_fn(model), params)
-    name = "BERT-base-pretrain" if on_accel else "BERT(tiny smoke)"
+    name = (("BERT-large-pretrain" if large else "BERT-base-pretrain")
+            if on_accel else "BERT(tiny smoke)")
+    proxy = 20_000.0 if large else 60_000.0
     return (state, step, (batch,), B * S, iters,
             f"tokens/sec/chip {name} amp-O2 fused_adam", "tokens/sec/chip",
-            60_000.0)
+            proxy)
 
 
 def bench_resnet(on_accel):
@@ -207,6 +212,7 @@ def bench_llama_longctx(on_accel):
 BENCHES = {
     "gpt2": bench_gpt2,
     "bert": bench_bert,
+    "bert_large": functools.partial(bench_bert, large=True),
     "resnet": bench_resnet,
     "llama_longctx": bench_llama_longctx,
 }
