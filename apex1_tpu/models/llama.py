@@ -38,6 +38,7 @@ from apex1_tpu.ops import (apply_rotary_pos_emb, linear_cross_entropy,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
 from apex1_tpu.parallel.ring_attention import ring_attention
+from apex1_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +60,11 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 1e-2
+    # context-parallel attention implementation when seq_shard_axis is
+    # set: "ring" (ppermute KV, any device count) or "ulysses"
+    # (all-to-all head scatter; the cp axis size must divide the head
+    # counts, or the KV count for GQA-repeat)
+    cp_impl: str = "ring"
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 
@@ -111,8 +117,17 @@ class LlamaBlock(nn.Module):
         k = apply_rotary_pos_emb(k, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
         if self.seq_shard_axis is not None:
-            attn = ring_attention(q, k, v, self.seq_shard_axis, causal=True,
-                                  segment_ids=segment_ids)
+            if cfg.cp_impl == "ulysses":
+                attn = ulysses_attention(q, k, v, self.seq_shard_axis,
+                                         causal=True,
+                                         segment_ids=segment_ids)
+            elif cfg.cp_impl == "ring":
+                attn = ring_attention(q, k, v, self.seq_shard_axis,
+                                      causal=True, segment_ids=segment_ids)
+            else:
+                raise ValueError(
+                    f"cp_impl must be 'ring' or 'ulysses', got "
+                    f"{cfg.cp_impl!r}")
         else:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids)

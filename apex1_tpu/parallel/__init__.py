@@ -10,3 +10,4 @@ from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
     shard_opt_state_specs)
 from apex1_tpu.parallel.halo import halo_exchange, spatial_conv2d  # noqa: F401
 from apex1_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from apex1_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,75 @@
+"""Ulysses-style all-to-all sequence parallelism — the second
+context-parallel form (complement of `parallel.ring_attention`).
+
+**Beyond-reference capability** (SURVEY.md §2.6 marks Ulysses *[absent]*
+in apex). Mechanism (DeepSpeed-Ulysses lineage): tokens arrive sharded
+over the ``cp`` axis; one ``all_to_all`` re-shards attention inputs from
+sequence-sharded (B, H, S/n, D) to HEAD-sharded (B, H/n, S, D), each
+device runs ordinary (flash) attention over the FULL sequence for its
+head subset, and a second ``all_to_all`` restores sequence sharding.
+
+Trade-offs vs ring attention (both provided so configs can pick):
+- Ulysses: 2 all-to-alls per attention (O(S·D·H/n) bytes each), full-seq
+  attention locally — simple, exact, great when heads ≥ devices;
+  requires Hq and Hkv divisible by the axis size.
+- Ring: n−1 neighbor ppermutes of K/V, attention stays seq-local —
+  scales to more devices than heads and overlaps transfer with compute,
+  at the cost of the lse-merge machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.mesh import AXIS_CP
+from apex1_tpu.ops.attention import flash_attention
+
+
+def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
+                      sm_scale: float | None = None, segment_ids=None,
+                      block_q: int | None = None,
+                      block_k: int | None = None):
+    """Attention over a sequence sharded on ``axis_name`` via head
+    scatter / sequence gather all-to-alls. Call inside ``shard_map``.
+
+    ``q`` (B, Hq, S_local, D); ``k``/``v`` (B, Hkv, S_local, D) with Hq
+    and Hkv divisible by the axis size. ``segment_ids``: local (B,
+    S_local) shard (all-gathered internally — after the first a2a every
+    device sees the full sequence). Returns the local output shard.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k)
+    Hq, Hkv = q.shape[1], k.shape[1]
+    if Hkv % n and n % Hkv == 0:
+        # GQA with fewer KV heads than devices: materialize the group
+        # repeat (exactly how GQA attention is defined) so KV heads
+        # split evenly; costs KV bandwidth, preserves semantics
+        rep = n // Hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        Hkv = n
+    if Hq % n or Hkv % n:
+        raise ValueError(
+            f"ulysses needs head counts divisible by the axis size: "
+            f"Hq={Hq}, Hkv={Hkv}, n={n} (use ring_attention otherwise)")
+
+    def seq_to_heads(t):   # (B, H, S_l, D) -> (B, H/n, S, D)
+        return jax.lax.all_to_all(t, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def heads_to_seq(t):   # (B, H/n, S, D) -> (B, H, S_l, D)
+        return jax.lax.all_to_all(t, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if segment_ids is not None:
+        segment_ids = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                         tiled=True)  # full (B, S)
+    out = flash_attention(qg, kg, vg, causal=causal,
+                          segment_ids=segment_ids, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k)
+    return heads_to_seq(out)

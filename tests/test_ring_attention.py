@@ -85,3 +85,96 @@ def test_ring_gqa(rng, devices):
     got = fn(q, k, v)
     want = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestUlysses:
+    """All-to-all sequence parallelism (≙ DeepSpeed Ulysses; SURVEY §2.6
+    [absent] in apex): head-scatter attention over cp must equal
+    unsharded flash attention on the full sequence."""
+
+    def test_matches_unsharded(self, rng, devices):
+        from jax.sharding import PartitionSpec as P
+
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.parallel.ulysses import ulysses_attention
+        B, H, S, D = 2, 4, 64, 16
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+                   for _ in range(3))
+
+        def f(q, k, v):
+            return ulysses_attention(q, k, v, "cp", causal=True)
+
+        got = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, None, "cp"),) * 3,
+            out_specs=P(None, None, "cp"), check_vma=False))(q, k, v)
+        want = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_segment_ids_ride_along(self, rng, devices):
+        from jax.sharding import PartitionSpec as P
+
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.parallel.ulysses import ulysses_attention
+        B, H, S, D = 1, 4, 32, 8
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+                   for _ in range(3))
+        segs = jnp.asarray(
+            np.repeat(np.arange(4), 8)[None, :], jnp.int32)  # 4 docs
+
+        def f(q, k, v, s):
+            return ulysses_attention(q, k, v, "cp", causal=True,
+                                     segment_ids=s)
+
+        got = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=((P(None, None, "cp"),) * 3 + (P(None, "cp"),)),
+            out_specs=P(None, None, "cp"), check_vma=False))(q, k, v, segs)
+        want = flash_attention(q, k, v, causal=True, segment_ids=segs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_head_divisibility_error(self, rng, devices):
+        from jax.sharding import PartitionSpec as P
+
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.parallel.ulysses import ulysses_attention
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        q = jnp.ones((1, 2, 16, 8), jnp.float32)  # 2 heads, cp=4
+
+        def f(q):
+            return ulysses_attention(q, q, q, "cp")
+
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(P(None, None, "cp"),),
+                out_specs=P(None, None, "cp"), check_vma=False))(q)
+
+    def test_llama_ulysses_cp(self, rng, devices):
+        """Llama with cp_impl='ulysses': sharded forward == unsharded."""
+        import dataclasses
+
+        from jax.sharding import PartitionSpec as P
+
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.models.llama import Llama, LlamaConfig
+        cfg = dataclasses.replace(LlamaConfig.tiny(), cp_impl="ulysses")
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 32)),
+                             jnp.int32)
+        plain = Llama(cfg)
+        sharded_model = Llama(cfg, seq_shard_axis="cp")
+        params = plain.init(jax.random.key(0), tokens)["params"]
+        want = plain.apply({"params": params}, tokens)
+
+        def f(p, t):
+            return sharded_model.apply({"params": p}, t)
+
+        got = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(None, "cp")),
+            out_specs=P(None, "cp"), check_vma=False))(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
