@@ -80,6 +80,11 @@ class LlamaConfig:
         defaults.update(kw)
         return LlamaConfig(**defaults)
 
+    def __post_init__(self):
+        if self.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
+                             f"{self.cp_impl!r}")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
@@ -121,13 +126,9 @@ class LlamaBlock(nn.Module):
                 attn = ulysses_attention(q, k, v, self.seq_shard_axis,
                                          causal=True,
                                          segment_ids=segment_ids)
-            elif cfg.cp_impl == "ring":
+            else:  # "ring" — cp_impl validated in LlamaConfig
                 attn = ring_attention(q, k, v, self.seq_shard_axis,
                                       causal=True, segment_ids=segment_ids)
-            else:
-                raise ValueError(
-                    f"cp_impl must be 'ring' or 'ulysses', got "
-                    f"{cfg.cp_impl!r}")
         else:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids)

@@ -54,18 +54,21 @@ def _block(size: int, requested: int) -> int:
 def _auto_blocks(D, block_q, block_k):
     """Default block sizes. Small tiles (128×128) make the grid huge and
     the per-step MXU work tiny — grid/DMA overheads then dominate (measured
-    ~5× on GPT-2 shapes, v5e). Defaults aim for ~2 MiB fp32 score tiles and
-    shrink with the padded head dim so q/k/v blocks + accumulators +
-    double-buffered operands stay inside the generation's VMEM budget
-    (`core.capability.vmem_budget` — the runtime analog of the reference's
-    per-sm kernel specialization in csrc/fmha)."""
+    ~5× on GPT-2 shapes, v5e). Defaults target a ≤1 MiB fp32 score tile
+    (512×512) and shrink with the padded head dim so q/k/v blocks +
+    accumulators + double-buffered operands stay inside the generation's
+    VMEM budget (`core.capability.vmem_budget` — the runtime analog of the
+    reference's per-sm kernel specialization in csrc/fmha)."""
     from apex1_tpu.core.capability import vmem_budget
     Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
     small_vmem = vmem_budget() < 12 * 2**20
     if block_q is None:
         block_q = 256 if (Dp > 512 or small_vmem) else 512
     if block_k is None:
-        block_k = 512 if (Dp > 256 or small_vmem) else 1024
+        # 512 keeps the fp32 score tile at 1 MiB (bq=512): comfortably
+        # inside VMEM with double-buffered operands on every generation;
+        # the step from 1024 halves peak usage for one extra grid level
+        block_k = 256 if (Dp > 512 or small_vmem) else 512
     return block_q, block_k
 
 
