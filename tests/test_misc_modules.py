@@ -109,6 +109,9 @@ class TestASP:
     ("examples/gpt2_amp.py", ["--tiny", "--steps", "3", "--seq", "64"]),
     ("examples/imagenet_amp.py", ["--tiny", "--steps", "3", "--batch",
                                   "8", "--image", "32"]),
+    ("examples/llama_distributed.py", ["--steps", "2", "--tp", "2",
+                                       "--fsdp", "2", "--dp", "2",
+                                       "--batch", "4", "--seq", "64"]),
 ])
 def test_examples_smoke(script, args):
     """≙ reference examples/ as integration tests (SURVEY §4.1 L1)."""
