@@ -57,7 +57,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # must fall back to NumPy, and ctypes raises AttributeError (not
         # OSError) for missing symbols
         lib.apex1_runtime_abi_version.restype = ctypes.c_int
-        if lib.apex1_runtime_abi_version() != 2:
+        if lib.apex1_runtime_abi_version() != 3:
             return None
         i64, vp = ctypes.c_int64, ctypes.c_void_p
         lib.apex1_flatten.argtypes = [ctypes.POINTER(vp),
@@ -78,6 +78,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.apex1_loader_num_sequences.restype = i64
         lib.apex1_loader_next.argtypes = [vp, i64, vp, ctypes.c_int]
         lib.apex1_loader_next.restype = ctypes.c_int
+        lib.apex1_loader_fetch.argtypes = [vp, i64, vp]
+        lib.apex1_loader_fetch.restype = ctypes.c_int
         lib.apex1_loader_close.argtypes = [vp]
         return lib
     except (OSError, AttributeError):
@@ -202,6 +204,31 @@ def _mix64(x: np.ndarray) -> np.ndarray:
         return x ^ (x >> np.uint64(31))
 
 
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _epoch_perm(epoch: np.ndarray, i: np.ndarray, *, seed: int, n: int,
+                pow2: int) -> np.ndarray:
+    """Exact permutation of [0, n) per epoch (cycle-walked affine map over
+    the pow2 ring) — the math of ``TokenLoader::perm``, vectorized."""
+    seed = np.uint64(seed)
+    a = (_mix64(seed ^ _mix64(epoch)) | np.uint64(1))
+    c = _mix64(seed ^ _mix64(epoch ^ np.uint64(0xD1B54A32D192ED03)))
+    m = np.uint64(pow2 - 1)
+    x = i.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (a * x + c) & m
+        todo = x >= np.uint64(n)
+        while np.any(todo):
+            x[todo] = (a[todo] * x[todo] + c[todo]) & m
+            todo = x >= np.uint64(n)
+    return x.astype(np.int64)
+
+
 class TokenDataset:
     """Deterministic LM-pretraining batches from a flat binary token file.
 
@@ -254,9 +281,7 @@ class TokenDataset:
         if self.num_sequences < 1:
             raise ValueError(
                 f"{path}: fewer than one {seq_len}-token sequence")
-        self._pow2 = 1
-        while self._pow2 < self.num_sequences:
-            self._pow2 <<= 1
+        self._pow2 = _next_pow2(self.num_sequences)
 
     @property
     def native(self) -> bool:
@@ -269,18 +294,29 @@ class TokenDataset:
         """Vectorized epoch permutation — mirrors TokenLoader::perm."""
         if not self.shuffle:
             return i.astype(np.int64)
-        seed = np.uint64(self.seed)
-        a = (_mix64(seed ^ _mix64(epoch)) | np.uint64(1))
-        c = _mix64(seed ^ _mix64(epoch ^ np.uint64(0xD1B54A32D192ED03)))
-        m = np.uint64(self._pow2 - 1)
-        x = i.astype(np.uint64)
-        with np.errstate(over="ignore"):
-            x = (a * x + c) & m
-            todo = x >= np.uint64(self.num_sequences)
-            while np.any(todo):
-                x[todo] = (a[todo] * x[todo] + c[todo]) & m
-                todo = x >= np.uint64(self.num_sequences)
-        return x.astype(np.int64)
+        return _epoch_perm(epoch, i, seed=self.seed, n=self.num_sequences,
+                           pow2=self._pow2)
+
+    def fetch(self, seq_index: int, out=None) -> np.ndarray:
+        """One raw sequence by index (no permutation) — the building
+        block `ShardedTokenDataset` routes its global shuffle through.
+        ``out``: optional int32 (seq_len,) buffer to fill in place (the
+        sharded batch loop passes batch rows, avoiding per-row allocs)."""
+        if self._closed:
+            raise RuntimeError("TokenDataset is closed")
+        if not 0 <= seq_index < self.num_sequences:
+            raise IndexError(seq_index)
+        if out is None:
+            out = np.empty((self.seq_len,), np.int32)
+        if self._handle:
+            rc = _LIB.apex1_loader_fetch(self._handle, seq_index,
+                                         out.ctypes.data)
+            if rc != 0:
+                raise RuntimeError(f"loader_fetch failed ({seq_index})")
+            return out
+        lo = seq_index * self.seq_len
+        out[:] = self._tokens[lo:lo + self.seq_len]
+        return out
 
     def batch_at(self, step: int) -> np.ndarray:
         """(batch_size, seq_len) int32 tokens of global step ``step``."""
@@ -318,6 +354,74 @@ class TokenDataset:
             self._finalizer = None
         self._handle = None
         self._tokens = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ShardedTokenDataset:
+    """`TokenDataset` over a sharded corpus (many flat token files) —
+    real pretraining datasets ship as shards. Same contract: pure
+    ``batch_at(step)``, exact global shuffle (one permutation over the
+    CONCATENATED sequence pool, so epoch boundaries and resume semantics
+    are corpus-global, not per-shard), NumPy fallback bit-identical.
+    Shards are mmapped native loaders; rows route to their shard via the
+    cumulative sequence counts. Shard order is the CALLER's order (pass
+    a sorted list for a canonical corpus — no silent re-sorting)."""
+
+    def __init__(self, paths: Sequence[str], *, seq_len: int,
+                 batch_size: int, dtype=np.uint16, seed: int = 0,
+                 shuffle: bool = True):
+        if not paths:
+            raise ValueError("need at least one shard path")
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed) & ((1 << 64) - 1)
+        self.shuffle = bool(shuffle)
+        self._shards = [TokenDataset(str(p), seq_len=seq_len,
+                                     batch_size=1, dtype=dtype, seed=0,
+                                     shuffle=False) for p in paths]
+        counts = [s.num_sequences for s in self._shards]
+        self._starts = np.concatenate([[0], np.cumsum(counts)])
+        self.num_sequences = int(self._starts[-1])
+        self._pow2 = _next_pow2(self.num_sequences)
+
+    @property
+    def native(self) -> bool:
+        return all(s.native for s in self._shards)
+
+    def steps_per_epoch(self) -> int:
+        return self.num_sequences // self.batch_size
+
+    def batch_at(self, step: int) -> np.ndarray:
+        if step < 0:
+            raise ValueError("step must be >= 0")
+        g = np.uint64(step) * np.uint64(self.batch_size) + np.arange(
+            self.batch_size, dtype=np.uint64)
+        epoch = g // np.uint64(self.num_sequences)
+        i = g % np.uint64(self.num_sequences)
+        s = (_epoch_perm(epoch, i, seed=self.seed, n=self.num_sequences,
+                         pow2=self._pow2)
+             if self.shuffle else i.astype(np.int64))
+        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        shard_of = np.searchsorted(self._starts, s, side="right") - 1
+        for r in range(self.batch_size):
+            sh = int(shard_of[r])
+            self._shards[sh].fetch(int(s[r] - self._starts[sh]),
+                                   out=out[r])
+        return out
+
+    def iter_from(self, step: int = 0) -> Iterator[np.ndarray]:
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+    def close(self):
+        for s in self._shards:
+            s.close()
 
     def __enter__(self):
         return self

@@ -246,6 +246,22 @@ int apex1_loader_next(void* h, int64_t step, int32_t* out, int threads) {
   return 0;
 }
 
+// Fetch ONE sequence by raw index (no permutation) — the building block
+// for multi-shard datasets whose global shuffle lives above the shards.
+int apex1_loader_fetch(void* h, int64_t seq_index, int32_t* out) {
+  if (!h) return 1;
+  auto* L = static_cast<TokenLoader*>(h);
+  if (seq_index < 0 || seq_index >= L->n_seqs) return 2;
+  const uint8_t* src = L->map + seq_index * L->seq_len * L->dtype_size;
+  if (L->dtype_size == 2) {
+    auto* p = reinterpret_cast<const uint16_t*>(src);
+    for (int64_t i = 0; i < L->seq_len; ++i) out[i] = p[i];
+  } else {
+    std::memcpy(out, src, L->seq_len * 4);
+  }
+  return 0;
+}
+
 void apex1_loader_close(void* h) {
   if (!h) return;
   auto* L = static_cast<TokenLoader*>(h);
@@ -253,6 +269,6 @@ void apex1_loader_close(void* h) {
   delete L;
 }
 
-int apex1_runtime_abi_version() { return 2; }
+int apex1_runtime_abi_version() { return 3; }
 
 }  // extern "C"

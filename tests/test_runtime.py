@@ -227,3 +227,43 @@ def test_pack_documents_long_doc_positions():
     # chunks are distinct segments either way (no cross-chunk attention)
     row0 = s[0][s[0] >= 0]
     assert len(np.unique(row0)) >= 1
+
+
+def test_sharded_token_dataset(tmp_path):
+    """Global exact shuffle over concatenated shards: one epoch covers
+    every sequence of every shard exactly once; single-file dataset over
+    the concatenation produces the SAME batches (same permutation)."""
+    rng = np.random.default_rng(5)
+    shards, all_toks = [], []
+    for i, n in enumerate((7, 13, 5)):  # sequences per shard (seq_len 8)
+        toks = rng.integers(0, 60000, n * 8).astype(np.uint16)
+        p = str(tmp_path / f"shard{i}.bin")
+        rt.write_token_file(p, toks)
+        shards.append(p)
+        all_toks.append(toks)
+    concat = str(tmp_path / "concat.bin")
+    rt.write_token_file(concat, np.concatenate(all_toks))
+
+    with rt.ShardedTokenDataset(shards, seq_len=8, batch_size=5,
+                                seed=9) as ds, \
+         rt.TokenDataset(concat, seq_len=8, batch_size=5, seed=9) as ref:
+        assert ds.num_sequences == ref.num_sequences == 25
+        assert ds.steps_per_epoch() == 5
+        for step in range(8):  # crosses the epoch boundary
+            np.testing.assert_array_equal(ds.batch_at(step),
+                                          ref.batch_at(step))
+        # epoch coverage: rows of one epoch == all sequences
+        rows = {tuple(ds.batch_at(s)[r]) for s in range(5)
+                for r in range(5)}
+        expect = {tuple(t[i * 8:(i + 1) * 8].astype(np.int32))
+                  for t in all_toks for i in range(len(t) // 8)}
+        assert rows == expect
+
+
+def test_token_dataset_fetch(tmp_path):
+    path, toks = _token_file(tmp_path)
+    with rt.TokenDataset(path, seq_len=16, batch_size=2) as ds:
+        np.testing.assert_array_equal(ds.fetch(3),
+                                      toks[48:64].astype(np.int32))
+        with pytest.raises(IndexError):
+            ds.fetch(ds.num_sequences)
