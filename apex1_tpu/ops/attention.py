@@ -59,16 +59,35 @@ def _auto_blocks(D, block_q, block_k):
     accumulators + double-buffered operands stay inside the generation's
     VMEM budget (`core.capability.vmem_budget` — the runtime analog of the
     reference's per-sm kernel specialization in csrc/fmha)."""
+    import os
+
     from apex1_tpu.core.capability import vmem_budget
+
+    def env_block(name):
+        raw = os.environ.get(name, "").strip()
+        if not raw:
+            return None
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(f"{name}={raw!r} is not an integer") from None
+        if val <= 0:
+            raise ValueError(f"{name} must be > 0, got {val}")
+        return val
+
     Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
     small_vmem = vmem_budget() < 12 * 2**20
     if block_q is None:
-        block_q = 256 if (Dp > 512 or small_vmem) else 512
+        block_q = env_block("APEX1_ATTN_BLOCK_Q") or (
+            256 if (Dp > 512 or small_vmem) else 512)
     if block_k is None:
         # 512 keeps the fp32 score tile at 1 MiB (bq=512): comfortably
         # inside VMEM with double-buffered operands on every generation;
-        # the step from 1024 halves peak usage for one extra grid level
-        block_k = 256 if (Dp > 512 or small_vmem) else 512
+        # the step from 1024 halves peak usage for one extra grid level.
+        # APEX1_ATTN_BLOCK_Q/K override for hardware sweeps without code
+        # edits (tools/bench_kernels.py measures the candidates).
+        block_k = env_block("APEX1_ATTN_BLOCK_K") or (
+            256 if (Dp > 512 or small_vmem) else 512)
     return block_q, block_k
 
 
