@@ -64,6 +64,8 @@ def _auto_blocks(D, block_q, block_k):
     from apex1_tpu.core.capability import vmem_budget
 
     def env_block(name):
+        # read at TRACE time: a sweep must use a fresh process (or clear
+        # the jit cache) per candidate — jit caches don't key on env vars
         raw = os.environ.get(name, "").strip()
         if not raw:
             return None
@@ -71,8 +73,9 @@ def _auto_blocks(D, block_q, block_k):
             val = int(raw)
         except ValueError:
             raise ValueError(f"{name}={raw!r} is not an integer") from None
-        if val <= 0:
-            raise ValueError(f"{name} must be > 0, got {val}")
+        if val <= 0 or val % 16:
+            raise ValueError(f"{name} must be a positive multiple of 16 "
+                             f"(TPU sublane tiling), got {val}")
         return val
 
     Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
