@@ -1,0 +1,211 @@
+"""AOT compile-check the Pallas kernels AND the full bench train steps
+for a real TPU target WITHOUT hardware: libtpu's compile-only PJRT
+topology client lowers through Mosaic exactly as a real chip would, so
+kernel lowering errors, VMEM exhaustion, and whole-step HBM overflow
+surface here instead of in the driver's benchmark run.
+
+Usage: python tools/aot_check.py [--topology v5e:2x2] [--kernels]
+                                 [--steps]            (default: both)
+
+- Kernel checks shard the batch over a dp mesh (Mosaic kernels are not
+  auto-partitionable), sized so PER-DEVICE shapes equal the single-chip
+  bench shapes.
+- Step checks compile the ACTUAL `bench.py` train steps single-device
+  with donated state and report the HBM breakdown — these are the
+  numbers the bench.py batch/layer comments cite.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _gen_from_topology(topology: str) -> str:
+    return topology.split(":")[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="v5e:2x2")
+    ap.add_argument("--kernels", action="store_true")
+    ap.add_argument("--steps", action="store_true")
+    args = ap.parse_args()
+    if not (args.kernels or args.steps):
+        args.kernels = args.steps = True
+
+    # Before ANY apex1_tpu import: make dispatch pick the REAL (non-
+    # interpret) Pallas path, and block planning match the target chip.
+    os.environ["PALLAS_AXON_TPU_GEN"] = _gen_from_topology(args.topology)
+    import apex1_tpu.ops._common as _common
+    _common.on_tpu = lambda: True          # use_pallas() -> True
+    _common.interpret_mode = lambda: False  # real Mosaic lowering
+    # kernel modules bound interpret_mode by value at import in some
+    # refactors — fail loudly if the patch ever stops taking effect
+    assert not _common.interpret_mode()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, SingleDeviceSharding
+    from jax.sharding import PartitionSpec as P
+
+    from apex1_tpu.ops import force_impl
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=args.topology)
+    n = len(topo.devices)
+    mesh = Mesh(np.array(topo.devices).reshape(n), ("dp",))
+    ok = True
+
+    def report(name, lower_fn):
+        nonlocal ok
+        try:
+            mem = lower_fn().compile().memory_analysis()
+            tmp = mem.temp_size_in_bytes / 2**30
+            arg = mem.argument_size_in_bytes / 2**30
+            print(f"  OK   {name:48s} temp {tmp:6.2f} GiB  "
+                  f"args {arg:6.2f} GiB", flush=True)
+        except Exception as e:
+            ok = False
+            print(f"  FAIL {name}: {type(e).__name__}: {str(e)[:300]}",
+                  flush=True)
+
+    def check(name, fn, shapes, *, dtypes=jnp.bfloat16, in_specs=None,
+              grad=False):
+        """Kernel check: shapes are PER-DEVICE; sharded dims scale by n."""
+        if not isinstance(dtypes, (tuple, list)):
+            dtypes = [dtypes] * len(shapes)
+        in_specs = in_specs or (P("dp"),) * len(shapes)
+        # global shape = per-device shape scaled along the sharded dim
+        def gshape(shp, spec):
+            if spec == P():
+                return shp
+            return (shp[0] * n,) + tuple(shp[1:])
+        arrs = [jax.ShapeDtypeStruct(
+                    gshape(shp, spec), dt,
+                    sharding=NamedSharding(mesh, spec))
+                for shp, dt, spec in zip(shapes, dtypes, in_specs)]
+
+        def run():
+            def local(*xs):
+                with force_impl("pallas"):
+                    out = fn(*xs)
+                return out
+
+            if grad:
+                base = local
+
+                def local(*xs):  # noqa: F811
+                    fi = tuple(i for i, x in enumerate(xs)
+                               if jnp.issubdtype(x.dtype, jnp.floating))
+                    return jax.grad(
+                        lambda *a: jnp.sum(base(*a).astype(jnp.float32)),
+                        argnums=fi)(*xs)
+
+            out_specs = jax.tree_util.tree_map(
+                lambda _: P("dp"), jax.eval_shape(local, *arrs))
+            smapped = jax.shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                                    out_specs=out_specs, check_vma=False)
+            return jax.jit(smapped).lower(*arrs)
+
+        report(name, run)
+
+    if args.kernels:
+        print(f"== Pallas kernels (per-device = bench shapes), "
+              f"{args.topology} ==", flush=True)
+        from apex1_tpu.ops import (layer_norm, rms_norm,
+                                   scaled_upper_triang_masked_softmax,
+                                   softmax_cross_entropy_loss)
+        from apex1_tpu.ops.attention import flash_attention
+        from apex1_tpu.ops.linear_xent import linear_cross_entropy
+        from apex1_tpu.ops.rope import apply_rotary_pos_emb, rope_tables
+
+        fa = lambda q, k, v: flash_attention(q, k, v, causal=True)
+        for nm, shp in (("flash gpt2 B16 (16,12,1024,64)",
+                         (16, 12, 1024, 64)),
+                        ("flash longctx (1,32,16384,64)",
+                         (1, 32, 16384, 64))):
+            check(f"{nm} fwd", fa, [shp] * 3)
+            check(f"{nm} fwd+bwd", fa, [shp] * 3, grad=True)
+
+        T, Hid, V = 16 * 1023, 768, 50432
+        check(f"linear_xent gpt2 ({T},{Hid},{V}) fwd+bwd",
+              lambda x, w: linear_cross_entropy(
+                  x, w, jnp.zeros((x.shape[0],), jnp.int32),
+                  num_classes=V - 200),
+              [(T, Hid), (V, Hid)], in_specs=(P("dp"), P()), grad=True)
+
+        g = jnp.ones((768,), jnp.float32)
+        check("layer_norm (16384,768) fwd+bwd",
+              lambda x: layer_norm(x, g, jnp.zeros_like(g)),
+              [(16384, 768)], grad=True)
+        check("rms_norm (16384,2048) fwd+bwd",
+              lambda x: rms_norm(x, jnp.ones((2048,), jnp.float32)),
+              [(16384, 2048)], grad=True)
+        check("causal softmax (16,12,1024,1024) fwd+bwd",
+              lambda x: scaled_upper_triang_masked_softmax(x, scale=0.125),
+              [(16, 12, 1024, 1024)], dtypes=jnp.float32, grad=True)
+        check("xentropy (16368,50432) fwd+bwd",
+              lambda x: softmax_cross_entropy_loss(
+                  x, jnp.zeros((x.shape[0],), jnp.int32),
+                  num_classes=50257),
+              [(16368, 50432)], dtypes=jnp.float32, grad=True)
+        cos, sin = rope_tables(jnp.arange(16384), 64)
+        check("rope llama (1,16384,32,64) fwd+bwd",
+              lambda x: apply_rotary_pos_emb(x, cos, sin),
+              [(1, 16384, 32, 64)], grad=True)
+
+    if args.steps:
+        print(f"== full bench train steps (single device), "
+              f"{args.topology} ==", flush=True)
+        import bench as bench_mod
+        from apex1_tpu.amp import Amp
+        from apex1_tpu.optim.fused_adam import fused_adam
+
+        s1 = SingleDeviceSharding(topo.devices[0])
+
+        def step_check(tag, model, loss_fn, tok_shape):
+            def run():
+                tokens = jax.ShapeDtypeStruct(tok_shape, jnp.int32,
+                                              sharding=s1)
+                pshapes = jax.eval_shape(
+                    model.init, jax.random.key(0),
+                    jnp.zeros(tok_shape, jnp.int32))["params"]
+                amp = Amp(tx=fused_adam(1e-4, weight_decay=0.01),
+                          opt_level="O2")
+                st = jax.eval_shape(amp.init, pshapes)
+                st = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=s1), st)
+                step = amp.make_train_step(loss_fn)
+                return jax.jit(step, donate_argnums=0).lower(st, tokens)
+
+            report(tag, run)
+
+        from apex1_tpu.core.policy import get_policy
+        from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
+        from apex1_tpu.models.llama import (Llama, LlamaConfig,
+                                            llama_loss_fn)
+        m = GPT2(GPT2Config(policy=get_policy("O2")))
+        step_check("gpt2 bench step (B=16, S=1024)", m, gpt2_loss_fn(m),
+                   (16, 1024))
+        cfg = LlamaConfig(vocab_size=32000, max_seq_len=16384,
+                          num_layers=16, num_heads=32, num_kv_heads=4,
+                          hidden_size=2048, ffn_size=5632, remat=True,
+                          policy=get_policy("O2"))
+        mm = Llama(cfg)
+        step_check("llama_longctx bench step (B=1, S=16k, L=16)", mm,
+                   llama_loss_fn(mm), (1, 16384))
+
+    print("ALL OK" if ok else "FAILURES PRESENT", flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
