@@ -4,8 +4,8 @@ topology client lowers through Mosaic exactly as a real chip would, so
 kernel lowering errors, VMEM exhaustion, and whole-step HBM overflow
 surface here instead of in the driver's benchmark run.
 
-Usage: python tools/aot_check.py [--topology v5e:2x2] [--kernels]
-                                 [--steps]            (default: both)
+Usage: python tools/aot_check.py [--topology v5e:2x2]
+        [--kernels] [--steps] [--collectives]   (default: all three)
 
 - Kernel checks shard the batch over a dp mesh (Mosaic kernels are not
   auto-partitionable), sized so PER-DEVICE shapes equal the single-chip
@@ -13,6 +13,10 @@ Usage: python tools/aot_check.py [--topology v5e:2x2] [--kernels]
 - Step checks compile the ACTUAL `bench.py` train steps single-device
   with donated state and report the HBM breakdown — these are the
   numbers the bench.py batch/layer comments cite.
+- Collectives checks compile the distributed shard_map programs (ring
+  attention, Ulysses, MoE double-all_to_all, scan+ppermute pipeline)
+  against the multi-chip topology — ICI collective lowering + Mosaic
+  in one program.
 """
 
 import argparse
@@ -35,9 +39,10 @@ def main():
     ap.add_argument("--topology", default="v5e:2x2")
     ap.add_argument("--kernels", action="store_true")
     ap.add_argument("--steps", action="store_true")
+    ap.add_argument("--collectives", action="store_true")
     args = ap.parse_args()
-    if not (args.kernels or args.steps):
-        args.kernels = args.steps = True
+    if not (args.kernels or args.steps or args.collectives):
+        args.kernels = args.steps = args.collectives = True
 
     # Before ANY apex1_tpu import: make dispatch pick the REAL (non-
     # interpret) Pallas path, and block planning match the target chip.
@@ -45,9 +50,6 @@ def main():
     import apex1_tpu.ops._common as _common
     _common.on_tpu = lambda: True          # use_pallas() -> True
     _common.interpret_mode = lambda: False  # real Mosaic lowering
-    # kernel modules bound interpret_mode by value at import in some
-    # refactors — fail loudly if the patch ever stops taking effect
-    assert not _common.interpret_mode()
 
     import jax.numpy as jnp
     import numpy as np
@@ -62,6 +64,21 @@ def main():
     n = len(topo.devices)
     mesh = Mesh(np.array(topo.devices).reshape(n), ("dp",))
     ok = True
+
+    # Verify the patches reach the DISPATCH THE KERNELS USE (they import
+    # interpret_mode/on_tpu by reference; a refactor that snapshots the
+    # mode at import would silently AOT-check the interpreter instead of
+    # Mosaic): a Mosaic lowering must contain a tpu_custom_call.
+    from apex1_tpu.ops import layer_norm as _ln
+    _s1 = SingleDeviceSharding(topo.devices[0])
+    _txt = jax.jit(
+        lambda x: _ln(x, jnp.ones((128,), jnp.float32),
+                      jnp.zeros((128,), jnp.float32))).lower(
+        jax.ShapeDtypeStruct((8, 128), jnp.float32,
+                             sharding=_s1)).as_text()
+    assert "tpu_custom_call" in _txt or "mosaic" in _txt.lower(), (
+        "Pallas dispatch is NOT taking the Mosaic path — aot_check "
+        "results would be meaningless")
 
     def report(name, lower_fn):
         nonlocal ok
@@ -202,6 +219,106 @@ def main():
         mm = Llama(cfg)
         step_check("llama_longctx bench step (B=1, S=16k, L=16)", mm,
                    llama_loss_fn(mm), (1, 16384))
+
+    if args.collectives:
+        print(f"== distributed shard_map programs (ICI collectives + "
+              f"Mosaic), {args.topology} ==", flush=True)
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.parallel.ring_attention import ring_attention
+        from apex1_tpu.parallel.ulysses import ulysses_attention
+        from apex1_tpu.transformer.moe import (MoEConfig,
+                                               moe_shard_map_apply)
+        from apex1_tpu.transformer.pipeline_parallel.schedules import (
+            pipeline_apply)
+
+        def coll(name, builder):
+            def run():
+                f, arrs = builder()
+                return jax.jit(f).lower(*arrs)
+            report(name, run)
+
+        B, H, S, D = 2, 16, 4096, 128   # S is GLOBAL (sharded over cp=n)
+        cp_mesh = make_mesh(cp=n, dp=1, devices=list(topo.devices))
+
+        def mk_attn(kind):
+            def builder():
+                qs = NamedSharding(cp_mesh, P(None, None, "cp"))
+                arrs = [jax.ShapeDtypeStruct((B, H, S, D), jnp.bfloat16,
+                                             sharding=qs)] * 3
+
+                def local(q, k, v):
+                    with force_impl("pallas"):
+                        if kind == "ring":
+                            return ring_attention(q, k, v, "cp",
+                                                  causal=True)
+                        return ulysses_attention(q, k, v, "cp",
+                                                 causal=True)
+
+                f = jax.shard_map(local, mesh=cp_mesh,
+                                  in_specs=(P(None, None, "cp"),) * 3,
+                                  out_specs=P(None, None, "cp"),
+                                  check_vma=False)
+                return f, arrs
+            return builder
+
+        coll(f"ring attention cp={n} (S={S} global)", mk_attn("ring"))
+        coll(f"ulysses attention cp={n} (S={S} global)", mk_attn("uly"))
+
+        def moe_builder():
+            ep_mesh = make_mesh(ep=n, dp=1, devices=list(topo.devices))
+            cfg = MoEConfig(num_experts=2 * n, top_k=2,
+                            capacity_factor=1.25, hidden_size=2048,
+                            ffn_size=5632)
+            xs = NamedSharding(ep_mesh, P("ep"))
+            ws = NamedSharding(ep_mesh, P("ep"))
+            arrs = [
+                jax.ShapeDtypeStruct((8192 * n, 2048), jnp.bfloat16,
+                                     sharding=xs),
+                jax.ShapeDtypeStruct((2048, 2 * n), jnp.float32,
+                                     sharding=NamedSharding(ep_mesh, P())),
+                jax.ShapeDtypeStruct((2 * n, 2048, 5632), jnp.bfloat16,
+                                     sharding=ws),
+                jax.ShapeDtypeStruct((2 * n, 5632, 2048), jnp.bfloat16,
+                                     sharding=ws),
+            ]
+
+            def local(x, wg, w1, w2):
+                y, aux = moe_shard_map_apply(x, wg, w1, w2, cfg)
+                return y, jax.lax.pmean(aux, "ep")
+
+            f = jax.shard_map(local, mesh=ep_mesh,
+                              in_specs=(P("ep"), P(), P("ep"), P("ep")),
+                              out_specs=(P("ep"), P()), check_vma=False)
+            return f, arrs
+
+        coll(f"MoE all_to_all ep={n} (8k tok/dev, H=2048)", moe_builder)
+
+        def pp_builder():
+            pp_mesh = make_mesh(pp=n, dp=1, devices=list(topo.devices))
+            M, mb, hid = 2 * n, 2, 1024
+            ps = NamedSharding(pp_mesh, P(None, "pp"))
+
+            def stage_fn(p, x):
+                return jnp.tanh(x @ p)
+
+            def local(chunk_params, mbs):
+                local_p = chunk_params[:, 0]   # (V=1, hid, hid)
+                outs = pipeline_apply(stage_fn, local_p, mbs,
+                                      num_chunks=1)
+                return jnp.sum(outs.astype(jnp.float32))
+
+            f = jax.shard_map(
+                local, mesh=pp_mesh,
+                in_specs=(P(None, "pp"), P()), out_specs=P(),
+                check_vma=False)
+            arrs = [jax.ShapeDtypeStruct((1, n, hid, hid), jnp.float32,
+                                         sharding=ps),
+                    jax.ShapeDtypeStruct((M, mb, hid), jnp.float32,
+                                         sharding=NamedSharding(pp_mesh,
+                                                                P()))]
+            return f, arrs
+
+        coll(f"pipeline scan+ppermute pp={n}", pp_builder)
 
     print("ALL OK" if ok else "FAILURES PRESENT", flush=True)
     sys.exit(0 if ok else 1)
