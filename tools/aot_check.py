@@ -179,46 +179,25 @@ def main():
               [(1, 16384, 32, 64)], grad=True)
 
     if args.steps:
-        print(f"== full bench train steps (single device), "
-              f"{args.topology} ==", flush=True)
+        print(f"== full bench train steps (single device, exactly what "
+              f"bench.py runs), {args.topology} ==", flush=True)
         import bench as bench_mod
-        from apex1_tpu.amp import Amp
-        from apex1_tpu.optim.fused_adam import fused_adam
 
         s1 = SingleDeviceSharding(topo.devices[0])
 
-        def step_check(tag, model, loss_fn, tok_shape):
-            def run():
-                tokens = jax.ShapeDtypeStruct(tok_shape, jnp.int32,
-                                              sharding=s1)
-                pshapes = jax.eval_shape(
-                    model.init, jax.random.key(0),
-                    jnp.zeros(tok_shape, jnp.int32))["params"]
-                amp = Amp(tx=fused_adam(1e-4, weight_decay=0.01),
-                          opt_level="O2")
-                st = jax.eval_shape(amp.init, pshapes)
-                st = jax.tree_util.tree_map(
-                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                                   sharding=s1), st)
-                step = amp.make_train_step(loss_fn)
-                return jax.jit(step, donate_argnums=0).lower(st, tokens)
+        def to_shape(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.asarray(x).dtype,
+                                               sharding=s1), tree)
 
-            report(tag, run)
+        for cfg_name in sorted(bench_mod.BENCHES):
+            def run(cfg_name=cfg_name):
+                state, step, batch, *_ = bench_mod.BENCHES[cfg_name](True)
+                return jax.jit(step, donate_argnums=0).lower(
+                    to_shape(state), *to_shape(batch))
 
-        from apex1_tpu.core.policy import get_policy
-        from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
-        from apex1_tpu.models.llama import (Llama, LlamaConfig,
-                                            llama_loss_fn)
-        m = GPT2(GPT2Config(policy=get_policy("O2")))
-        step_check("gpt2 bench step (B=16, S=1024)", m, gpt2_loss_fn(m),
-                   (16, 1024))
-        cfg = LlamaConfig(vocab_size=32000, max_seq_len=16384,
-                          num_layers=16, num_heads=32, num_kv_heads=4,
-                          hidden_size=2048, ffn_size=5632, remat=True,
-                          policy=get_policy("O2"))
-        mm = Llama(cfg)
-        step_check("llama_longctx bench step (B=1, S=16k, L=16)", mm,
-                   llama_loss_fn(mm), (1, 16384))
+            report(f"bench step [{cfg_name}]", run)
 
     if args.collectives:
         print(f"== distributed shard_map programs (ICI collectives + "
