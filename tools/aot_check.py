@@ -299,6 +299,53 @@ def main():
 
         coll(f"pipeline scan+ppermute pp={n}", pp_builder)
 
+        def tp_sp_builder():
+            # phase-1 core of the driver dryrun: Megatron TP + sequence
+            # parallelism fwd+bwd (all-gather fwd / reduce-scatter bwd
+            # pairs + psum) in one integrated program
+            from apex1_tpu.transformer.tensor_parallel import layers as tpl
+            tp_mesh = make_mesh(tp=n, dp=1, devices=list(topo.devices))
+            S_l, mb, hid, ffn = 512, 4, 2048, 8192  # per-dev seq shard
+
+            def local(x, w1, b1, w2, b2):
+                def loss_fn(w1, b1, w2, b2):
+                    h = tpl.column_parallel_linear(
+                        x, w1, b1, sequence_parallel_enabled=True)
+                    h = jax.nn.gelu(h)
+                    h = tpl.row_parallel_linear(
+                        h, w2, bias=b2, sequence_parallel_enabled=True)
+                    return jnp.sum(h.astype(jnp.float32))
+
+                g_w1, g_b1, g_w2, g_b2 = jax.grad(
+                    loss_fn, argnums=(0, 1, 2, 3))(w1, b1, w2, b2)
+                # replicated b2 under SP: local db2 sums only the local
+                # seq shard — psum completes it (and puts the psum
+                # collective on the lowered path, per the section name)
+                return g_w1, g_b1, g_w2, jax.lax.psum(g_b2, "tp")
+
+            f = jax.shard_map(
+                local, mesh=tp_mesh,
+                in_specs=(P("tp"), P(None, "tp"), P("tp"),
+                          P("tp", None), P()),
+                out_specs=(P(None, "tp"), P("tp"), P("tp", None), P()),
+                check_vma=False)
+            ns = lambda spec: NamedSharding(tp_mesh, spec)
+            arrs = [
+                jax.ShapeDtypeStruct((S_l * n, mb, hid), jnp.bfloat16,
+                                     sharding=ns(P("tp"))),
+                jax.ShapeDtypeStruct((hid, ffn), jnp.bfloat16,
+                                     sharding=ns(P(None, "tp"))),
+                jax.ShapeDtypeStruct((ffn,), jnp.bfloat16,
+                                     sharding=ns(P("tp"))),
+                jax.ShapeDtypeStruct((ffn, hid), jnp.bfloat16,
+                                     sharding=ns(P("tp", None))),
+                jax.ShapeDtypeStruct((hid,), jnp.bfloat16,
+                                     sharding=ns(P())),
+            ]
+            return f, arrs
+
+        coll(f"TP+SP column/row linear fwd+bwd tp={n}", tp_sp_builder)
+
     print("ALL OK" if ok else "FAILURES PRESENT", flush=True)
     sys.exit(0 if ok else 1)
 

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Full pre-hardware validation: unit/parity suite on the virtual CPU
+# mesh, driver entry points, and AOT Mosaic/HBM checks for the real TPU
+# target. Exits non-zero on any failure.
+set -e
+cd "$(dirname "$0")/.."
+echo "== pytest (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q
+echo "== driver entry points =="
+python - <<'EOF'
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print("entry OK")
+g.dryrun_multichip(8)
+EOF
+echo "== AOT Mosaic + HBM checks (v5e) =="
+python tools/aot_check.py
+echo "ALL CHECKS PASSED"
