@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import re
 from typing import Optional
 
 import numpy as np
@@ -25,10 +26,16 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
     first backend use (≙ ``DistributedTestBase.setUpClass`` spawning its
     process group). The container's sitecustomize pins
     ``jax_platforms=axon,cpu`` via jax.config, so the env var alone is
-    not enough — we also override through jax.config."""
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={n}")
+    not enough — we also override through jax.config. A pre-existing
+    device-count flag with a different count is replaced, not kept."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    if re.search(pat, flags):
+        flags = re.sub(pat, flag, flags)
+    else:
+        flags = f"{flags} {flag}".strip()
+    os.environ["XLA_FLAGS"] = flags
     import jax
 
     jax.config.update("jax_platforms", "cpu")
