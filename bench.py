@@ -33,6 +33,9 @@ import argparse
 import functools
 import json
 import math
+import signal
+import subprocess
+import sys
 import time
 
 import jax
@@ -40,37 +43,72 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def timed_steps(train_step, state, batch, iters):
-    """(state, metrics, seconds/step) with the loop in one dispatch."""
+def probe_backend(timeout_s=180.0, retries=3, backoff=20.0):
+    """Initialize the backend in a SUBPROCESS first: on a dead axon tunnel,
+    in-process init blocks uninterruptibly (BENCH_r01 died rc=1 with no
+    output), while a subprocess can be killed and retried with backoff.
+    Returns the backend name, or None if it never came up."""
+    # honor JAX_PLATFORMS through jax.config: the container sitecustomize
+    # pins jax_platforms=axon,cpu, which silently overrides the env var
+    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); "
+            "d = jax.devices(); print('BACKEND=' + jax.default_backend())")
+    for attempt in range(retries):
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout_s)
+            for line in out.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1]
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < retries - 1:
+            time.sleep(backoff * (2 ** attempt))
+    return None
 
-    def many_steps(state, n):
+
+def timed_steps(train_step, state, batch, iters):
+    """(seconds/step, flops/step) with the loop in one dispatch.
+
+    The many-step loop is AOT-lowered so ``cost_analysis`` can price one
+    dispatch (→ MFU) without a second compile; the sync reduction covers
+    every output leaf because on the tunneled backend reading back one
+    output does not imply the whole program ran."""
+
+    def many_steps(state):
         def body(_, carry):
             st, _m = carry
             return train_step(st, *batch)
-        return jax.lax.fori_loop(0, n, body, train_step(state, *batch))
+        return jax.lax.fori_loop(0, iters - 1, body,
+                                 train_step(state, *batch))
 
-    many = jax.jit(many_steps, static_argnums=1, donate_argnums=0)
+    compiled = jax.jit(many_steps, donate_argnums=0).lower(state).compile()
+    flops_per_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops_per_step = float(cost["flops"]) / iters
+    except Exception:
+        pass  # cost model unavailable on some backends — MFU omitted
 
     @jax.jit
     def _reduce_all(tree):
-        # one scalar whose dataflow covers EVERY output leaf: on the axon
-        # tunnel backend, reading back a single output does not imply the
-        # whole program ran
         return sum(jnp.sum(leaf.astype(jnp.float32))
                    for leaf in jax.tree.leaves(tree))
 
-    # warmup with the SAME static n so the timed call hits the jit cache
-    state, metrics = many(state, iters - 1)
+    state, metrics = compiled(state)           # warmup (same executable)
     float(_reduce_all((state, metrics)))       # compiles the sync too
 
     t0 = time.perf_counter()
-    state, metrics = many(state, iters - 1)    # n loop iters + 1 leading
+    state, metrics = compiled(state)           # n loop iters + 1 leading
     float(_reduce_all((state, metrics)))       # hard sync, full tree
     dt = time.perf_counter() - t0
     loss = float(metrics["loss"])
     if not math.isfinite(loss):
-        raise SystemExit(f"benchmark loss is not finite: {loss}")
-    return state, metrics, dt / iters
+        raise RuntimeError(f"benchmark loss is not finite: {loss}")
+    return dt / iters, flops_per_step
 
 
 def _amp_state_step(model_loss_fn, params, lr=1e-4):
@@ -224,6 +262,11 @@ BENCHES = {
 }
 
 
+def _emit(record):
+    """The ONE JSON line the driver parses — also on partial failure."""
+    print(json.dumps(record), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="gpt2", choices=sorted(BENCHES))
@@ -231,24 +274,67 @@ def main():
                     help="override batch size (gpt2 config only)")
     ap.add_argument("--seq", type=int, default=None,
                     help="override sequence length (gpt2 config only)")
+    ap.add_argument("--timeout", type=float, default=1500.0,
+                    help="watchdog for build+compile+measure (seconds)")
+    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    ap.add_argument("--probe-retries", type=int, default=3)
     args = ap.parse_args()
 
-    backend = jax.default_backend()
-    on_accel = backend not in ("cpu",)
-    kw = {}
-    if args.config == "gpt2":
-        kw = dict(batch=args.batch, seq=args.seq)
-    (state, step, batch, units_per_step, iters, metric, unit,
-     proxy) = BENCHES[args.config](on_accel, **kw)
+    unit = "images/sec/chip" if args.config == "resnet" else "tokens/sec/chip"
+    fallback = {"metric": f"{unit} {args.config} [unreachable]",
+                "value": 0.0, "unit": unit, "vs_baseline": 0.0}
 
-    _, _, per_step = timed_steps(step, state, batch, iters)
-    rate = units_per_step / per_step
-    print(json.dumps({
-        "metric": f"{metric} [{backend}]",
-        "value": round(rate, 1),
-        "unit": unit,
-        "vs_baseline": round(rate / proxy, 4),
-    }))
+    # honor JAX_PLATFORMS despite the sitecustomize jax_platforms pin
+    # (same dance as probe_backend's subprocess)
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+    backend = probe_backend(args.probe_timeout, args.probe_retries)
+    if backend is None:
+        fallback["error"] = (
+            f"backend init unreachable after {args.probe_retries} probes "
+            f"x {args.probe_timeout:.0f}s")
+        _emit(fallback)
+        return
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"watchdog: exceeded {args.timeout:.0f}s")
+
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(int(args.timeout))
+    try:
+        on_accel = backend not in ("cpu",)
+        kw = {}
+        if args.config == "gpt2":
+            kw = dict(batch=args.batch, seq=args.seq)
+        (state, step, batch, units_per_step, iters, metric, unit,
+         proxy) = BENCHES[args.config](on_accel, **kw)
+
+        per_step, flops_per_step = timed_steps(step, state, batch, iters)
+        signal.alarm(0)
+        rate = units_per_step / per_step
+        record = {
+            "metric": f"{metric} [{backend}]",
+            "value": round(rate, 1),
+            "unit": unit,
+            "vs_baseline": round(rate / proxy, 4),
+        }
+        if flops_per_step is not None and on_accel:
+            from apex1_tpu.core.capability import get_capability
+            peak = get_capability().bf16_tflops * 1e12
+            record["mfu"] = round(flops_per_step / per_step / peak, 4)
+            record["step_ms"] = round(per_step * 1e3, 2)
+        _emit(record)
+    except Exception as e:  # the line must still print on any failure
+        signal.alarm(0)
+        fallback["metric"] = f"{unit} {args.config} [{backend}]"
+        fallback["error"] = f"{type(e).__name__}: {e}"
+        _emit(fallback)
 
 
 if __name__ == "__main__":

@@ -150,6 +150,14 @@ def main():
                          (1, 32, 16384, 64))):
             check(f"{nm} fwd", fa, [shp] * 3)
             check(f"{nm} fwd+bwd", fa, [shp] * 3, grad=True)
+        # GQA (Hq/Hkv = 8): the dkv kernel accumulates the group in VMEM
+        # and writes Hkv-sized fp32 outputs — temp must stay near the
+        # group=1 case, not 8x it
+        gq, gkv = (1, 32, 16384, 64), (1, 4, 16384, 64)
+        check("flash longctx GQA (Hq32/Hkv4,16k,64) fwd", fa,
+              [gq, gkv, gkv])
+        check("flash longctx GQA (Hq32/Hkv4,16k,64) fwd+bwd", fa,
+              [gq, gkv, gkv], grad=True)
 
         T, Hid, V = 16 * 1023, 768, 50432
         check(f"linear_xent gpt2 ({T},{Hid},{V}) fwd+bwd",
