@@ -108,6 +108,23 @@ def get_context_parallel_group() -> str:
     return AXIS_CP
 
 
+def get_embedding_group() -> str:
+    """≙ the reference's embedding group ({first, last} PP stage ranks,
+    built by ``initialize_model_parallel`` for tied input-embedding/LM-head
+    grad sync). Mesh-native: the group IS the pp axis — the embedding-grad
+    all-reduce is a psum over pp in which middle stages contribute zeros
+    (see ``pipeline_parallel.schedules.allreduce_embedding_grads``), which
+    is numerically identical to the reference's two-rank all-reduce."""
+    return AXIS_PP
+
+
+def is_rank_in_embedding_group():
+    """Traced predicate: does this pp rank hold a tied-embedding copy that
+    receives a nonzero grad contribution (first or last stage)?"""
+    s = jax.lax.axis_index(AXIS_PP)
+    return (s == 0) | (s == get_pipeline_model_parallel_world_size() - 1)
+
+
 # -- size getters -----------------------------------------------------------
 
 def get_tensor_model_parallel_world_size() -> int:

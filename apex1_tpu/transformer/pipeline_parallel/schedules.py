@@ -58,6 +58,7 @@ def pipeline_apply(
     *,
     num_chunks: int = 1,
     axis_name: str = AXIS_PP,
+    broadcast_outputs: bool = True,
 ):
     """Run the pipelined forward. MUST be called inside ``shard_map`` over
     ``axis_name``.
@@ -71,8 +72,23 @@ def pipeline_apply(
       across the pp axis (only stage 0 consumes; ≙ the reference reading
       the batch on the first stage).
 
-    Returns (M, ...) outputs of the LAST chunk on every rank (masked psum
-    broadcast — its transpose routes cotangents back to the last stage).
+    GRAD CONVENTIONS (pick by how you differentiate):
+
+    - ``broadcast_outputs=True`` (default): returns (M, ...) outputs of the
+      LAST chunk on every rank (masked psum broadcast). Correct when the
+      loss is differentiated OUTSIDE the ``shard_map`` (``jax.grad`` of the
+      shard_mapped callable) — shard_map's transpose accounts for the
+      replication.
+    - ``broadcast_outputs=False``: returns the PARTIAL outputs — real
+      values on the last stage, zeros elsewhere; their sum over the pp
+      axis is the broadcast value. REQUIRED when ``jax.grad`` runs INSIDE
+      the shard_map (a whole train step in one shard_map): JAX transposes
+      ``psum`` to ``psum``, and with every rank seeding the same replicated
+      loss the broadcast form scales every gradient by P. Under the partial
+      convention, compute per-rank partial losses (mask with the last-stage
+      indicator), take grads, then ``psum`` the loss VALUE for logging;
+      grads of pp-replicated leaves (tied embeddings, shared heads) combine
+      with :func:`allreduce_embedding_grads`.
     """
     P = jax.lax.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
@@ -130,9 +146,77 @@ def pipeline_apply(
             jnp.zeros((M,) + x_shape, dtype))
     (x_recv, fifo, outs), _ = jax.lax.scan(tick, init, jnp.arange(T))
 
+    if not broadcast_outputs:
+        return outs  # accumulated on the last stage only; zeros elsewhere
     # replicate last-stage outputs (transpose: cotangent flows to stage P-1)
     is_last = (s == P - 1).astype(outs.dtype)
     return jax.lax.psum(outs * is_last, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# tied-embedding pipeline (embedding group)
+# ---------------------------------------------------------------------------
+
+def pipeline_tied_apply(
+    stage_fn: Callable,
+    chunk_params,
+    embed_fn: Callable,
+    head_fn: Callable,
+    tied_params,
+    tokens_mb,
+    *,
+    num_chunks: int = 1,
+    axis_name: str = AXIS_PP,
+    broadcast_outputs: bool = True,
+):
+    """Pipeline with a TIED input-embedding / LM-head weight — reference
+    ``parallel_state.initialize_model_parallel``'s embedding group ({first,
+    last} PP stages) plus the post-step embedding-grad all-reduce the
+    schedules issue (§3.4 "embedding-grad all-reduce across embedding
+    group").
+
+    ``tied_params`` (the shared vocab-embedding tree) is REPLICATED across
+    the pp axis — the mesh-native form of "a copy lives on the first and
+    last stage". ``embed_fn(tied_params, tokens) -> (..., D)`` feeds the
+    pipeline; its cotangent is masked to stage 0 by ``pipeline_apply``'s
+    stage-0 input select, so only the first stage's copy accumulates the
+    input-embedding grad. ``head_fn(tied_params, outs) -> z`` is applied to
+    the last-chunk outputs, masked to the last stage, so its cotangent
+    lands on stage P−1 only.
+
+    Grad conventions (see :func:`pipeline_apply`):
+
+    - ``broadcast_outputs=True``: ``z`` is psum-broadcast; differentiate
+      OUTSIDE the shard_map — shard_map's replicated-input transpose then
+      IS the embedding-group all-reduce (tied grads arrive combined).
+    - ``broadcast_outputs=False``: ``z`` is the per-rank PARTIAL (zeros off
+      the last stage; psum the value for logging). For ``jax.grad`` INSIDE
+      the shard_map; combine the tied grads with
+      :func:`allreduce_embedding_grads` — a psum over pp in which middle
+      stages contribute zeros, exactly the reference's embedding-group
+      all-reduce.
+    """
+    P = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    h_mb = jax.vmap(lambda t: embed_fn(tied_params, t))(tokens_mb)
+    outs = pipeline_apply(stage_fn, chunk_params, h_mb,
+                          num_chunks=num_chunks, axis_name=axis_name,
+                          broadcast_outputs=False)
+    z = head_fn(tied_params, outs)
+    last = s == P - 1
+    z = jax.tree_util.tree_map(lambda a: a * last.astype(a.dtype), z)
+    if not broadcast_outputs:
+        return z
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.psum(a, axis_name), z)
+
+
+def allreduce_embedding_grads(tied_grads, axis_name: str = AXIS_PP):
+    """≙ the reference's embedding-grad all-reduce over the embedding group
+    after the pipeline step: sums the first-stage (input embedding) and
+    last-stage (LM head) contributions; middle stages contribute zeros."""
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), tied_grads)
 
 
 # ---------------------------------------------------------------------------

@@ -113,6 +113,168 @@ class TestPipelineBackward:
         assert np.isfinite(float(l1)) and float(l1) == float(l2)
 
 
+class TestTiedEmbedding:
+    """≙ the reference's embedding-group semantics: tied vocab embedding on
+    first+last stages, grads combined by the embedding-group all-reduce,
+    must match the unpartitioned tied model exactly."""
+
+    V_SIZE = 12  # vocab
+
+    @staticmethod
+    def embed_fn(tied, tokens):
+        return tied["emb"][tokens]
+
+    @staticmethod
+    def head_fn(tied, outs):
+        # (M, B, D) @ (V, D)^T -> per-microbatch mean CE against token 0
+        logits = jnp.einsum("mbd,vd->mbv", outs, tied["emb"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(logp[..., 0], axis=(1,))  # (M,)
+
+    def _params(self, rng, P):
+        chunk = make_params(rng, 1, P)
+        tied = {"emb": jnp.asarray(
+            rng.normal(size=(self.V_SIZE, D)) * 0.5, jnp.float32)}
+        return chunk, tied
+
+    def _gold(self, chunk, tied, tokens):
+        def gold(chunk_params, tied_params):
+            h = jax.vmap(lambda t: self.embed_fn(tied_params, t))(tokens)
+            outs = jax.vmap(lambda x: full_model(chunk_params, x))(h)
+            return jnp.mean(self.head_fn(tied_params, outs))
+
+        return jax.value_and_grad(gold, argnums=(0, 1))(chunk, tied)
+
+    @pytest.mark.parametrize("M", [4, 6])
+    def test_tied_grads_outer_convention(self, mesh, rng, M):
+        """broadcast_outputs=True + grad OUTSIDE shard_map: shard_map's
+        replicated-input transpose is the embedding-group all-reduce."""
+        from jax.sharding import PartitionSpec as Ps
+        P = 4
+        chunk, tied = self._params(rng, P)
+        tokens = jnp.asarray(rng.integers(0, self.V_SIZE, (M, 2)),
+                             jnp.int32)
+
+        def inner(chunk_params, tied_params, tokens_mb):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_params)
+            per_mb = schedules.pipeline_tied_apply(
+                stage_fn, local, self.embed_fn, self.head_fn,
+                tied_params, tokens_mb)
+            return jnp.mean(per_mb)
+
+        def f(chunk_params, tied_params, tokens_mb):
+            return jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(Ps(None, "pp"), Ps(), Ps()),
+                out_specs=Ps(), check_vma=False)(
+                    chunk_params, tied_params, tokens_mb)
+
+        loss, (g_chunk, g_tied) = jax.value_and_grad(
+            f, argnums=(0, 1))(chunk, tied, tokens)
+        gold_loss, (gold_chunk, gold_tied) = self._gold(chunk, tied, tokens)
+
+        np.testing.assert_allclose(float(loss), float(gold_loss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g_chunk[k]),
+                                       np.asarray(gold_chunk[k]),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_tied["emb"]),
+                                   np.asarray(gold_tied["emb"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_tied_grads_inside_convention(self, mesh, rng):
+        """broadcast_outputs=False + grad INSIDE shard_map (whole-train-
+        step-in-one-shard_map, the dryrun pattern): partial losses, then
+        the explicit embedding-group all-reduce combines tied grads."""
+        from jax.sharding import PartitionSpec as Ps
+        P, M = 4, 4
+        chunk, tied = self._params(rng, P)
+        tokens = jnp.asarray(rng.integers(0, self.V_SIZE, (M, 2)),
+                             jnp.int32)
+
+        def g_inner(chunk_params, tied_params, tokens_mb):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_params)
+
+            def scalar(local, tp):
+                per_mb = schedules.pipeline_tied_apply(
+                    stage_fn, local, self.embed_fn, self.head_fn,
+                    tp, tokens_mb, broadcast_outputs=False)
+                return jnp.mean(per_mb)  # PARTIAL: sums to loss over pp
+
+            loss_part, (g_local, g_tied) = jax.value_and_grad(
+                scalar, argnums=(0, 1))(local, tied_params)
+            loss = jax.lax.psum(loss_part, "pp")  # logging broadcast
+            g_tied = schedules.allreduce_embedding_grads(g_tied)
+            # chunk grads are per-stage local; restore the stage dim
+            g_chunk = jax.tree_util.tree_map(lambda g: g[:, None], g_local)
+            return loss, g_chunk, g_tied
+
+        loss, g_chunk, g_tied = jax.shard_map(
+            g_inner, mesh=mesh,
+            in_specs=(Ps(None, "pp"), Ps(), Ps()),
+            out_specs=(Ps(), Ps(None, "pp"), Ps()), check_vma=False)(
+                chunk, tied, tokens)
+        gold_loss, (gold_chunk, gold_tied) = self._gold(chunk, tied, tokens)
+
+        np.testing.assert_allclose(float(loss), float(gold_loss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g_chunk[k]),
+                                       np.asarray(gold_chunk[k]),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_tied["emb"]),
+                                   np.asarray(gold_tied["emb"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_inside_grad_partial_convention_pipeline_apply(self, mesh, rng):
+        """Chunk grads taken INSIDE shard_map with broadcast_outputs=False
+        match the unpartitioned model (the broadcast form would scale them
+        by P — transpose(psum) = psum with per-rank seeds)."""
+        from jax.sharding import PartitionSpec as Ps
+        P, M = 4, 4
+        params = make_params(rng, 1, P)
+        mbs = jnp.asarray(rng.normal(size=(M, 2, D)), jnp.float32)
+        targets = jnp.asarray(rng.normal(size=(M, 2, D)), jnp.float32)
+
+        def g_inner(params, mbs, targets):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], params)
+            s = jax.lax.axis_index("pp")
+            last = (s == jax.lax.axis_size("pp") - 1).astype(jnp.float32)
+
+            def scalar(local):
+                outs = schedules.pipeline_apply(stage_fn, local, mbs,
+                                                broadcast_outputs=False)
+                return last * loss_fn(outs, targets)  # PARTIAL loss
+
+            g = jax.grad(scalar)(local)
+            return jax.tree_util.tree_map(lambda g: g[:, None], g)
+
+        g = jax.shard_map(
+            g_inner, mesh=mesh, in_specs=(Ps(None, "pp"), Ps(), Ps()),
+            out_specs=Ps(None, "pp"), check_vma=False)(params, mbs, targets)
+
+        def gold(params):
+            outs = jax.vmap(lambda x: full_model(params, x))(mbs)
+            return loss_fn(outs, targets)
+
+        gold_grads = jax.grad(gold)(params)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g[k]),
+                                       np.asarray(gold_grads[k]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_embedding_group_getters(self, devices, mesh):
+        from jax.sharding import PartitionSpec as Ps
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(1, 4)
+        assert parallel_state.get_embedding_group() == "pp"
+        in_group = jax.shard_map(
+            lambda: parallel_state.is_rank_in_embedding_group()[None],
+            mesh=mesh, in_specs=(), out_specs=Ps("pp"),
+            check_vma=False)()
+        assert list(np.asarray(in_group)) == [True, False, False, True]
+        parallel_state.destroy_model_parallel()
+
+
 class TestNoPipelining:
     def test_grad_accumulation_matches_full_batch(self, rng):
         params = {"w": jnp.asarray(rng.normal(size=(D, D)) * 0.5,
