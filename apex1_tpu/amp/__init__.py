@@ -179,7 +179,12 @@ class Amp:
                 (grads, loss), aux = jax.lax.scan(
                     body, (zeros, jnp.zeros([], jnp.float32)), batch)
                 inv = 1.0 / accum_steps
-                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                # accumulate in fp32, then restore the accum_steps=1 dtype
+                # contract (grads wrt masters carry the master dtype, which
+                # is half under O3-style half-master policies)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g * inv).astype(p.dtype), grads,
+                    state.params)
                 loss = loss * inv
                 if has_aux:
                     # keep metrics["aux"] shape-stable across accum_steps:

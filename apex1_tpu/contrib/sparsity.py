@@ -1,18 +1,28 @@
 """ASP (2:4 structured sparsity) — reference ``apex/contrib/sparsity/
 asp.py :: ASP``, ``sparse_masklib.py``, ``permutation_search_kernels``.
 
-**Documented N/A on TPU** (SURVEY.md §2.3 row 47): the reference's value
-is NVIDIA Ampere's 2:4 sparse tensor cores — hardware the TPU MXU does
-not have, so pruning to the 2:4 pattern buys no TPU speedup. The MASKING
-capability (train-with-frozen-sparsity, mask re-applied after each
-optimizer step) is still provided for model-portability experiments; the
-permutation search and the speedup expectation are not.
+**Speedup documented N/A on TPU** (SURVEY.md §2.3 row 47): the
+reference's speed value is NVIDIA Ampere's 2:4 sparse tensor cores —
+hardware the TPU MXU does not have, so pruning to the 2:4 pattern buys
+no TPU speedup. The ACCURACY machinery is provided in full: mask
+computation, train-with-frozen-sparsity re-application, and the
+channel-permutation search (``permutation_search`` ≙ the reference's
+``permutation_search_kernels``: permute input channels so the 2:4
+pattern retains more magnitude — the accuracy-preserving half of ASP).
+The search is the reference's greedy channel-swap strategy, vectorized
+as dense XLA ops (an all-pairs swap-gain tensor per iteration) instead
+of CUDA kernels.
+
+The reference physically permutes adjacent layers to compensate; that
+model-surgery step stays with the caller (same as the reference's
+offline flow), with the returned permutation as the contract.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def compute_m4n2_mask(w) -> jnp.ndarray:
@@ -23,6 +33,94 @@ def compute_m4n2_mask(w) -> jnp.ndarray:
     groups = w.reshape(*w.shape[:-1], -1, 4)
     ranks = jnp.argsort(jnp.argsort(-jnp.abs(groups), axis=-1), axis=-1)
     return (ranks < 2).reshape(w.shape)
+
+
+def mask_efficacy(w, mask=None) -> jnp.ndarray:
+    """|w| retained by the 2:4 mask / total |w| — the quantity the
+    permutation search maximizes."""
+    if mask is None:
+        mask = compute_m4n2_mask(w)
+    aw = jnp.abs(w)
+    return jnp.sum(aw * mask) / jnp.sum(aw)
+
+
+@jax.jit
+def _swap_gains(aw_perm):
+    """All-pairs column-swap gain matrix for the 2:4 retained magnitude.
+
+    ``aw_perm``: (R, C) |w| with columns in the CURRENT permutation order;
+    groups are consecutive 4-column stripes. Returns (C, C) ``gain`` where
+    ``gain[i, j]`` is the change in total retained magnitude from swapping
+    columns at permuted positions i and j (same-group pairs are 0).
+
+    Per (group g, slot m): with the slot's column removed, sort the 3
+    remaining values per row as a ≤ b ≤ c; for a replacement value x the
+    top-2 sum of {a, b, c, x} is ``max(c, x) + max(b, min(c, x))`` — an
+    elementwise formula, so the whole (G, 4, R, C) candidate space is a
+    few fused max/min ops instead of per-candidate sorts (the vectorized
+    form of the reference's per-swap CUDA evaluation).
+    """
+    R, C = aw_perm.shape
+    G = C // 4
+    g_vals = aw_perm.T.reshape(G, 4, R)                 # (G, 4, R)
+    top2 = jnp.sum(jnp.sort(g_vals, axis=1)[:, 2:], axis=1)   # (G, R)
+    q_cur = jnp.sum(top2, axis=1)                       # (G,)
+
+    # remaining-3 statistics per (g, slot): b = 2nd largest, c = largest
+    idx = jnp.arange(4)
+    keep = idx[None, :] != idx[:, None]                 # (slot, member)
+    rem = jnp.where(keep[None, :, :, None], g_vals[:, None, :, :],
+                    0.0)                                # (G, 4slot, 4, R)
+    rem_sorted = jnp.sort(rem, axis=2)                  # zeros sort first
+    b3, c3 = rem_sorted[:, :, 2], rem_sorted[:, :, 3]   # (G, 4, R)
+
+    # Q of group g with slot m replaced by column x, for every column x
+    x = aw_perm                                          # (R, C)
+    b3e, c3e = b3[..., None], c3[..., None]              # (G, 4, R, 1)
+    top2_rep = (jnp.maximum(c3e, x) +
+                jnp.maximum(b3e, jnp.minimum(c3e, x)))   # (G, 4, R, C)
+    q_rep = jnp.sum(top2_rep, axis=2)                    # (G, 4, C)
+
+    # dq[i, j] = gain on i's group from replacing column i with column j
+    dq = (q_rep - q_cur[:, None, None]).reshape(C, C)
+    gain = dq + dq.T
+    same_group = (jnp.arange(C)[:, None] // 4) == (jnp.arange(C)[None] // 4)
+    return jnp.where(same_group, 0.0, gain)
+
+
+def permutation_search(w, *, max_swaps: int = 256, tol: float = 1e-6):
+    """Greedy channel-permutation search — reference
+    ``permutation_search_kernels`` (``Exhaustive_Search``/channel-swap
+    strategy). Returns ``(perm, mask, efficacy)``:
+
+    - ``perm``: int array (C,), the input-channel order that maximizes the
+      magnitude retained by the 2:4 pattern (apply to this weight's
+      columns AND compensate in the producing layer, as the reference's
+      offline flow does);
+    - ``mask``: boolean mask in the ORIGINAL column order implementing the
+      permuted 2:4 pattern (usable directly by :class:`ASP`);
+    - ``efficacy``: retained/total |w| under the permuted mask.
+
+    Greedy: evaluate the all-pairs swap-gain matrix, apply the best swap,
+    repeat until no swap improves by more than ``tol`` (or ``max_swaps``).
+    """
+    if w.ndim != 2 or w.shape[-1] % 4:
+        raise ValueError("permutation_search expects (rows, cols) with "
+                         "cols a multiple of 4")
+    aw = jnp.abs(jnp.asarray(w, jnp.float32))
+    C = aw.shape[1]
+    perm = np.arange(C)
+    for _ in range(max_swaps):
+        gain = np.asarray(_swap_gains(aw[:, perm]))
+        i, j = np.unravel_index(np.argmax(gain), gain.shape)
+        if gain[i, j] <= tol:
+            break
+        perm[i], perm[j] = perm[j], perm[i]
+    perm = jnp.asarray(perm)
+    mask_permuted = compute_m4n2_mask(jnp.asarray(w)[:, perm])
+    inv = jnp.argsort(perm)
+    mask = mask_permuted[:, inv]
+    return perm, mask, mask_efficacy(jnp.asarray(w), mask)
 
 
 class ASP:

@@ -134,7 +134,12 @@ class GPT2(nn.Module):
         if positions is None:
             pos_emb = wpe[:S].astype(dtype)[None]
         else:
-            pos_emb = wpe[positions].astype(dtype)
+            # out-of-range positions (e.g. runtime.pack_documents chunking
+            # a long document without restart_chunk_positions=True) must
+            # not silently clamp under jit — fill with NaN so the loss
+            # goes non-finite and the mistake is visible immediately
+            pos_emb = jnp.take(wpe, positions, axis=0, mode="fill",
+                               fill_value=jnp.nan).astype(dtype)
         x = wte[tokens].astype(dtype) + pos_emb
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"h{i}")(x, deterministic=deterministic,

@@ -57,9 +57,22 @@ def shard_opt_state_specs(opt_state, *, axis=AXIS_FSDP, param_specs=None):
     pstruct = jax.tree_util.tree_structure(
         param_specs, is_leaf=lambda v: isinstance(v, P))
 
+    def specs_fit(node):
+        """Structure match is not enough: a degenerate params tree (e.g. a
+        single leaf) structurally matches every scalar opt-state leaf, and
+        substituting a rank-k spec onto a 0-d step/count leaf is invalid.
+        Require each spec's length to equal its candidate leaf's rank."""
+        leaves = jax.tree_util.tree_leaves(node)
+        specs = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda v: isinstance(v, P))
+        return all(len(sp) <= len(jnp.shape(lf))
+                   for sp, lf in zip(specs, leaves))  # short specs: JAX
+        # leaves trailing dims replicated, so len(sp) <= rank is valid
+
     def walk(node):
         try:
-            if jax.tree_util.tree_structure(node) == pstruct:
+            if (jax.tree_util.tree_structure(node) == pstruct
+                    and specs_fit(node)):
                 return param_specs
         except Exception:
             pass

@@ -42,6 +42,25 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
     jax.config.update("jax_threefry_partitionable", True)
 
 
+def enable_persistent_compilation_cache(default_dir: str | None = None
+                                        ) -> None:
+    """Point JAX's persistent compilation cache at ``APEX1_JAX_CACHE_DIR``
+    (or ``default_dir``, or ``<repo>/.jax_cache``). The validation gates on
+    a single-core box are compile-dominated; a warm cache is what makes
+    re-running them cheap. Set ``APEX1_JAX_CACHE_DIR=`` (empty) to
+    disable."""
+    if default_dir is None:
+        default_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    cache = os.environ.get("APEX1_JAX_CACHE_DIR", default_dir)
+    if not cache:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 def set_random_seed(seed: int):
     """``testing/commons.py :: set_random_seed`` — numpy + a JAX key."""
     import jax

@@ -120,9 +120,11 @@ def get_embedding_group() -> str:
 
 def is_rank_in_embedding_group():
     """Traced predicate: does this pp rank hold a tied-embedding copy that
-    receives a nonzero grad contribution (first or last stage)?"""
+    receives a nonzero grad contribution (first or last stage)? Valid
+    inside any ``shard_map`` over a mesh with a pp axis (reads the
+    enclosing mesh, not the module-level global)."""
     s = jax.lax.axis_index(AXIS_PP)
-    return (s == 0) | (s == get_pipeline_model_parallel_world_size() - 1)
+    return (s == 0) | (s == jax.lax.axis_size(AXIS_PP) - 1)
 
 
 # -- size getters -----------------------------------------------------------

@@ -8,9 +8,11 @@ mesh/pjit/shard_map/collective coverage (SURVEY.md §4.2.4). The mechanism
 necessary) lives in `apex1_tpu.testing.force_virtual_cpu_devices`.
 """
 
-from apex1_tpu.testing import force_virtual_cpu_devices
+from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                               force_virtual_cpu_devices)
 
 force_virtual_cpu_devices(8)
+enable_persistent_compilation_cache()
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

@@ -91,6 +91,32 @@ class TestASP:
                 top2 = set(np.argsort(-wg[i, j])[:2])
                 assert kept == top2
 
+    def test_permutation_search_improves_crafted_case(self, rng):
+        """Columns arranged so all large magnitudes share one stripe: the
+        unpermuted 2:4 mask must drop large entries; the searched
+        permutation spreads them and strictly improves efficacy."""
+        from apex1_tpu.contrib.sparsity import (mask_efficacy,
+                                                permutation_search)
+        R, C = 8, 8
+        w = np.full((R, C), 0.01, np.float32)
+        w[:, :4] = 10.0 + rng.random((R, 4))   # one all-large stripe
+        w = jnp.asarray(w)
+        base = float(mask_efficacy(w))
+        perm, mask, eff = permutation_search(w)
+        assert sorted(np.asarray(perm).tolist()) == list(range(C))
+        # mask is a valid 2:4 pattern in the PERMUTED order
+        mp = np.asarray(mask)[:, np.asarray(perm)].reshape(R, C // 4, 4)
+        assert np.all(mp.sum(-1) == 2)
+        assert float(eff) > base + 0.2  # large entries now all retained
+
+    def test_permutation_search_never_hurts(self, rng):
+        from apex1_tpu.contrib.sparsity import (mask_efficacy,
+                                                permutation_search)
+        w = jnp.asarray(rng.normal(size=(16, 12)), jnp.float32)
+        base = float(mask_efficacy(w))
+        _, _, eff = permutation_search(w, max_swaps=64)
+        assert float(eff) >= base - 1e-6
+
     def test_apply_masks(self, rng):
         params = {"dense": {"kernel": jnp.asarray(
             rng.normal(size=(8, 8)), jnp.float32),

@@ -28,6 +28,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent cache: repeated AOT gates on this single-core box are
+# compile-dominated; cached Mosaic/XLA artifacts make re-runs cheap
+from apex1_tpu.testing import (  # noqa: E402
+    enable_persistent_compilation_cache)
+
+enable_persistent_compilation_cache()
 
 
 def _gen_from_topology(topology: str) -> str:
