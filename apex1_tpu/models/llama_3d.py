@@ -1,0 +1,348 @@
+"""Llama under full 3D parallelism — dp × pp × tp (+ Megatron sequence
+parallelism on tp) in ONE ``shard_map`` train step.
+
+This is BASELINE config 4 ("Llama-3 8B, TP/PP on XLA mesh") as a
+reusable step builder: the manual-collective composition of
+- ``transformer.tensor_parallel`` mappings/layers (Megatron TP + SP:
+  one sequence all-gather feeding the fused-QKV and gate/up matmuls,
+  reduce-scatter after the row-parallel projections — ≙ reference
+  `tensor_parallel/layers.py :: ColumnParallelLinear/RowParallelLinear`
+  with ``sequence_parallel_enabled``),
+- ``ops.flash_attention`` (Pallas, GQA) + ``ops.apply_rotary_pos_emb``
+  + ``ops.rms_norm`` inside each pipeline stage,
+- ``pipeline_parallel.schedules.pipeline_apply`` with the PARTIAL-loss
+  convention (grad taken inside the shard_map; see the grad-conventions
+  note in `schedules` and docs/parallel.md),
+- vocab-parallel embedding + fused LM-head cross-entropy
+  (`tensor_parallel.vocab_parallel_linear_cross_entropy`), both
+  pp-replicated with embedding-group grad combination
+  (`schedules.allreduce_embedding_grads` ≙ reference
+  `parallel_state` embedding group).
+
+Pipeline boundary activations are SEQUENCE-SHARDED over tp — the
+reference's `p2p_communication.py` scatter-gather-tensors-in-pipeline
+optimization (split boundary tensors over the TP group to cut p2p
+traffic by tp×) falls out of the SP layout for free here.
+
+Gradient combination map (inside-grad convention):
+- all leaves: pmean over dp;
+- tp-sharded matmul shards (wq/wk/wv/wo/w_gate/w_up/w_down, emb/head
+  rows): exact locally;
+- tp-replicated norms computed on sequence shards: psum over tp;
+- pp-replicated embedding/head/final_norm (used on first/last stage
+  only): psum over pp (the embedding-group all-reduce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from apex1_tpu.core.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_mesh
+from apex1_tpu.models.llama import LlamaConfig
+from apex1_tpu.ops import apply_rotary_pos_emb, rms_norm, rope_tables
+from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.transformer.pipeline_parallel.schedules import (
+    allreduce_embedding_grads, pipeline_apply)
+from apex1_tpu.transformer.tensor_parallel import mappings as mp
+from apex1_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_linear_cross_entropy)
+from apex1_tpu.transformer.tensor_parallel.layers import (
+    vocab_parallel_embedding)
+
+
+@dataclasses.dataclass(frozen=True)
+class Llama3DConfig:
+    model: LlamaConfig
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    num_microbatches: int = 4
+    microbatch_size: int = 1          # sequences per dp replica per mb
+    learning_rate: float = 1e-4
+
+    def __post_init__(self):
+        m = self.model
+        if m.num_layers % self.pp:
+            raise ValueError("num_layers must divide by pp")
+        if m.num_heads % self.tp or m.num_kv_heads % self.tp:
+            raise ValueError("head counts must divide by tp")
+        if m.vocab_size % self.tp:
+            raise ValueError("vocab_size must divide by tp")
+        if m.max_seq_len % self.tp:
+            raise ValueError("seq len must divide by tp (SP shards)")
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.model.num_layers // self.pp
+
+
+def _layer_leaf_shapes(cfg: Llama3DConfig):
+    m = cfg.model
+    E, F = m.hidden_size, m.ffn_size
+    HD, KD = m.num_heads * m.head_dim, m.num_kv_heads * m.head_dim
+    return {
+        "attn_norm": (E,), "mlp_norm": (E,),
+        "wq": (E, HD), "wk": (E, KD), "wv": (E, KD), "wo": (HD, E),
+        "w_gate": (E, F), "w_up": (E, F), "w_down": (F, E),
+    }
+
+
+def chunk_param_specs(cfg: Llama3DConfig):
+    """PartitionSpecs for the (V=1, pp, layers/pp, ...) stacked tree."""
+    col = P(None, AXIS_PP, None, None, AXIS_TP)
+    row = P(None, AXIS_PP, None, AXIS_TP, None)
+    norm = P(None, AXIS_PP, None, None)
+    return {
+        "attn_norm": norm, "mlp_norm": norm,
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "w_gate": col, "w_up": col, "w_down": row,
+    }
+
+
+def shared_param_specs():
+    return {"emb": P(AXIS_TP, None), "head": P(AXIS_TP, None),
+            "final_norm": P()}
+
+
+def init_params(cfg: Llama3DConfig, seed: int = 0):
+    """Global (unsharded) param trees: (chunk_params, shared_params)."""
+    m = cfg.model
+    rng = np.random.default_rng(seed)
+    V, PP, L = m.vocab_size, cfg.pp, cfg.layers_per_stage
+
+    def norm_init(shape):
+        return jnp.ones((1, PP, L) + shape, jnp.float32)
+
+    def w_init(shape):
+        return jnp.asarray(
+            rng.normal(size=(1, PP, L) + shape) * 0.02, jnp.float32)
+
+    chunk = {k: (norm_init(s) if "norm" in k else w_init(s))
+             for k, s in _layer_leaf_shapes(cfg).items()}
+    shared = {
+        "emb": jnp.asarray(
+            rng.normal(size=(V, m.hidden_size)) * 0.02, jnp.float32),
+        "head": jnp.asarray(
+            rng.normal(size=(V, m.hidden_size)) * 0.02, jnp.float32),
+        "final_norm": jnp.ones((m.hidden_size,), jnp.float32),
+    }
+    return chunk, shared
+
+
+def abstract_state(cfg: Llama3DConfig, mesh):
+    """ShapeDtypeStruct trees (with NamedShardings) for the train state
+    and (tokens, labels) — lets AOT checks lower the full 8B-scale step
+    without materializing 100+ GB of host arrays."""
+    from apex1_tpu.optim.fused_adam import FusedAdamState
+
+    m = cfg.model
+    PP, L, V = cfg.pp, cfg.layers_per_stage, m.vocab_size
+
+    def sds(shape, spec, dtype=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    cspecs, sspecs = chunk_param_specs(cfg), shared_param_specs()
+    chunk = {k: sds((1, PP, L) + shp, cspecs[k])
+             for k, shp in _layer_leaf_shapes(cfg).items()}
+    shared = {"emb": sds((V, m.hidden_size), sspecs["emb"]),
+              "head": sds((V, m.hidden_size), sspecs["head"]),
+              "final_norm": sds((m.hidden_size,), sspecs["final_norm"])}
+    params = {"chunk": chunk, "shared": shared}
+    state = {
+        "step": sds((), P(), jnp.int32),
+        "params": params,
+        "opt": FusedAdamState(
+            step=sds((), P(), jnp.int32),
+            exp_avg=jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding),
+                params),
+            exp_avg_sq=jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding),
+                params)),
+    }
+    dshape = (cfg.num_microbatches, m.max_seq_len,
+              cfg.microbatch_size * cfg.dp)
+    data = sds(dshape, P(None, None, AXIS_DP), jnp.int32)
+    return state, data
+
+
+def from_llama_params(params, cfg: Llama3DConfig):
+    """Convert a `models.llama.Llama` param tree (layer{i}/wq, …,
+    tok_embeddings, output, norm) into the stacked 3D trees — the parity
+    bridge the tests use."""
+    L, PP = cfg.layers_per_stage, cfg.pp
+
+    def stack(leaf_name):
+        return jnp.stack(
+            [jnp.stack([params[f"layer{s * L + j}"][leaf_name]
+                        for j in range(L)]) for s in range(PP)])[None]
+
+    chunk = {k: stack(k) for k in _layer_leaf_shapes(cfg)}
+    shared = {"emb": params["tok_embeddings"],
+              "head": params["output"],
+              "final_norm": params["norm"]}
+    return chunk, shared
+
+
+def _stage_fn(cfg: Llama3DConfig, cos, sin):
+    """One pipeline stage over the LOCAL shards: x (S/tp, mb, E) bf16,
+    sequence-sharded over tp (Megatron (s, b, h) layout)."""
+    m = cfg.model
+    tp = cfg.tp
+    Hl, Kl, D = m.num_heads // tp, m.num_kv_heads // tp, m.head_dim
+    E = m.hidden_size
+    dt = m.policy.compute_dtype
+
+    def layer(x, lp):
+        # attention: norm on seq shards, ONE seq all-gather feeds q/k/v
+        h = rms_norm(x, lp["attn_norm"], eps=m.norm_eps).astype(dt)
+        h = mp.gather_from_sequence_parallel_region(h, AXIS_TP, 0, True)
+        S, mb = h.shape[0], h.shape[1]
+        q = (h @ lp["wq"].astype(dt)).reshape(S, mb, Hl, D)
+        k = (h @ lp["wk"].astype(dt)).reshape(S, mb, Kl, D)
+        v = (h @ lp["wv"].astype(dt)).reshape(S, mb, Kl, D)
+        q = apply_rotary_pos_emb(q.transpose(1, 0, 2, 3), cos, sin)
+        k = apply_rotary_pos_emb(k.transpose(1, 0, 2, 3), cos, sin)
+        v = v.transpose(1, 0, 2, 3)
+        attn = flash_attention(*(t.transpose(0, 2, 1, 3)
+                                 for t in (q, k, v)), causal=True)
+        attn = attn.transpose(2, 0, 1, 3).reshape(S, mb, Hl * D)
+        o = attn @ lp["wo"].astype(dt)
+        o = mp.reduce_scatter_to_sequence_parallel_region(o, AXIS_TP, 0)
+        x = x + o.astype(x.dtype)
+
+        # MLP: same SP pattern, one gather feeds gate+up
+        h = rms_norm(x, lp["mlp_norm"], eps=m.norm_eps).astype(dt)
+        h = mp.gather_from_sequence_parallel_region(h, AXIS_TP, 0, True)
+        y = (jax.nn.silu(h @ lp["w_gate"].astype(dt))
+             * (h @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
+        y = mp.reduce_scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
+        return x + y.astype(x.dtype)
+
+    if m.remat:
+        layer = jax.checkpoint(layer)
+
+    def stage(p_stage, x):
+        # p_stage leaves: (layers_per_stage, ...) — scan keeps the jaxpr
+        # O(1) in depth (16 layers/stage at 8B scale); remat(layer) inside
+        # scan is the standard activation-checkpoint pattern
+        x, _ = jax.lax.scan(lambda x, lp: (layer(x, lp), None),
+                            x, p_stage)
+        return x
+
+    return stage
+
+
+def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
+            cos, sin):
+    """PARTIAL loss (sums to the global mean CE over the pp axis). Runs
+    inside shard_map over (dp, pp, tp). ``tokens``/``labels``:
+    (M, S, mb) int32, already dp-sharded on mb by the in_specs."""
+    m = cfg.model
+    tp = cfg.tp
+    dt = m.policy.compute_dtype
+    stage = _stage_fn(cfg, cos, sin)
+
+    def embed(tok_m):  # (S, mb) -> (S/tp, mb, E) seq shard
+        y = vocab_parallel_embedding(tok_m, shared_local["emb"].astype(dt))
+        return mp.scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
+
+    h_mb = jax.vmap(embed)(tokens)            # (M, S/tp, mb, E)
+    local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_local)
+    outs = pipeline_apply(stage, local, h_mb, num_chunks=1,
+                          broadcast_outputs=False)
+
+    o = rms_norm(outs, shared_local["final_norm"], eps=m.norm_eps)
+    o = o.astype(dt)
+    # fused LM-head CE: local tokens seq-major-first so the op's internal
+    # tp all-gather reconstructs the global token order (dryrun pattern)
+    M, S_loc, mb, E = o.shape
+    x_tok = o.transpose(1, 0, 2, 3).reshape(-1, E)
+    lbl = labels.reshape(M, tp, S_loc, mb).transpose(1, 2, 0, 3)
+    lbl = lbl.reshape(-1)
+    ce = vocab_parallel_linear_cross_entropy(
+        x_tok, shared_local["head"].astype(dt), lbl,
+        sequence_parallel_input=True)
+    last = (jax.lax.axis_index(AXIS_PP)
+            == jax.lax.axis_size(AXIS_PP) - 1).astype(jnp.float32)
+    return last * jnp.mean(ce)
+
+
+def combine_grads(g_chunk, g_shared):
+    """The full combination map for the inside-grad convention."""
+    g_chunk = jax.lax.pmean(g_chunk, AXIS_DP)
+    g_shared = jax.lax.pmean(g_shared, AXIS_DP)
+    g_chunk = {k: (jax.lax.psum(v, AXIS_TP) if "norm" in k else v)
+               for k, v in g_chunk.items()}
+    # final_norm: computed on seq shards (tp-partial) on the last stage
+    g_shared["final_norm"] = jax.lax.psum(g_shared["final_norm"], AXIS_TP)
+    # embedding group: emb lives on stage 0, head + final_norm on the
+    # last stage; psum over pp completes them (middle stages are zero)
+    g_shared = allreduce_embedding_grads(g_shared, AXIS_PP)
+    return g_chunk, g_shared
+
+
+def build_step(cfg: Llama3DConfig, mesh):
+    """The jitted shard_map train step alone (no state materialization) —
+    ``step(state, tokens, labels) -> (state, loss)``. Pair with
+    `abstract_state` for AOT lowering at 8B scale."""
+    import optax
+
+    from apex1_tpu.optim.fused_adam import FusedAdamState, fused_adam
+
+    m = cfg.model
+    tx = fused_adam(cfg.learning_rate)
+    param_specs = {"chunk": chunk_param_specs(cfg),
+                   "shared": shared_param_specs()}
+    state_specs = {"step": P(), "params": param_specs,
+                   "opt": FusedAdamState(step=P(), exp_avg=param_specs,
+                                         exp_avg_sq=param_specs)}
+    cos, sin = rope_tables(jnp.arange(m.max_seq_len), m.head_dim,
+                           base=m.rope_base)
+    data_spec = P(None, None, AXIS_DP)       # (M, S, mb)
+
+    def train_step(state, tokens, labels):
+        def scalar(params):
+            return loss_fn(cfg, params["chunk"], params["shared"],
+                           tokens, labels, cos, sin)
+
+        loss_part, grads = jax.value_and_grad(scalar)(state["params"])
+        loss = jax.lax.psum(loss_part, AXIS_PP)
+        loss = jax.lax.pmean(loss, AXIS_DP)
+        g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"])
+        grads = {"chunk": g_chunk, "shared": g_shared}
+        updates, new_opt = tx.update(grads, state["opt"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"step": state["step"] + 1, "params": new_params,
+                 "opt": new_opt}, loss)
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(state_specs, data_spec, data_spec),
+        out_specs=(state_specs, P()),
+        check_vma=False), donate_argnums=0)
+    return step, state_specs, data_spec, tx
+
+
+def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
+    """Returns ``(step, state, data_spec)`` with a materialized initial
+    state, fused Adam on fp32 masters. ``params`` overrides the random
+    init (e.g. `from_llama_params` output)."""
+    if mesh is None:
+        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, tp=cfg.tp)
+    step, _state_specs, data_spec, tx = build_step(cfg, mesh)
+    if params is None:
+        chunk, shared = init_params(cfg)
+        params = {"chunk": chunk, "shared": shared}
+    state = {"step": jnp.zeros([], jnp.int32), "params": params,
+             "opt": tx.init(params)}
+    return step, state, data_spec

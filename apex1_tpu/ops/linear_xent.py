@@ -179,9 +179,15 @@ def _auto_blocks(Hp, block_t, block_v):
     blocks are honored as-is."""
     from apex1_tpu.core.capability import vmem_budget
     acc_budget = vmem_budget() // 4
-    cap = max(16, acc_budget // (4 * Hp) // 16 * 16)
-    bt = min(block_t, cap) if block_t is not None else min(256, cap)
-    bv = min(block_v, cap) if block_v is not None else min(512, cap)
+    # BOTH fp32 accumulators (dx (bt, Hp) + dw (bv, Hp)) share the frame
+    # with double-buffered operand tiles; bound their SUM, with the 3/4
+    # headroom measured on v5p at H=4096 (bt+bv=512 OOMs, 384 fits —
+    # AOT-verified in tools/aot_check.py --flagship)
+    cap_total = max(32, int(acc_budget * 0.75) // (4 * Hp) // 16 * 16)
+    bt = block_t if block_t is not None else min(
+        256, max(16, cap_total // 3 // 16 * 16))
+    bv = block_v if block_v is not None else min(
+        512, max(16, cap_total - bt))
     return bt, bv
 
 

@@ -59,9 +59,19 @@ def pipeline_apply(
     num_chunks: int = 1,
     axis_name: str = AXIS_PP,
     broadcast_outputs: bool = True,
+    remat_stage: bool = False,
+    scan_unroll: int | bool = 1,
 ):
     """Run the pipelined forward. MUST be called inside ``shard_map`` over
     ``axis_name``.
+
+    ``remat_stage=True`` wraps ``stage_fn`` in ``jax.checkpoint``: the
+    backward scan then recomputes each tick's stage activations instead
+    of storing them, bounding per-stage activation memory at O(1 tick) +
+    boundary carries — the memory property the reference's 1F1B schedule
+    achieves by interleaving backward steps (``deallocate_output_tensor``,
+    warmup ``p − rank − 1``). Measured numbers: docs/parallel.md
+    ("Pipeline cost model").
 
     - ``stage_fn(params_chunk, x) -> y``: one pipeline-chunk forward; input
       and output must have identical shape/dtype (boundary activation).
@@ -90,6 +100,8 @@ def pipeline_apply(
       grads of pp-replicated leaves (tied embeddings, shared heads) combine
       with :func:`allreduce_embedding_grads`.
     """
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
     P = jax.lax.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
@@ -144,7 +156,11 @@ def pipeline_apply(
     init = (zeros_x,
             jnp.zeros((M,) + x_shape, dtype),
             jnp.zeros((M,) + x_shape, dtype))
-    (x_recv, fifo, outs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+    # scan_unroll > 1 lets XLA software-pipeline the tick loop (overlap a
+    # tick's ppermute with the next tick's compute); True also makes every
+    # tick visible to cost_analysis (tools/pipeline_cost.py)
+    (x_recv, fifo, outs), _ = jax.lax.scan(tick, init, jnp.arange(T),
+                                           unroll=scan_unroll)
 
     if not broadcast_outputs:
         return outs  # accumulated on the last stage only; zeros elsewhere

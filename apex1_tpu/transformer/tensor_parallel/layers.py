@@ -209,7 +209,12 @@ def vocab_parallel_embedding(tokens, table_shard, *, axis_name=AXIS_TP):
     local = jnp.clip(local, 0, per - 1)
     y = jnp.take(table_shard, local, axis=0)
     y = jnp.where(in_shard[..., None], y, 0.0)
-    return jax.lax.psum(y, axis_name)
+    # custom-VJP reduce (all-reduce fwd, identity bwd), NOT raw psum: raw
+    # psum transposes to psum, which under grad-inside-shard_map would
+    # scale the table cotangent by tp (each rank seeds the replicated
+    # output); identity-bwd routes each row's cotangent to the one rank
+    # whose mask kept it — exact under both grad conventions
+    return mp.reduce_from_tensor_model_parallel_region(y, axis_name)
 
 
 def set_tensor_model_parallel_attributes(spec_tree):

@@ -138,6 +138,8 @@ class TestASP:
     ("examples/llama_distributed.py", ["--steps", "2", "--tp", "2",
                                        "--fsdp", "2", "--dp", "2",
                                        "--batch", "4", "--seq", "64"]),
+    ("examples/gpt2_pp_tied.py", ["--steps", "3", "--seq", "32",
+                                  "--hidden", "32"]),
 ])
 def test_examples_smoke(script, args):
     """≙ reference examples/ as integration tests (SURVEY §4.1 L1)."""

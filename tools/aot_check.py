@@ -46,9 +46,15 @@ def main():
     ap.add_argument("--kernels", action="store_true")
     ap.add_argument("--steps", action="store_true")
     ap.add_argument("--collectives", action="store_true")
+    ap.add_argument("--flagship", action="store_true",
+                    help="Llama-3-8B dp x pp x tp train step at v5p-32 "
+                         "scale (BASELINE config 4)")
+    ap.add_argument("--flagship-topology", default="v5p:2x2x4")
     args = ap.parse_args()
-    if not (args.kernels or args.steps or args.collectives):
+    if not (args.kernels or args.steps or args.collectives
+            or args.flagship):
         args.kernels = args.steps = args.collectives = True
+        args.flagship = True
 
     # Before ANY apex1_tpu import: make dispatch pick the REAL (non-
     # interpret) Pallas path, and block planning match the target chip.
@@ -359,6 +365,76 @@ def main():
             return f, arrs
 
         coll(f"TP+SP column/row linear fwd+bwd tp={n}", tp_sp_builder)
+
+    if args.flagship:
+        # BASELINE config 4 at target scale: Llama-3-8B full 3D train
+        # step (dp x pp x tp + SP + remat + fused Adam) against a
+        # v5p-32-class topology — OOMs surface HERE, not on hardware
+        ftopo_name = args.flagship_topology
+        print(f"== flagship: Llama-3-8B dp2 x pp2 x tp4 (+SP, remat) "
+              f"train step, {ftopo_name} ==", flush=True)
+        from apex1_tpu.core.mesh import make_mesh as mk
+        from apex1_tpu.core.policy import get_policy
+        from apex1_tpu.models.llama import LlamaConfig
+        from apex1_tpu.models.llama_3d import (Llama3DConfig,
+                                               abstract_state, build_step)
+
+        from apex1_tpu.core import capability as _cap
+
+        os.environ["PALLAS_AXON_TPU_GEN"] = _gen_from_topology(ftopo_name)
+        # earlier sections cached the --topology generation; the Pallas
+        # block planners must see the flagship chip's VMEM budget
+        _cap.detect_generation.cache_clear()
+        ftopo = topologies.get_topology_desc(platform="tpu",
+                                             topology_name=ftopo_name)
+        fn_dev = len(ftopo.devices)
+        # dp=2 fixed; tp bounded by the 8 kv heads; pp >= 2 so the
+        # pipeline axis is actually exercised
+        cands = [t for t in (1, 2, 4, 8)
+                 if fn_dev % (2 * t) == 0 and fn_dev // (2 * t) >= 2]
+        if not cands:
+            raise SystemExit(f"--flagship-topology needs >= 8 chips with "
+                             f"even count, got {fn_dev}")
+        tp = max(cands)
+        dp = 2
+        pp = fn_dev // (dp * tp)
+        gen = _gen_from_topology(ftopo_name)
+        print(f"   mesh dp={dp} pp={pp} tp={tp} over {fn_dev} chips",
+              flush=True)
+        # 8B defaults, bf16 compute, per-layer remat
+        mcfg = LlamaConfig(policy=get_policy("O2"), remat=True)
+        fcfg = Llama3DConfig(model=mcfg, dp=dp, pp=pp, tp=tp,
+                             num_microbatches=max(4, 2 * pp),
+                             microbatch_size=1)
+        fmesh = mk(dp=dp, pp=pp, tp=tp, devices=list(ftopo.devices),
+                   allow_split_physical_axes=True)
+
+        def flagship_run():
+            step, _, _, _ = build_step(fcfg, fmesh)
+            state, data = abstract_state(fcfg, fmesh)
+            return step.lower(state, data, data)
+
+        report(f"flagship 8B train step ({gen} x{fn_dev})", flagship_run)
+        # analytic per-stage parameter budget (SPMD allocates the
+        # pp-replicated embedding/head on every stage)
+        m = fcfg.model
+        lay = sum(int(np.prod(s)) for s in (
+            (m.hidden_size, m.num_heads * m.head_dim),
+            (m.hidden_size, m.num_kv_heads * m.head_dim),
+            (m.hidden_size, m.num_kv_heads * m.head_dim),
+            (m.num_heads * m.head_dim, m.hidden_size),
+            (m.hidden_size, m.ffn_size),
+            (m.hidden_size, m.ffn_size),
+            (m.ffn_size, m.hidden_size)))
+        per_stage = lay * fcfg.layers_per_stage / tp
+        embhead = 2 * m.vocab_size * m.hidden_size / tp
+        f32x3 = 12 / 2**30  # master + 2 moments, fp32 bytes
+        from apex1_tpu.core.capability import get_capability
+        hbm = get_capability(gen).hbm_bytes / 2**30
+        print(f"       per-stage params/chip: blocks "
+              f"{per_stage * f32x3:5.2f} GiB, emb+head "
+              f"{embhead * f32x3:5.2f} GiB (fp32 x3 opt); chip HBM "
+              f"{hbm:.0f} GiB ({gen})", flush=True)
 
     print("ALL OK" if ok else "FAILURES PRESENT", flush=True)
     sys.exit(0 if ok else 1)
