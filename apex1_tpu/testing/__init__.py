@@ -61,6 +61,20 @@ def enable_persistent_compilation_cache(default_dir: str | None = None
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def honor_jax_platforms_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` through ``jax.config``: the container's
+    sitecustomize pins ``jax_platforms=axon,cpu`` via jax.config, which
+    silently overrides the env var. Call before first backend use; raises
+    if the backend is already initialized differently (a silent drop
+    would run the wrong backend)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+
+
 def set_random_seed(seed: int):
     """``testing/commons.py :: set_random_seed`` — numpy + a JAX key."""
     import jax

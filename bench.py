@@ -286,13 +286,9 @@ def main():
 
     # honor JAX_PLATFORMS despite the sitecustomize jax_platforms pin
     # (same dance as probe_backend's subprocess)
-    import os
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass
+    from apex1_tpu.testing import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
     backend = probe_backend(args.probe_timeout, args.probe_retries)
     if backend is None:

@@ -1,10 +1,13 @@
 """Microbenchmarks for the Pallas kernels on the current backend.
 
-Times fwd and fwd+bwd for flash attention and linear_cross_entropy across
-block sizes, against their XLA-composite golds. Prints immediately
-(unbuffered) — safe to tail.
+Times fwd and fwd+bwd against the XLA-composite golds: flash attention
+and the fused LM-head CE across block sizes; layer/rms norm, causal
+softmax, RoPE, and plain xentropy as pallas-vs-xla A/Bs; fused_dense as
+an achieved-TFLOPs roofline check; the flat-buffer fused optimizer vs
+per-tensor optax. Prints immediately (unbuffered) — safe to tail.
 
-Usage: python tools/bench_kernels.py [attn|xent|all] [--gpt2|--llama]
+Usage: python tools/bench_kernels.py
+         [attn|xent|norm|softmax|rope|xent_plain|dense|opt|all] [--llama]
 """
 
 import argparse
@@ -32,10 +35,10 @@ def timeit(fn, *args, iters=20):
         def body(_, carry):
             cargs, out = carry
             eps = jax.tree.leaves(out)[0].ravel()[0] * 0
-            cargs = tuple(
-                a + eps.astype(a.dtype) if jnp.issubdtype(a.dtype,
-                                                          jnp.floating)
-                else a for a in cargs)
+            cargs = jax.tree.map(
+                lambda a: (a + eps.astype(a.dtype)
+                           if jnp.issubdtype(a.dtype, jnp.floating) else a),
+                cargs)
             return cargs, fn2(*cargs)
         return jax.lax.fori_loop(0, n, body, (args, fn2(*args)))[1]
 
@@ -143,13 +146,126 @@ def bench_norm(R, H):
                   f"{dt2*1e3:8.3f} ms", flush=True)
 
 
+def bench_softmax(B, H, S):
+    from apex1_tpu.ops import scaled_upper_triang_masked_softmax
+    from apex1_tpu.ops._common import force_impl
+    print(f"== causal softmax (B,H,S,S)=({B},{H},{S},{S}) fp32 ==",
+          flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, H, S, S)), jnp.float32)
+    for impl in ("xla", "pallas"):
+        def f(x, impl=impl):
+            with force_impl(impl):
+                return jnp.sum(scaled_upper_triang_masked_softmax(
+                    x, scale=0.125))
+        dt = timeit(f, x)
+        dt2 = timeit(jax.grad(f), x)
+        print(f"  {impl:6s} fwd {dt*1e3:8.2f} ms   fwd+bwd "
+              f"{dt2*1e3:8.2f} ms", flush=True)
+
+
+def bench_rope(B, S, H, D):
+    from apex1_tpu.ops import apply_rotary_pos_emb, rope_tables
+    from apex1_tpu.ops._common import force_impl
+    print(f"== rope (B,S,H,D)=({B},{S},{H},{D}) bf16 ==", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+    cos, sin = rope_tables(jnp.arange(S), D)
+    for impl in ("xla", "pallas"):
+        def f(x, impl=impl):
+            with force_impl(impl):
+                return jnp.sum(apply_rotary_pos_emb(x, cos, sin)
+                               .astype(jnp.float32))
+        dt = timeit(f, x)
+        dt2 = timeit(jax.grad(f), x)
+        print(f"  {impl:6s} fwd {dt*1e3:8.3f} ms   fwd+bwd "
+              f"{dt2*1e3:8.3f} ms", flush=True)
+
+
+def bench_xent_plain(T, V):
+    from apex1_tpu.ops import softmax_cross_entropy_loss
+    from apex1_tpu.ops._common import force_impl
+    print(f"== xentropy T={T} V={V} fp32 ==", flush=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, V)), jnp.float32)
+    t = jnp.asarray(rng.integers(0, V - 200, (T,)), jnp.int32)
+    for impl in ("xla", "pallas"):
+        def f(x, impl=impl):
+            with force_impl(impl):
+                return jnp.mean(softmax_cross_entropy_loss(
+                    x, t, num_classes=V - 200))
+        dt = timeit(f, x)
+        dt2 = timeit(jax.grad(f), x)
+        print(f"  {impl:6s} fwd {dt*1e3:8.2f} ms   fwd+bwd "
+              f"{dt2*1e3:8.2f} ms", flush=True)
+
+
+def bench_dense(B, In, Hid):
+    """fused_dense decision check: gemm+bias+gelu(+gemm) in one jit —
+    achieved TFLOP/s vs chip peak tells whether XLA's epilogue fusion
+    leaves anything on the table (the 'XLA already fuses this' claim)."""
+    from apex1_tpu.core.capability import get_capability
+    from apex1_tpu.ops.fused_dense import fused_dense_gelu_dense
+    print(f"== fused_dense_gelu_dense B={B} {In}->{Hid}->{In} bf16 ==",
+          flush=True)
+    rng = np.random.default_rng(0)
+    # torch nn.Linear weight convention: (out_features, in_features)
+    x = jnp.asarray(rng.normal(size=(B, In)) * 0.02, jnp.bfloat16)
+    w1 = jnp.asarray(rng.normal(size=(Hid, In)) * 0.02, jnp.bfloat16)
+    b1 = jnp.zeros((Hid,), jnp.bfloat16)
+    w2 = jnp.asarray(rng.normal(size=(In, Hid)) * 0.02, jnp.bfloat16)
+    b2 = jnp.zeros((In,), jnp.bfloat16)
+
+    def f(x, w1, b1, w2, b2):
+        return jnp.sum(fused_dense_gelu_dense(x, w1, b1, w2, b2)
+                       .astype(jnp.float32))
+
+    flops = 2 * B * In * Hid * 2          # two gemms
+    for name, fn in (("fwd", f), ("fwd+bwd", jax.grad(f, argnums=(0, 1, 2,
+                                                                  3, 4)))):
+        mult = 1 if name == "fwd" else 3
+        dt = timeit(fn, x, w1, b1, w2, b2)
+        tf = flops * mult / dt / 1e12
+        peak = get_capability().bf16_tflops
+        print(f"  {name:8s} {dt*1e3:8.2f} ms  ~{tf:6.1f} TF/s "
+              f"({100 * tf / peak:4.1f}% of {peak:.0f} peak)", flush=True)
+
+
+def bench_opt(n_leaves=148, leaf=(1024, 768)):
+    """flat-buffer fused update (multi_tensor_apply analog) vs per-tensor
+    optax adam over a GPT-2-sized tree."""
+    import optax
+
+    from apex1_tpu.optim.fused_adam import fused_adam
+    print(f"== optimizer: {n_leaves} leaves x {leaf} fp32 ==", flush=True)
+    rng = np.random.default_rng(0)
+    params = {f"p{i}": jnp.asarray(rng.normal(size=leaf), jnp.float32)
+              for i in range(n_leaves)}
+    grads = {f"p{i}": jnp.asarray(rng.normal(size=leaf), jnp.float32)
+             for i in range(n_leaves)}
+    for name, tx in (("fused_adam (flat)", fused_adam(1e-4)),
+                     ("optax.adam (per-tensor)", optax.adam(1e-4))):
+        state = tx.init(params)
+
+        def f(params, grads, state, tx=tx):
+            up, st = tx.update(grads, state, params)
+            return optax.apply_updates(params, up), st
+
+        dt = timeit(f, params, grads, state)
+        print(f"  {name:26s} {dt*1e3:8.2f} ms/step", flush=True)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
-                    choices=["attn", "xent", "norm", "all"])
+                    choices=["attn", "xent", "norm", "softmax", "rope",
+                             "xent_plain", "dense", "opt", "all"])
     ap.add_argument("--llama", action="store_true",
                     help="long-context llama shapes instead of GPT-2")
     args = ap.parse_args()
+    from apex1_tpu.testing import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     print(f"backend={jax.default_backend()}", flush=True)
     if args.llama:
         attn_shape, xent = (1, 32, 16384, 64), (4096, 2048, 32000)
@@ -162,3 +278,20 @@ if __name__ == "__main__":
     if args.what in ("norm", "all"):
         bench_norm(8192 if not args.llama else 16384,
                    768 if not args.llama else 2048)
+    if args.what in ("softmax", "all"):
+        # GPT-2 shape in both modes: the llama 16k score matrix would
+        # materialize (1,32,16k,16k) fp32 = 32 GiB — flash owns that case
+        bench_softmax(8, 12, 1024)
+    if args.what in ("rope", "all"):
+        bench_rope(1, 16384 if args.llama else 1024,
+                   32 if args.llama else 12, 64)
+    if args.what in ("xent_plain", "all"):
+        bench_xent_plain(*((4096, 32000) if args.llama else (8184, 50432)))
+    if args.what in ("dense", "all"):
+        if args.llama:
+            bench_dense(16384, 2048, 5632)
+        else:
+            bench_dense(16384, 768, 3072)
+    if args.what in ("opt", "all"):
+        bench_opt(*(((32, (2048, 2048)) if args.llama else
+                     (148, (1024, 768)))))
