@@ -62,24 +62,29 @@ class Llama3DConfig:
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    num_chunks: int = 1               # V>1 = interleaved virtual pipeline
     num_microbatches: int = 4
     microbatch_size: int = 1          # sequences per dp replica per mb
     learning_rate: float = 1e-4
 
     def __post_init__(self):
         m = self.model
-        if m.num_layers % self.pp:
-            raise ValueError("num_layers must divide by pp")
+        if m.num_layers % (self.pp * self.num_chunks):
+            raise ValueError("num_layers must divide by pp * num_chunks")
         if m.num_heads % self.tp or m.num_kv_heads % self.tp:
             raise ValueError("head counts must divide by tp")
         if m.vocab_size % self.tp:
             raise ValueError("vocab_size must divide by tp")
         if m.max_seq_len % self.tp:
             raise ValueError("seq len must divide by tp (SP shards)")
+        if self.num_chunks > 1 and self.num_microbatches < self.pp:
+            raise ValueError("interleaved pipeline needs M >= pp")
 
     @property
     def layers_per_stage(self) -> int:
-        return self.model.num_layers // self.pp
+        """Layers per (chunk, stage) slot — model chunk c = v·pp + s
+        holds layers [c·lps, (c+1)·lps)."""
+        return self.model.num_layers // (self.pp * self.num_chunks)
 
 
 def _layer_leaf_shapes(cfg: Llama3DConfig):
@@ -94,7 +99,8 @@ def _layer_leaf_shapes(cfg: Llama3DConfig):
 
 
 def chunk_param_specs(cfg: Llama3DConfig):
-    """PartitionSpecs for the (V=1, pp, layers/pp, ...) stacked tree."""
+    """PartitionSpecs for the (num_chunks, pp, layers_per_stage, ...)
+    stacked tree (chunk axis replicated; stage axis sharded over pp)."""
     col = P(None, AXIS_PP, None, None, AXIS_TP)
     row = P(None, AXIS_PP, None, AXIS_TP, None)
     norm = P(None, AXIS_PP, None, None)
@@ -115,13 +121,14 @@ def init_params(cfg: Llama3DConfig, seed: int = 0):
     m = cfg.model
     rng = np.random.default_rng(seed)
     V, PP, L = m.vocab_size, cfg.pp, cfg.layers_per_stage
+    VC = cfg.num_chunks
 
     def norm_init(shape):
-        return jnp.ones((1, PP, L) + shape, jnp.float32)
+        return jnp.ones((VC, PP, L) + shape, jnp.float32)
 
     def w_init(shape):
         return jnp.asarray(
-            rng.normal(size=(1, PP, L) + shape) * 0.02, jnp.float32)
+            rng.normal(size=(VC, PP, L) + shape) * 0.02, jnp.float32)
 
     chunk = {k: (norm_init(s) if "norm" in k else w_init(s))
              for k, s in _layer_leaf_shapes(cfg).items()}
@@ -149,7 +156,7 @@ def abstract_state(cfg: Llama3DConfig, mesh):
                                     sharding=NamedSharding(mesh, spec))
 
     cspecs, sspecs = chunk_param_specs(cfg), shared_param_specs()
-    chunk = {k: sds((1, PP, L) + shp, cspecs[k])
+    chunk = {k: sds((cfg.num_chunks, PP, L) + shp, cspecs[k])
              for k, shp in _layer_leaf_shapes(cfg).items()}
     shared = {"emb": sds((V, m.hidden_size), sspecs["emb"]),
               "head": sds((V, m.hidden_size), sspecs["head"]),
@@ -179,12 +186,14 @@ def from_llama_params(params, cfg: Llama3DConfig):
     """Convert a `models.llama.Llama` param tree (layer{i}/wq, …,
     tok_embeddings, output, norm) into the stacked 3D trees — the parity
     bridge the tests use."""
-    L, PP = cfg.layers_per_stage, cfg.pp
+    L, PP, VC = cfg.layers_per_stage, cfg.pp, cfg.num_chunks
 
     def stack(leaf_name):
-        return jnp.stack(
-            [jnp.stack([params[f"layer{s * L + j}"][leaf_name]
-                        for j in range(L)]) for s in range(PP)])[None]
+        # model chunk c = v*PP + s holds layers [c*L, (c+1)*L)
+        return jnp.stack([jnp.stack(
+            [jnp.stack([params[f"layer{(v * PP + s) * L + j}"][leaf_name]
+                        for j in range(L)]) for s in range(PP)])
+            for v in range(VC)])
 
     chunk = {k: stack(k) for k in _layer_leaf_shapes(cfg)}
     shared = {"emb": params["tok_embeddings"],
@@ -258,7 +267,7 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
 
     h_mb = jax.vmap(embed)(tokens)            # (M, S/tp, mb, E)
     local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_local)
-    outs = pipeline_apply(stage, local, h_mb, num_chunks=1,
+    outs = pipeline_apply(stage, local, h_mb, num_chunks=cfg.num_chunks,
                           broadcast_outputs=False)
 
     o = rms_norm(outs, shared_local["final_norm"], eps=m.norm_eps)

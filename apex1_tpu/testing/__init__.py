@@ -64,15 +64,23 @@ def enable_persistent_compilation_cache(default_dir: str | None = None
 def honor_jax_platforms_env() -> None:
     """Re-assert ``JAX_PLATFORMS`` through ``jax.config``: the container's
     sitecustomize pins ``jax_platforms=axon,cpu`` via jax.config, which
-    silently overrides the env var. Call before first backend use; raises
-    if the backend is already initialized differently (a silent drop
-    would run the wrong backend)."""
+    silently overrides the env var. Call before first backend use. The
+    update is a silent no-op if a backend is already initialized, so the
+    active backend is checked afterwards and a mismatch raises (a silent
+    drop would run the wrong backend)."""
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
     import jax
 
     jax.config.update("jax_platforms", plat)
+    want = [p.strip().lower() for p in plat.split(",") if p.strip()]
+    got = jax.default_backend()  # forces init under the requested config
+    if got.lower() not in want:
+        raise RuntimeError(
+            f"JAX_PLATFORMS={plat!r} requested but the active backend is "
+            f"{got!r} — a backend was initialized before "
+            "honor_jax_platforms_env() ran")
 
 
 def set_random_seed(seed: int):

@@ -22,12 +22,13 @@ DP, PP, TP = 2, 2, 2
 M, MB = 4, 2          # microbatches, global sequences per microbatch
 
 
-@pytest.fixture()
-def setup(rng, devices):
+@pytest.fixture(params=[1, 2], ids=["V1", "V2-interleaved"])
+def setup(rng, devices, request):
     mcfg = LlamaConfig.tiny(num_layers=4, max_seq_len=32, vocab_size=64,
                             num_heads=4, num_kv_heads=2, hidden_size=32,
                             ffn_size=64, policy=get_policy("O0"))
     cfg = Llama3DConfig(model=mcfg, dp=DP, pp=PP, tp=TP,
+                        num_chunks=request.param,
                         num_microbatches=M, microbatch_size=MB // DP)
     model = Llama(mcfg)
     tokens = jnp.asarray(

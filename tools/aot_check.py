@@ -426,7 +426,9 @@ def main():
             (m.hidden_size, m.ffn_size),
             (m.hidden_size, m.ffn_size),
             (m.ffn_size, m.hidden_size)))
-        per_stage = lay * fcfg.layers_per_stage / tp
+        # layers_per_stage is per (chunk, stage) slot — a stage holds
+        # num_chunks of them
+        per_stage = lay * fcfg.layers_per_stage * fcfg.num_chunks / tp
         embhead = 2 * m.vocab_size * m.hidden_size / tp
         f32x3 = 12 / 2**30  # master + 2 moments, fp32 bytes
         from apex1_tpu.core.capability import get_capability

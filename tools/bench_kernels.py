@@ -146,58 +146,48 @@ def bench_norm(R, H):
                   f"{dt2*1e3:8.3f} ms", flush=True)
 
 
-def bench_softmax(B, H, S):
-    from apex1_tpu.ops import scaled_upper_triang_masked_softmax
+def _ab_bench(title, x, op):
+    """pallas-vs-xla A/B: times fwd and fwd+bwd of ``op(x) -> scalar``
+    under each dispatch mode."""
     from apex1_tpu.ops._common import force_impl
-    print(f"== causal softmax (B,H,S,S)=({B},{H},{S},{S}) fp32 ==",
-          flush=True)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(B, H, S, S)), jnp.float32)
+    print(f"== {title} ==", flush=True)
     for impl in ("xla", "pallas"):
         def f(x, impl=impl):
             with force_impl(impl):
-                return jnp.sum(scaled_upper_triang_masked_softmax(
-                    x, scale=0.125))
-        dt = timeit(f, x)
-        dt2 = timeit(jax.grad(f), x)
-        print(f"  {impl:6s} fwd {dt*1e3:8.2f} ms   fwd+bwd "
-              f"{dt2*1e3:8.2f} ms", flush=True)
-
-
-def bench_rope(B, S, H, D):
-    from apex1_tpu.ops import apply_rotary_pos_emb, rope_tables
-    from apex1_tpu.ops._common import force_impl
-    print(f"== rope (B,S,H,D)=({B},{S},{H},{D}) bf16 ==", flush=True)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
-    cos, sin = rope_tables(jnp.arange(S), D)
-    for impl in ("xla", "pallas"):
-        def f(x, impl=impl):
-            with force_impl(impl):
-                return jnp.sum(apply_rotary_pos_emb(x, cos, sin)
-                               .astype(jnp.float32))
+                return op(x)
         dt = timeit(f, x)
         dt2 = timeit(jax.grad(f), x)
         print(f"  {impl:6s} fwd {dt*1e3:8.3f} ms   fwd+bwd "
               f"{dt2*1e3:8.3f} ms", flush=True)
 
 
+def bench_softmax(B, H, S):
+    from apex1_tpu.ops import scaled_upper_triang_masked_softmax
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, H, S, S)), jnp.float32)
+    _ab_bench(f"causal softmax (B,H,S,S)=({B},{H},{S},{S}) fp32", x,
+              lambda x: jnp.sum(scaled_upper_triang_masked_softmax(
+                  x, scale=0.125)))
+
+
+def bench_rope(B, S, H, D):
+    from apex1_tpu.ops import apply_rotary_pos_emb, rope_tables
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+    cos, sin = rope_tables(jnp.arange(S), D)
+    _ab_bench(f"rope (B,S,H,D)=({B},{S},{H},{D}) bf16", x,
+              lambda x: jnp.sum(apply_rotary_pos_emb(x, cos, sin)
+                                .astype(jnp.float32)))
+
+
 def bench_xent_plain(T, V):
     from apex1_tpu.ops import softmax_cross_entropy_loss
-    from apex1_tpu.ops._common import force_impl
-    print(f"== xentropy T={T} V={V} fp32 ==", flush=True)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(T, V)), jnp.float32)
     t = jnp.asarray(rng.integers(0, V - 200, (T,)), jnp.int32)
-    for impl in ("xla", "pallas"):
-        def f(x, impl=impl):
-            with force_impl(impl):
-                return jnp.mean(softmax_cross_entropy_loss(
-                    x, t, num_classes=V - 200))
-        dt = timeit(f, x)
-        dt2 = timeit(jax.grad(f), x)
-        print(f"  {impl:6s} fwd {dt*1e3:8.2f} ms   fwd+bwd "
-              f"{dt2*1e3:8.2f} ms", flush=True)
+    _ab_bench(f"xentropy T={T} V={V} fp32", x,
+              lambda x: jnp.mean(softmax_cross_entropy_loss(
+                  x, t, num_classes=V - 200)))
 
 
 def bench_dense(B, In, Hid):
