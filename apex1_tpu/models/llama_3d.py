@@ -182,6 +182,22 @@ def abstract_state(cfg: Llama3DConfig, mesh):
     return state, data
 
 
+def reshape_chunks(tree, cfg_to: Llama3DConfig):
+    """Re-stack chunk leaves between pipeline topologies (same model,
+    different pp / num_chunks). The chunk-major layout assigns global
+    layer (v·pp + s)·lps + j to slot (v, s, j) — exactly the row-major
+    flattening of the (V, PP, lps) axes — so a plain reshape
+    re-partitions the stack for any (V', PP', lps') factorization:
+    checkpoint on one pipeline layout, resume on another
+    (≙ reference cross-topology resume, SURVEY §5.4)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.reshape(
+            jnp.asarray(x),
+            (cfg_to.num_chunks, cfg_to.pp, cfg_to.layers_per_stage)
+            + x.shape[3:]),
+        tree)
+
+
 def from_llama_params(params, cfg: Llama3DConfig):
     """Convert a `models.llama.Llama` param tree (layer{i}/wq, …,
     tok_embeddings, output, norm) into the stacked 3D trees — the parity

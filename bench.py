@@ -284,12 +284,6 @@ def main():
     fallback = {"metric": f"{unit} {args.config} [unreachable]",
                 "value": 0.0, "unit": unit, "vs_baseline": 0.0}
 
-    # honor JAX_PLATFORMS despite the sitecustomize jax_platforms pin
-    # (same dance as probe_backend's subprocess)
-    from apex1_tpu.testing import honor_jax_platforms_env
-
-    honor_jax_platforms_env()
-
     backend = probe_backend(args.probe_timeout, args.probe_retries)
     if backend is None:
         fallback["error"] = (
@@ -304,6 +298,13 @@ def main():
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(args.timeout))
     try:
+        # honor JAX_PLATFORMS despite the sitecustomize jax_platforms pin
+        # — only now, AFTER the subprocess probe succeeded and UNDER the
+        # watchdog (the helper's verification initializes the in-process
+        # backend, which blocks uninterruptibly on a dead tunnel)
+        from apex1_tpu.testing import honor_jax_platforms_env
+
+        honor_jax_platforms_env()
         on_accel = backend not in ("cpu",)
         kw = {}
         if args.config == "gpt2":

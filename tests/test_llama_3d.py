@@ -100,6 +100,44 @@ def test_loss_and_grads_match_unpartitioned(setup, devices):
                                    rtol=2e-4, atol=2e-5, err_msg=k)
 
 
+def test_checkpoint_cross_topology_resume(setup, devices, tmp_path):
+    """Save the 3D-sharded state mid-training on one pipeline layout and
+    resume on a DIFFERENT one (dp2 pp2 tp2 [xV] -> dp1 pp4 tp2 V=1): the
+    chunk-major stack re-partitions by reshape_chunks, and the
+    post-restore loss matches continuing on the original mesh
+    (≙ reference cross-topology resume, SURVEY §5.4)."""
+    from apex1_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from apex1_tpu.models.llama_3d import reshape_chunks
+
+    cfg, model, flat, tokens, labels = setup
+    params = {}
+    params["chunk"], params["shared"] = from_llama_params(flat, cfg)
+    step, state, _ = make_train_step(cfg, params=params)
+    state, _ = step(state, tokens, labels)
+
+    path = tmp_path / "ck3d"
+    save_checkpoint(path, state)
+    state, loss_cont = step(state, tokens, labels)  # continue on mesh A
+
+    cfg_b = dataclasses.replace(cfg, dp=1, pp=4, tp=2, num_chunks=1)
+    host = restore_checkpoint(path, template=state)  # keeps pytree types
+    remap = lambda t: {"chunk": reshape_chunks(t["chunk"], cfg_b),
+                       "shared": t["shared"]}
+    state_b = {
+        "step": host["step"],
+        "params": remap(host["params"]),
+        "opt": type(host["opt"])(
+            step=host["opt"].step,
+            exp_avg=remap(host["opt"].exp_avg),
+            exp_avg_sq=remap(host["opt"].exp_avg_sq)),
+    }
+    step_b, _, _ = make_train_step(cfg_b, params=state_b["params"])
+    state_b, loss_res = step_b(state_b, tokens, labels)
+    np.testing.assert_allclose(float(loss_res), float(loss_cont),
+                               rtol=2e-5)
+    assert int(state_b["step"]) == int(state["step"])
+
+
 def test_train_step_runs_and_descends(setup, devices):
     cfg, model, flat, tokens, labels = setup
     cfg = dataclasses.replace(cfg, learning_rate=5e-3)

@@ -140,6 +140,8 @@ class TestASP:
                                        "--batch", "4", "--seq", "64"]),
     ("examples/gpt2_pp_tied.py", ["--steps", "3", "--seq", "32",
                                   "--hidden", "32"]),
+    ("examples/llama_3d.py", ["--steps", "3", "--seq", "32",
+                              "--hidden", "32", "--chunks", "2"]),
 ])
 def test_examples_smoke(script, args):
     """≙ reference examples/ as integration tests (SURVEY §4.1 L1)."""
