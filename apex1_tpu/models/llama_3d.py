@@ -176,10 +176,28 @@ def abstract_state(cfg: Llama3DConfig, mesh):
                                                sharding=x.sharding),
                 params)),
     }
+    _scaler = _make_scaler(cfg)
+    if _scaler is not None:
+        state["scale"] = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                jnp.shape(x), x.dtype,
+                sharding=NamedSharding(mesh, P())),
+            _scaler.init())
     dshape = (cfg.num_microbatches, m.max_seq_len,
               cfg.microbatch_size * cfg.dp)
     data = sds(dshape, P(None, None, AXIS_DP), jnp.int32)
     return state, data
+
+
+def _make_scaler(cfg: Llama3DConfig):
+    """The policy's loss-scale machine, or None for unscaled (bf16/fp32)
+    policies — the ONE construction point shared by build_step /
+    make_train_step / abstract_state so their state trees can't drift."""
+    if cfg.model.policy.loss_scale is None:
+        return None
+    from apex1_tpu.core import loss_scale as ls
+
+    return ls.make_loss_scale(cfg.model.policy.loss_scale)
 
 
 def reshape_chunks(tree, cfg_to: Llama3DConfig):
@@ -319,36 +337,63 @@ def combine_grads(g_chunk, g_shared):
 def build_step(cfg: Llama3DConfig, mesh):
     """The jitted shard_map train step alone (no state materialization) —
     ``step(state, tokens, labels) -> (state, loss)``. Pair with
-    `abstract_state` for AOT lowering at 8B scale."""
+    `abstract_state` for AOT lowering at 8B scale.
+
+    When the model policy carries a loss scale (fp16 compute), the step
+    threads the dynamic loss-scale state machine: scale the PARTIAL loss
+    (linear, so the pp-partial convention is preserved), unscale after
+    the grad combines, global finite-check psum across ALL mesh axes
+    (≙ the reference's MP-aware GradScaler, `transformer/amp/
+    grad_scaler.py` — every dp/pp/tp rank skips together), skip-on-
+    overflow via `select_tree`, hysteresis adjust."""
     import optax
 
+    from apex1_tpu.core import loss_scale as ls
     from apex1_tpu.optim.fused_adam import FusedAdamState, fused_adam
 
     m = cfg.model
     tx = fused_adam(cfg.learning_rate)
+    scaler = _make_scaler(cfg)
     param_specs = {"chunk": chunk_param_specs(cfg),
                    "shared": shared_param_specs()}
     state_specs = {"step": P(), "params": param_specs,
                    "opt": FusedAdamState(step=P(), exp_avg=param_specs,
                                          exp_avg_sq=param_specs)}
+    if scaler is not None:
+        state_specs["scale"] = jax.tree_util.tree_map(
+            lambda _: P(), scaler.init())
     cos, sin = rope_tables(jnp.arange(m.max_seq_len), m.head_dim,
                            base=m.rope_base)
     data_spec = P(None, None, AXIS_DP)       # (M, S, mb)
 
     def train_step(state, tokens, labels):
         def scalar(params):
-            return loss_fn(cfg, params["chunk"], params["shared"],
+            loss = loss_fn(cfg, params["chunk"], params["shared"],
                            tokens, labels, cos, sin)
+            if scaler is None:
+                return loss, loss
+            return scaler.scale(loss, state["scale"]), loss
 
-        loss_part, grads = jax.value_and_grad(scalar)(state["params"])
+        grads, loss_part = jax.grad(scalar, has_aux=True)(state["params"])
         loss = jax.lax.psum(loss_part, AXIS_PP)
         loss = jax.lax.pmean(loss, AXIS_DP)
         g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"])
         grads = {"chunk": g_chunk, "shared": g_shared}
+        if scaler is not None:
+            grads = scaler.unscale(grads, state["scale"])
+            finite = ls.all_finite(grads,
+                                   axis_names=(AXIS_DP, AXIS_PP, AXIS_TP))
         updates, new_opt = tx.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
-        return ({"step": state["step"] + 1, "params": new_params,
-                 "opt": new_opt}, loss)
+        new_state = {"step": state["step"] + 1, "params": new_params,
+                     "opt": new_opt}
+        if scaler is not None:
+            new_state["params"] = ls.select_tree(finite, new_params,
+                                                 state["params"])
+            new_state["opt"] = ls.select_tree(finite, new_opt,
+                                              state["opt"])
+            new_state["scale"] = scaler.adjust(state["scale"], finite)
+        return new_state, loss
 
     step = jax.jit(jax.shard_map(
         train_step, mesh=mesh,
@@ -370,4 +415,7 @@ def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
         params = {"chunk": chunk, "shared": shared}
     state = {"step": jnp.zeros([], jnp.int32), "params": params,
              "opt": tx.init(params)}
+    _scaler = _make_scaler(cfg)
+    if _scaler is not None:
+        state["scale"] = _scaler.init()
     return step, state, data_spec
