@@ -25,20 +25,28 @@ until probe; do
 done
 echo "TPU BACK $(date -u +%H:%M:%S)" >> "$RES/status.log"
 
+# Results ALSO land in the repo so they survive the session for the
+# next round's context (committed by the next session, not by this
+# script).
+REPO_RES=/root/repo/perf_results
+mkdir -p "$REPO_RES"
+
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
-  stdbuf -oL -eL timeout "$to" "$@" > "$RES/$name.log" 2>&1
+  stdbuf -oL -eL timeout "$to" "$@" 2>&1 | tee "$RES/$name.log" \
+    > "$REPO_RES/$name.log"
   echo "$name rc=$? $(date -u +%H:%M:%S)" >> "$RES/status.log"
 }
 
 # Headline numbers first (most valuable if the tunnel dies again),
-# then per-kernel A/B sweeps for the perf playbook.
+# then batch scaling, per-op profile, per-kernel A/B sweeps.
 run bench_gpt2      1800 python bench.py --config gpt2
 run bench_bert_lg   1800 python bench.py --config bert_large
 run bench_llama16k  2400 python bench.py --config llama_longctx
 run bench_bert      1500 python bench.py --config bert
 run bench_resnet    1500 python bench.py --config resnet
-run kern_attn       2400 python tools/bench_kernels.py attn
-run kern_xent       2400 python tools/bench_kernels.py xent
-run kern_norm       1200 python tools/bench_kernels.py norm
+run bench_gpt2_b24  1500 python bench.py --config gpt2 --batch 24
+run profile_gpt2    1500 python tools/profile_step.py --config gpt2 --top 40
+run kern_all        4800 python tools/bench_kernels.py all
+run kern_all_llama  4800 python tools/bench_kernels.py all --llama
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
