@@ -24,11 +24,24 @@ reference's `p2p_communication.py` scatter-gather-tensors-in-pipeline
 optimization (split boundary tensors over the TP group to cut p2p
 traffic by tp×) falls out of the SP layout for free here.
 
-Gradient combination map (inside-grad convention):
-- all leaves: pmean over dp;
+With ``moe=True`` the dense FFN becomes an expert-routed FFN on every
+layer: each (dp, ep, tp) rank dispatches its sequence-shard tokens over
+the ``ep`` axis (double ``all_to_all`` in
+`transformer.moe.moe_shard_map_apply`), expert weights ep-sharded —
+the full 4-axis dp × pp × ep × tp composition. (The router's aux
+balance loss is not threaded through the pipeline boundary; use the
+GSPMD `models.llama` ``moe_every`` path when the aux term matters.)
+
+Gradient combination map (inside-grad convention; data replicas on
+(dp, ep)):
+- replicated leaves: pmean over (dp, ep);
 - tp-sharded matmul shards (wq/wk/wv/wo/w_gate/w_up/w_down, emb/head
   rows): exact locally;
-- tp-replicated norms computed on sequence shards: psum over tp;
+- tp-replicated norms + router (computed on per-rank token subsets):
+  psum over tp;
+- ep-sharded expert weights: psum over tp, pmean over dp, /ep (the
+  all_to_all transpose already SUMMED every ep shard's contribution —
+  never pmean across ep, that would mix different experts);
 - pp-replicated embedding/head/final_norm (used on first/last stage
   only): psum over pp (the embedding-group all-reduce).
 """
@@ -43,7 +56,8 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from apex1_tpu.core.mesh import AXIS_DP, AXIS_PP, AXIS_TP, make_mesh
+from apex1_tpu.core.mesh import (AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP,
+                                 make_mesh)
 from apex1_tpu.models.llama import LlamaConfig
 from apex1_tpu.ops import apply_rotary_pos_emb, rms_norm, rope_tables
 from apex1_tpu.ops.attention import flash_attention
@@ -62,9 +76,11 @@ class Llama3DConfig:
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    ep: int = 1                       # expert parallel (requires moe)
+    moe: bool = False                 # every layer's FFN expert-routed
     num_chunks: int = 1               # V>1 = interleaved virtual pipeline
     num_microbatches: int = 4
-    microbatch_size: int = 1          # sequences per dp replica per mb
+    microbatch_size: int = 1          # sequences per (dp, ep) replica/mb
     learning_rate: float = 1e-4
 
     def __post_init__(self):
@@ -79,6 +95,20 @@ class Llama3DConfig:
             raise ValueError("seq len must divide by tp (SP shards)")
         if self.num_chunks > 1 and self.num_microbatches < self.pp:
             raise ValueError("interleaved pipeline needs M >= pp")
+        if self.ep > 1 and not self.moe:
+            raise ValueError("ep > 1 requires moe=True")
+        if self.moe and m.num_experts % self.ep:
+            raise ValueError("num_experts must divide by ep")
+
+    @property
+    def moe_cfg(self):
+        from apex1_tpu.transformer.moe import MoEConfig
+
+        m = self.model
+        return MoEConfig(num_experts=m.num_experts, top_k=m.moe_top_k,
+                         capacity_factor=m.moe_capacity_factor,
+                         aux_loss_weight=m.moe_aux_loss_weight,
+                         hidden_size=m.hidden_size, ffn_size=m.ffn_size)
 
     @property
     def layers_per_stage(self) -> int:
@@ -91,24 +121,40 @@ def _layer_leaf_shapes(cfg: Llama3DConfig):
     m = cfg.model
     E, F = m.hidden_size, m.ffn_size
     HD, KD = m.num_heads * m.head_dim, m.num_kv_heads * m.head_dim
-    return {
+    shapes = {
         "attn_norm": (E,), "mlp_norm": (E,),
         "wq": (E, HD), "wk": (E, KD), "wv": (E, KD), "wo": (HD, E),
-        "w_gate": (E, F), "w_up": (E, F), "w_down": (F, E),
     }
+    if cfg.moe:
+        n = m.num_experts
+        shapes.update({"wg": (E, n), "w_moe1": (n, E, F),
+                       "w_moe2": (n, F, E)})
+    else:
+        shapes.update({"w_gate": (E, F), "w_up": (E, F),
+                       "w_down": (F, E)})
+    return shapes
 
 
 def chunk_param_specs(cfg: Llama3DConfig):
     """PartitionSpecs for the (num_chunks, pp, layers_per_stage, ...)
-    stacked tree (chunk axis replicated; stage axis sharded over pp)."""
+    stacked tree (chunk axis replicated; stage axis sharded over pp;
+    expert dim over ep when MoE)."""
     col = P(None, AXIS_PP, None, None, AXIS_TP)
     row = P(None, AXIS_PP, None, AXIS_TP, None)
     norm = P(None, AXIS_PP, None, None)
-    return {
+    specs = {
         "attn_norm": norm, "mlp_norm": norm,
         "wq": col, "wk": col, "wv": col, "wo": row,
-        "w_gate": col, "w_up": col, "w_down": row,
     }
+    if cfg.moe:
+        specs.update({
+            "wg": P(None, AXIS_PP, None, None, None),
+            "w_moe1": P(None, AXIS_PP, None, AXIS_EP, None, None),
+            "w_moe2": P(None, AXIS_PP, None, AXIS_EP, None, None),
+        })
+    else:
+        specs.update({"w_gate": col, "w_up": col, "w_down": row})
+    return specs
 
 
 def shared_param_specs():
@@ -184,8 +230,8 @@ def abstract_state(cfg: Llama3DConfig, mesh):
                 sharding=NamedSharding(mesh, P())),
             _scaler.init())
     dshape = (cfg.num_microbatches, m.max_seq_len,
-              cfg.microbatch_size * cfg.dp)
-    data = sds(dshape, P(None, None, AXIS_DP), jnp.int32)
+              cfg.microbatch_size * cfg.dp * cfg.ep)
+    data = sds(dshape, P(None, None, (AXIS_DP, AXIS_EP)), jnp.int32)
     return state, data
 
 
@@ -221,11 +267,20 @@ def from_llama_params(params, cfg: Llama3DConfig):
     tok_embeddings, output, norm) into the stacked 3D trees — the parity
     bridge the tests use."""
     L, PP, VC = cfg.layers_per_stage, cfg.pp, cfg.num_chunks
+    # MoE leaves live under the block's "moe" submodule in the flax tree
+    path = {"wg": ("moe", "router"), "w_moe1": ("moe", "w1"),
+            "w_moe2": ("moe", "w2")}
+
+    def leaf(i, name):
+        node = params[f"layer{i}"]
+        for part in path.get(name, (name,)):
+            node = node[part]
+        return node
 
     def stack(leaf_name):
         # model chunk c = v*PP + s holds layers [c*L, (c+1)*L)
         return jnp.stack([jnp.stack(
-            [jnp.stack([params[f"layer{(v * PP + s) * L + j}"][leaf_name]
+            [jnp.stack([leaf((v * PP + s) * L + j, leaf_name)
                         for j in range(L)]) for s in range(PP)])
             for v in range(VC)])
 
@@ -263,12 +318,31 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
         o = mp.reduce_scatter_to_sequence_parallel_region(o, AXIS_TP, 0)
         x = x + o.astype(x.dtype)
 
-        # MLP: same SP pattern, one gather feeds gate+up
         h = rms_norm(x, lp["mlp_norm"], eps=m.norm_eps).astype(dt)
-        h = mp.gather_from_sequence_parallel_region(h, AXIS_TP, 0, True)
-        y = (jax.nn.silu(h @ lp["w_gate"].astype(dt))
-             * (h @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
-        y = mp.reduce_scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
+        if cfg.moe:
+            # expert FFN on the SEQ-SHARDED tokens: each (tp, dp, ep)
+            # rank dispatches its own token subset over the ep axis
+            # (double all_to_all inside moe_shard_map_apply); expert
+            # weights are ep-sharded, tp/pp-replicated. The router's
+            # aux balance loss is not threaded through the pipeline
+            # boundary — use the GSPMD Llama (moe_every) path when the
+            # aux term matters.
+            from apex1_tpu.transformer.moe import moe_shard_map_apply
+
+            S_l, mb = h.shape[0], h.shape[1]
+            y2, _aux = moe_shard_map_apply(
+                h.reshape(-1, E), lp["wg"].astype(dt), lp["w_moe1"],
+                lp["w_moe2"], cfg.moe_cfg, axis_name=AXIS_EP,
+                act=jax.nn.silu)
+            y = y2.reshape(S_l, mb, E)
+        else:
+            # dense MLP: same SP pattern, one gather feeds gate+up
+            h = mp.gather_from_sequence_parallel_region(h, AXIS_TP, 0,
+                                                        True)
+            y = (jax.nn.silu(h @ lp["w_gate"].astype(dt))
+                 * (h @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
+            y = mp.reduce_scatter_to_sequence_parallel_region(y, AXIS_TP,
+                                                              0)
         return x + y.astype(x.dtype)
 
     if m.remat:
@@ -320,12 +394,27 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
     return last * jnp.mean(ce)
 
 
-def combine_grads(g_chunk, g_shared):
-    """The full combination map for the inside-grad convention."""
-    g_chunk = jax.lax.pmean(g_chunk, AXIS_DP)
-    g_shared = jax.lax.pmean(g_shared, AXIS_DP)
-    g_chunk = {k: (jax.lax.psum(v, AXIS_TP) if "norm" in k else v)
-               for k, v in g_chunk.items()}
+def combine_grads(g_chunk, g_shared, cfg: Llama3DConfig):
+    """The full combination map for the inside-grad convention. Data
+    replicas live on (dp, ep); expert-sharded leaves are special: the
+    all_to_all transpose already SUMMED every ep shard's token
+    contributions into the local expert shard, so their ep combine is a
+    /ep (sum -> replica mean), never a pmean across DIFFERENT experts."""
+    ep = cfg.ep
+    moe = cfg.moe
+    expert_keys = ("w_moe1", "w_moe2")
+
+    def chunk_one(k, g):
+        if moe and k in expert_keys:
+            g = jax.lax.psum(g, AXIS_TP)       # token subsets sum
+            return jax.lax.pmean(g, AXIS_DP) / ep
+        g = jax.lax.pmean(g, (AXIS_DP, AXIS_EP))
+        if "norm" in k or k == "wg":
+            g = jax.lax.psum(g, AXIS_TP)       # SP/token-subset partials
+        return g
+
+    g_chunk = {k: chunk_one(k, v) for k, v in g_chunk.items()}
+    g_shared = jax.lax.pmean(g_shared, (AXIS_DP, AXIS_EP))
     # final_norm: computed on seq shards (tp-partial) on the last stage
     g_shared["final_norm"] = jax.lax.psum(g_shared["final_norm"], AXIS_TP)
     # embedding group: emb lives on stage 0, head + final_norm on the
@@ -364,7 +453,7 @@ def build_step(cfg: Llama3DConfig, mesh):
             lambda _: P(), scaler.init())
     cos, sin = rope_tables(jnp.arange(m.max_seq_len), m.head_dim,
                            base=m.rope_base)
-    data_spec = P(None, None, AXIS_DP)       # (M, S, mb)
+    data_spec = P(None, None, (AXIS_DP, AXIS_EP))   # (M, S, mb)
 
     def train_step(state, tokens, labels):
         def scalar(params):
@@ -376,13 +465,15 @@ def build_step(cfg: Llama3DConfig, mesh):
 
         grads, loss_part = jax.grad(scalar, has_aux=True)(state["params"])
         loss = jax.lax.psum(loss_part, AXIS_PP)
-        loss = jax.lax.pmean(loss, AXIS_DP)
-        g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"])
+        loss = jax.lax.pmean(loss, (AXIS_DP, AXIS_EP))
+        g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"],
+                                          cfg)
         grads = {"chunk": g_chunk, "shared": g_shared}
         if scaler is not None:
             grads = scaler.unscale(grads, state["scale"])
-            finite = ls.all_finite(grads,
-                                   axis_names=(AXIS_DP, AXIS_PP, AXIS_TP))
+            finite = ls.all_finite(
+                grads,
+                axis_names=(AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP))
         updates, new_opt = tx.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         new_state = {"step": state["step"] + 1, "params": new_params,
@@ -408,7 +499,7 @@ def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
     state, fused Adam on fp32 masters. ``params`` overrides the random
     init (e.g. `from_llama_params` output)."""
     if mesh is None:
-        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, tp=cfg.tp)
+        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, ep=cfg.ep, tp=cfg.tp)
     step, _state_specs, data_spec, tx = build_step(cfg, mesh)
     if params is None:
         chunk, shared = init_params(cfg)

@@ -29,6 +29,10 @@ def main():
     ap.add_argument("--pp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--chunks", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert parallel (implies --moe)")
+    ap.add_argument("--moe", action="store_true",
+                    help="every FFN expert-routed (4 experts, top-2)")
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--seq", type=int, default=64)
@@ -36,7 +40,9 @@ def main():
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     args = ap.parse_args()
-    n = args.dp * args.pp * args.tp
+    if args.ep > 1:
+        args.moe = True
+    n = args.dp * args.pp * args.tp * args.ep
     force_virtual_cpu_devices(max(n, 2))
 
     import jax
@@ -47,20 +53,23 @@ def main():
     from apex1_tpu.models.llama import LlamaConfig
     from apex1_tpu.models.llama_3d import Llama3DConfig, make_train_step
 
+    moe_kw = (dict(moe_every=1, num_experts=4, moe_top_k=2,
+                   moe_capacity_factor=2.0) if args.moe else {})
     mcfg = LlamaConfig.tiny(
         num_layers=args.layers, max_seq_len=args.seq,
         vocab_size=args.vocab, num_heads=4, num_kv_heads=2,
         hidden_size=args.hidden, ffn_size=2 * args.hidden,
-        policy=get_policy("O2"))
+        policy=get_policy("O2"), **moe_kw)
     cfg = Llama3DConfig(model=mcfg, dp=args.dp, pp=args.pp, tp=args.tp,
+                        ep=args.ep, moe=args.moe,
                         num_chunks=args.chunks,
                         num_microbatches=args.microbatches,
                         microbatch_size=1, learning_rate=3e-3)
     step, state, _ = make_train_step(cfg)
     rng = np.random.default_rng(0)
-    shape = (args.microbatches, args.seq, args.dp)
-    print(f"mesh dp={args.dp} pp={args.pp} tp={args.tp} "
-          f"chunks={args.chunks} ({n} devices), "
+    shape = (args.microbatches, args.seq, args.dp * args.ep)
+    print(f"mesh dp={args.dp} pp={args.pp} tp={args.tp} ep={args.ep} "
+          f"chunks={args.chunks} moe={args.moe} ({n} devices), "
           f"{args.layers}L x {args.hidden}h", flush=True)
     t0 = time.time()
     for i in range(args.steps):

@@ -35,7 +35,8 @@ run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
   stdbuf -oL -eL timeout "$to" "$@" 2>&1 | tee "$RES/$name.log" \
     > "$REPO_RES/$name.log"
-  echo "$name rc=$? $(date -u +%H:%M:%S)" >> "$RES/status.log"
+  local rc=${PIPESTATUS[0]}   # the command's status, not tee's
+  echo "$name rc=$rc $(date -u +%H:%M:%S)" >> "$RES/status.log"
 }
 
 # Headline numbers first (most valuable if the tunnel dies again),
