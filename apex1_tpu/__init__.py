@@ -34,5 +34,6 @@ survey time, so symbol anchors are the citation unit).
 __version__ = "0.1.0"
 
 from apex1_tpu.core import mesh, policy, loss_scale  # noqa: F401
-from apex1_tpu.core.mesh import MeshConfig, make_mesh  # noqa: F401
+from apex1_tpu.core.mesh import (MeshConfig, make_hybrid_mesh,  # noqa: F401
+                                 make_mesh)
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy  # noqa: F401

@@ -9,6 +9,7 @@ Reference counterparts: ``apex/amp/frontend.py :: Properties`` (policy),
 from apex1_tpu.core.mesh import (  # noqa: F401
     MeshConfig,
     MeshResource,
+    make_hybrid_mesh,
     make_mesh,
     local_mesh,
 )

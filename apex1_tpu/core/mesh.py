@@ -124,6 +124,54 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def make_hybrid_mesh(
+    config: MeshConfig | None = None,
+    *,
+    dcn_dp: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+    process_is_granule: bool = False,
+    **axis_sizes: int,
+) -> Mesh:
+    """Multi-slice mesh: the outer data-parallel axis rides DCN (slice to
+    slice), everything else rides ICI within a slice — the mesh-axis →
+    fabric mapping of SURVEY §5.8 (≙ the reference's NCCL-over-IB outer
+    data parallelism around per-node NVLink groups).
+
+    ``dcn_dp`` slices multiply the ICI mesh's ``dp`` axis: the returned
+    mesh has ``dp = dcn_dp * ici_dp`` with slice-major ordering, so the
+    gradient psum over ``dp`` decomposes into an intra-slice ICI
+    reduction plus one inter-slice DCN exchange — XLA does this split
+    automatically for hierarchical device orders. Single-slice
+    (``dcn_dp=1``) delegates to `make_mesh`.
+
+    Call from a multi-controller job after ``jax.distributed.initialize``
+    (`parallel.multiproc`); ``process_is_granule=True`` is the fallback
+    for platforms without ``slice_index`` device attributes.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dcn_dp < 1:
+        raise ValueError(f"dcn_dp must be >= 1, got {dcn_dp}")
+    if dcn_dp == 1:
+        return make_mesh(config, devices=devices)
+    if len(devices) % dcn_dp:
+        raise ValueError(
+            f"{len(devices)} devices do not split into dcn_dp={dcn_dp} "
+            "slices")
+    per_slice = len(devices) // dcn_dp
+    config = config.resolve(per_slice)
+    from jax.experimental import mesh_utils
+
+    dcn_shape = tuple(dcn_dp if ax == AXIS_DP else 1 for ax in MESH_AXES)
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        config.shape, dcn_shape, devices=devices,
+        process_is_granule=process_is_granule)
+    return Mesh(dev_array, MESH_AXES)
+
+
 def local_mesh(**axis_sizes: int) -> Mesh:
     """Mesh over all visible devices; convenience for tests and single-host."""
     return make_mesh(MeshConfig(**axis_sizes) if axis_sizes else None)

@@ -46,6 +46,36 @@ class TestMesh:
             assert row == sorted(row)
             assert row[-1] - row[0] == 3
 
+    def test_hybrid_mesh_dcn_dp_outer(self, devices):
+        """Multi-slice mesh: each dp index must live on ONE slice so the
+        dp gradient reduction decomposes into intra-slice ICI + one DCN
+        exchange (SURVEY §5.8 fabric mapping)."""
+
+        class FakeDev:
+            def __init__(self, d, slice_index, i):
+                self.slice_index = slice_index
+                self.id = i
+                self.process_index = slice_index
+                self.platform = d.platform
+                self.device_kind = d.device_kind
+
+        fakes = [FakeDev(devices[i], i // 4, i) for i in range(8)]
+        m = mesh_lib.make_hybrid_mesh(MeshConfig(dp=1, pp=2, tp=2),
+                                      dcn_dp=2, devices=fakes)
+        assert m.shape == {"dp": 2, "fsdp": 1, "pp": 2, "cp": 1,
+                           "ep": 1, "tp": 2}
+        arr = np.asarray(m.devices)
+        for a in range(2):
+            slices = {d.slice_index for d in arr[a].ravel()}
+            assert slices == {a}, f"dp index {a} spans slices {slices}"
+
+    def test_hybrid_mesh_single_slice_delegates(self, devices):
+        m = mesh_lib.make_hybrid_mesh(dcn_dp=1, dp=2, tp=4)
+        assert m.shape["dp"] == 2 and m.shape["tp"] == 4
+        with pytest.raises(ValueError):
+            mesh_lib.make_hybrid_mesh(dcn_dp=3, dp=1,
+                                      devices=list(devices))
+
     def test_resource_spec(self):
         res = mesh_lib.MeshResource()
         spec = res.spec("batch", None, "heads")
