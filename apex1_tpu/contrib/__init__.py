@@ -12,9 +12,12 @@ binds them to the reference's import paths:
 - ``contrib.clip_grad``        → `clip_grad_norm_`
 - ``contrib.optimizers``       → `distributed_fused_adam` (ZeRO-style)
 
+- ``contrib.sparsity``        → `ASP`, `permutation_search` (masks +
+  accuracy-preserving channel-permutation search; the 2:4 *speedup* is
+  N/A on TPU — no sparse MXU mode — see docs/ops.md)
+
 Documented N/A on TPU (SURVEY.md §2.3): ``nccl_allocator`` (NVLS/SHARP),
-``peer_memory`` (CUDA IPC — superseded by ICI collectives), ``sparsity``
-(2:4 structured sparsity — no TPU sparse units).
+``peer_memory`` (CUDA IPC — superseded by ICI collectives).
 """
 
 from apex1_tpu.contrib import openfold  # noqa: F401
@@ -23,6 +26,8 @@ from apex1_tpu.contrib.group_norm import GroupNorm, group_norm  # noqa: F401
 from apex1_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401
 from apex1_tpu.contrib.multihead_attn import (  # noqa: F401
     EncdecMultiheadAttn, SelfMultiheadAttn)
+from apex1_tpu.contrib.sparsity import (  # noqa: F401
+    ASP, compute_m4n2_mask, permutation_search)
 from apex1_tpu.contrib.transducer import (  # noqa: F401
     TransducerJoint, TransducerLoss, transducer_joint, transducer_loss)
 from apex1_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss  # noqa: F401

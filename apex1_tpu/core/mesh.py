@@ -85,6 +85,15 @@ class MeshConfig:
         return (self.dp, self.fsdp, self.pp, self.cp, self.ep, self.tp)
 
 
+def _normalize_mesh_args(config, axis_sizes, devices):
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return config, devices
+
+
 def make_mesh(
     config: MeshConfig | None = None,
     *,
@@ -99,11 +108,7 @@ def make_mesh(
     respected (nearest-neighbour axes get torus links); falls back to a plain
     reshape on CPU/virtual device sets.
     """
-    if config is None:
-        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
-    elif axis_sizes:
-        raise ValueError("pass either a MeshConfig or axis sizes, not both")
-    devices = list(jax.devices()) if devices is None else list(devices)
+    config, devices = _normalize_mesh_args(config, axis_sizes, devices)
     config = config.resolve(len(devices))
     try:
         from jax.experimental import mesh_utils
@@ -148,11 +153,7 @@ def make_hybrid_mesh(
     (`parallel.multiproc`); ``process_is_granule=True`` is the fallback
     for platforms without ``slice_index`` device attributes.
     """
-    if config is None:
-        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
-    elif axis_sizes:
-        raise ValueError("pass either a MeshConfig or axis sizes, not both")
-    devices = list(jax.devices()) if devices is None else list(devices)
+    config, devices = _normalize_mesh_args(config, axis_sizes, devices)
     if dcn_dp < 1:
         raise ValueError(f"dcn_dp must be >= 1, got {dcn_dp}")
     if dcn_dp == 1:

@@ -25,7 +25,8 @@ from apex1_tpu.optim.fused_novograd import (  # noqa: F401
 from apex1_tpu.optim.fused_adagrad import (  # noqa: F401
     fused_adagrad, FusedAdagradState)
 from apex1_tpu.optim.larc import larc  # noqa: F401
-from apex1_tpu.optim.clip_grad import clip_grad_norm  # noqa: F401
+from apex1_tpu.optim.clip_grad import (  # noqa: F401
+    clip_grad_norm, clip_grad_norm as clip_grad_norm_)
 
 
 class Optimizer:

@@ -72,3 +72,8 @@ def larc(
         return (jax.tree_util.tree_map(per_param, grads, params), step)
 
     return optax.GradientTransformation(init, update)
+
+
+# reference name parity: ``apex.parallel.LARC.LARC`` is a wrapper
+# class; here the same math is an optax transform — same knobs
+LARC = larc

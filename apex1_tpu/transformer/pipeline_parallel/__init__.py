@@ -1,11 +1,13 @@
 """Pipeline parallelism — reference ``apex/transformer/pipeline_parallel``."""
 
 from apex1_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    allreduce_embedding_grads,
     forward_backward_no_pipelining,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
     pipeline_apply,
+    pipeline_tied_apply,
     pipelined_loss_fn,
 )
 from apex1_tpu.transformer.pipeline_parallel import (  # noqa: F401
