@@ -32,14 +32,20 @@ the full 4-axis dp × pp × ep × tp composition. (The router's aux
 balance loss is not threaded through the pipeline boundary; use the
 GSPMD `models.llama` ``moe_every`` path when the aux term matters.)
 
+With ``cp > 1`` the sequence is additionally sharded over the cp axis
+(outer to the tp/SP split): attention becomes `parallel.ring_attention`
+(ppermute KV ring, global causal offsets), rope rows are sliced at the
+shard's global positions, and the CE covers each cp shard's tokens —
+BASELINE config 5's long-context axis inside the same step.
+
 Gradient combination map (inside-grad convention; data replicas on
-(dp, ep)):
-- replicated leaves: pmean over (dp, ep);
+(dp, ep, cp)):
+- replicated leaves: pmean over (dp, ep, cp);
 - tp-sharded matmul shards (wq/wk/wv/wo/w_gate/w_up/w_down, emb/head
   rows): exact locally;
 - tp-replicated norms + router (computed on per-rank token subsets):
   psum over tp;
-- ep-sharded expert weights: psum over tp, pmean over dp, /ep (the
+- ep-sharded expert weights: psum over tp, pmean over (dp, cp), /ep (the
   all_to_all transpose already SUMMED every ep shard's contribution —
   never pmean across ep, that would mix different experts);
 - pp-replicated embedding/head/final_norm (used on first/last stage
@@ -56,8 +62,8 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from apex1_tpu.core.mesh import (AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP,
-                                 make_mesh)
+from apex1_tpu.core.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_PP,
+                                 AXIS_TP, make_mesh)
 from apex1_tpu.models.llama import LlamaConfig
 from apex1_tpu.ops import apply_rotary_pos_emb, rms_norm, rope_tables
 from apex1_tpu.ops.attention import flash_attention
@@ -76,6 +82,7 @@ class Llama3DConfig:
     dp: int = 1
     pp: int = 1
     tp: int = 1
+    cp: int = 1                       # context parallel (ring attention)
     ep: int = 1                       # expert parallel (requires moe)
     moe: bool = False                 # every layer's FFN expert-routed
     num_chunks: int = 1               # V>1 = interleaved virtual pipeline
@@ -91,8 +98,9 @@ class Llama3DConfig:
             raise ValueError("head counts must divide by tp")
         if m.vocab_size % self.tp:
             raise ValueError("vocab_size must divide by tp")
-        if m.max_seq_len % self.tp:
-            raise ValueError("seq len must divide by tp (SP shards)")
+        if m.max_seq_len % (self.tp * self.cp):
+            raise ValueError(
+                "seq len must divide by tp * cp (SP + ring shards)")
         if self.num_chunks > 1 and self.num_microbatches < self.pp:
             raise ValueError("interleaved pipeline needs M >= pp")
         if self.ep > 1 and not self.moe:
@@ -231,7 +239,7 @@ def abstract_state(cfg: Llama3DConfig, mesh):
             _scaler.init())
     dshape = (cfg.num_microbatches, m.max_seq_len,
               cfg.microbatch_size * cfg.dp * cfg.ep)
-    data = sds(dshape, P(None, None, (AXIS_DP, AXIS_EP)), jnp.int32)
+    data = sds(dshape, P(None, AXIS_CP, (AXIS_DP, AXIS_EP)), jnp.int32)
     return state, data
 
 
@@ -292,8 +300,9 @@ def from_llama_params(params, cfg: Llama3DConfig):
 
 
 def _stage_fn(cfg: Llama3DConfig, cos, sin):
-    """One pipeline stage over the LOCAL shards: x (S/tp, mb, E) bf16,
-    sequence-sharded over tp (Megatron (s, b, h) layout)."""
+    """One pipeline stage over the LOCAL shards: x (S/(cp*tp), mb, E)
+    bf16, sequence-sharded over cp (outer, ring attention) then tp
+    (Megatron SP, (s, b, h) layout)."""
     m = cfg.model
     tp = cfg.tp
     Hl, Kl, D = m.num_heads // tp, m.num_kv_heads // tp, m.head_dim
@@ -304,15 +313,27 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
         # attention: norm on seq shards, ONE seq all-gather feeds q/k/v
         h = rms_norm(x, lp["attn_norm"], eps=m.norm_eps).astype(dt)
         h = mp.gather_from_sequence_parallel_region(h, AXIS_TP, 0, True)
-        S, mb = h.shape[0], h.shape[1]
+        S, mb = h.shape[0], h.shape[1]      # S = cp-local sequence
         q = (h @ lp["wq"].astype(dt)).reshape(S, mb, Hl, D)
         k = (h @ lp["wk"].astype(dt)).reshape(S, mb, Kl, D)
         v = (h @ lp["wv"].astype(dt)).reshape(S, mb, Kl, D)
-        q = apply_rotary_pos_emb(q.transpose(1, 0, 2, 3), cos, sin)
-        k = apply_rotary_pos_emb(k.transpose(1, 0, 2, 3), cos, sin)
+        if cfg.cp > 1:
+            # GLOBAL positions for this cp shard's rope rows
+            start = jax.lax.axis_index(AXIS_CP) * S
+            cos_l = jax.lax.dynamic_slice_in_dim(cos, start, S)
+            sin_l = jax.lax.dynamic_slice_in_dim(sin, start, S)
+        else:
+            cos_l, sin_l = cos, sin
+        q = apply_rotary_pos_emb(q.transpose(1, 0, 2, 3), cos_l, sin_l)
+        k = apply_rotary_pos_emb(k.transpose(1, 0, 2, 3), cos_l, sin_l)
         v = v.transpose(1, 0, 2, 3)
-        attn = flash_attention(*(t.transpose(0, 2, 1, 3)
-                                 for t in (q, k, v)), causal=True)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if cfg.cp > 1:
+            from apex1_tpu.parallel.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, AXIS_CP, causal=True)
+        else:
+            attn = flash_attention(q, k, v, causal=True)
         attn = attn.transpose(2, 0, 1, 3).reshape(S, mb, Hl * D)
         o = attn @ lp["wo"].astype(dt)
         o = mp.reduce_scatter_to_sequence_parallel_region(o, AXIS_TP, 0)
@@ -362,8 +383,9 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
 def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
             cos, sin):
     """PARTIAL loss (sums to the global mean CE over the pp axis). Runs
-    inside shard_map over (dp, pp, tp). ``tokens``/``labels``:
-    (M, S, mb) int32, already dp-sharded on mb by the in_specs."""
+    inside shard_map over (dp, pp, cp, ep, tp). ``tokens``/``labels``:
+    (M, S, mb) int32, sequence cp-sharded and mb (dp, ep)-sharded by the
+    in_specs."""
     m = cfg.model
     tp = cfg.tp
     dt = m.policy.compute_dtype
@@ -373,7 +395,7 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
         y = vocab_parallel_embedding(tok_m, shared_local["emb"].astype(dt))
         return mp.scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
 
-    h_mb = jax.vmap(embed)(tokens)            # (M, S/tp, mb, E)
+    h_mb = jax.vmap(embed)(tokens)            # (M, S/(cp*tp), mb, E)
     local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_local)
     outs = pipeline_apply(stage, local, h_mb, num_chunks=cfg.num_chunks,
                           broadcast_outputs=False)
@@ -396,25 +418,26 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
 
 def combine_grads(g_chunk, g_shared, cfg: Llama3DConfig):
     """The full combination map for the inside-grad convention. Data
-    replicas live on (dp, ep); expert-sharded leaves are special: the
+    replicas live on (dp, ep, cp); expert-sharded leaves are special: the
     all_to_all transpose already SUMMED every ep shard's token
     contributions into the local expert shard, so their ep combine is a
     /ep (sum -> replica mean), never a pmean across DIFFERENT experts."""
     ep = cfg.ep
     moe = cfg.moe
     expert_keys = ("w_moe1", "w_moe2")
+    data_axes = (AXIS_DP, AXIS_EP, AXIS_CP)
 
     def chunk_one(k, g):
         if moe and k in expert_keys:
             g = jax.lax.psum(g, AXIS_TP)       # token subsets sum
-            return jax.lax.pmean(g, AXIS_DP) / ep
-        g = jax.lax.pmean(g, (AXIS_DP, AXIS_EP))
+            return jax.lax.pmean(g, (AXIS_DP, AXIS_CP)) / ep
+        g = jax.lax.pmean(g, data_axes)
         if "norm" in k or k == "wg":
             g = jax.lax.psum(g, AXIS_TP)       # SP/token-subset partials
         return g
 
     g_chunk = {k: chunk_one(k, v) for k, v in g_chunk.items()}
-    g_shared = jax.lax.pmean(g_shared, (AXIS_DP, AXIS_EP))
+    g_shared = jax.lax.pmean(g_shared, data_axes)
     # final_norm: computed on seq shards (tp-partial) on the last stage
     g_shared["final_norm"] = jax.lax.psum(g_shared["final_norm"], AXIS_TP)
     # embedding group: emb lives on stage 0, head + final_norm on the
@@ -453,7 +476,8 @@ def build_step(cfg: Llama3DConfig, mesh):
             lambda _: P(), scaler.init())
     cos, sin = rope_tables(jnp.arange(m.max_seq_len), m.head_dim,
                            base=m.rope_base)
-    data_spec = P(None, None, (AXIS_DP, AXIS_EP))   # (M, S, mb)
+    # (M, S, mb): sequence sharded over cp, batch over (dp, ep)
+    data_spec = P(None, AXIS_CP, (AXIS_DP, AXIS_EP))
 
     def train_step(state, tokens, labels):
         def scalar(params):
@@ -465,7 +489,7 @@ def build_step(cfg: Llama3DConfig, mesh):
 
         grads, loss_part = jax.grad(scalar, has_aux=True)(state["params"])
         loss = jax.lax.psum(loss_part, AXIS_PP)
-        loss = jax.lax.pmean(loss, (AXIS_DP, AXIS_EP))
+        loss = jax.lax.pmean(loss, (AXIS_DP, AXIS_EP, AXIS_CP))
         g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"],
                                           cfg)
         grads = {"chunk": g_chunk, "shared": g_shared}
@@ -473,7 +497,8 @@ def build_step(cfg: Llama3DConfig, mesh):
             grads = scaler.unscale(grads, state["scale"])
             finite = ls.all_finite(
                 grads,
-                axis_names=(AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP))
+                axis_names=(AXIS_DP, AXIS_EP, AXIS_CP, AXIS_PP,
+                            AXIS_TP))
         updates, new_opt = tx.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         new_state = {"step": state["step"] + 1, "params": new_params,
@@ -499,7 +524,8 @@ def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
     state, fused Adam on fp32 masters. ``params`` overrides the random
     init (e.g. `from_llama_params` output)."""
     if mesh is None:
-        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, ep=cfg.ep, tp=cfg.tp)
+        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, cp=cfg.cp, ep=cfg.ep,
+                         tp=cfg.tp)
     step, _state_specs, data_spec, tx = build_step(cfg, mesh)
     if params is None:
         chunk, shared = init_params(cfg)

@@ -29,6 +29,8 @@ def main():
     ap.add_argument("--pp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--chunks", type=int, default=1)
+    ap.add_argument("--cp", type=int, default=1,
+                    help="context parallel (ring attention seq shards)")
     ap.add_argument("--ep", type=int, default=1,
                     help="expert parallel (implies --moe)")
     ap.add_argument("--moe", action="store_true",
@@ -42,7 +44,7 @@ def main():
     args = ap.parse_args()
     if args.ep > 1:
         args.moe = True
-    n = args.dp * args.pp * args.tp * args.ep
+    n = args.dp * args.pp * args.tp * args.ep * args.cp
     force_virtual_cpu_devices(max(n, 2))
 
     import jax
@@ -61,7 +63,7 @@ def main():
         hidden_size=args.hidden, ffn_size=2 * args.hidden,
         policy=get_policy("O2"), **moe_kw)
     cfg = Llama3DConfig(model=mcfg, dp=args.dp, pp=args.pp, tp=args.tp,
-                        ep=args.ep, moe=args.moe,
+                        cp=args.cp, ep=args.ep, moe=args.moe,
                         num_chunks=args.chunks,
                         num_microbatches=args.microbatches,
                         microbatch_size=1, learning_rate=3e-3)
@@ -69,6 +71,7 @@ def main():
     rng = np.random.default_rng(0)
     shape = (args.microbatches, args.seq, args.dp * args.ep)
     print(f"mesh dp={args.dp} pp={args.pp} tp={args.tp} ep={args.ep} "
+          f"cp={args.cp} "
           f"chunks={args.chunks} moe={args.moe} ({n} devices), "
           f"{args.layers}L x {args.hidden}h", flush=True)
     t0 = time.time()
