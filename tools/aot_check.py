@@ -415,6 +415,25 @@ def main():
             return step.lower(state, data, data)
 
         report(f"flagship 8B train step ({gen} x{fn_dev})", flagship_run)
+
+        # BASELINE config 5 at scale: 8B LONG-CONTEXT — sequence 32k
+        # sharded over cp (ring attention inside the same step)
+        lc_cfg = Llama3DConfig(
+            model=LlamaConfig(policy=get_policy("O2"), remat=True,
+                              max_seq_len=32768),
+            dp=1, pp=2, cp=2, tp=fn_dev // 4, num_microbatches=4,
+            microbatch_size=1)
+        lc_mesh = mk(dp=1, pp=2, cp=2, tp=fn_dev // 4,
+                     devices=list(ftopo.devices),
+                     allow_split_physical_axes=True)
+
+        def longctx_run():
+            step, _, _, _ = build_step(lc_cfg, lc_mesh)
+            state, data = abstract_state(lc_cfg, lc_mesh)
+            return step.lower(state, data, data)
+
+        report(f"flagship 8B long-ctx S=32k cp2 ({gen} x{fn_dev})",
+               longctx_run)
         # analytic per-stage parameter budget (SPMD allocates the
         # pp-replicated embedding/head on every stage)
         m = fcfg.model
