@@ -33,6 +33,7 @@ import argparse
 import functools
 import json
 import math
+import os
 import signal
 import subprocess
 import sys
@@ -47,25 +48,52 @@ def probe_backend(timeout_s=180.0, retries=3, backoff=20.0):
     """Initialize the backend in a SUBPROCESS first: on a dead axon tunnel,
     in-process init blocks uninterruptibly (BENCH_r01 died rc=1 with no
     output), while a subprocess can be killed and retried with backoff.
-    Returns the backend name, or None if it never came up."""
+    Returns (backend_name_or_None, last_stderr_tail)."""
     # honor JAX_PLATFORMS through jax.config: the container sitecustomize
     # pins jax_platforms=axon,cpu, which silently overrides the env var
     code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
             "p and jax.config.update('jax_platforms', p); "
             "d = jax.devices(); print('BACKEND=' + jax.default_backend())")
+    stderr_tail = ""
     for attempt in range(retries):
         try:
             out = subprocess.run([sys.executable, "-c", code],
                                  capture_output=True, text=True,
                                  timeout=timeout_s)
+            stderr_tail = (out.stderr or "")[-400:]
             for line in out.stdout.splitlines():
                 if line.startswith("BACKEND="):
-                    return line.split("=", 1)[1]
-        except subprocess.TimeoutExpired:
-            pass
+                    return line.split("=", 1)[1], stderr_tail
+        except subprocess.TimeoutExpired as e:
+            stderr_tail = ((e.stderr or b"").decode("utf-8", "replace")
+                           if isinstance(e.stderr, bytes)
+                           else (e.stderr or ""))[-400:] or "probe timeout"
+            # a KILLED probe can leave a stale libtpu lockfile that wedges
+            # the next probe (and any AOT client) — but only remove it if
+            # no live client still holds the flock
+            _remove_stale_libtpu_lockfile()
         if attempt < retries - 1:
             time.sleep(backoff * (2 ** attempt))
-    return None
+    return None, stderr_tail
+
+
+def _remove_stale_libtpu_lockfile(path="/tmp/libtpu_lockfile"):
+    """Remove the libtpu multi-client lockfile only when it is STALE —
+    i.e. no live process holds the flock (a live holder means another
+    client owns the chip; deleting its lockfile would let two libtpu
+    clients collide on one device)."""
+    import fcntl
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)  # held? -> OSError
+        os.remove(path)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def timed_steps(train_step, state, batch, iters):
@@ -220,37 +248,68 @@ def bench_resnet(on_accel):
             1_400.0)
 
 
-def bench_llama_longctx(on_accel):
+def _bench_llama(on_accel, *, accel_cfg, accel_bsi, tiny_seq, name, proxy):
+    """Shared scaffolding for the Llama-family configs below."""
     import dataclasses
 
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn
 
     if on_accel:
-        B, S, iters = 1, 16384, 4
-        # 16 layers: AOT memory analysis (tools/aot_check.py) showed the
-        # 22-layer variant needs 18.7 GiB on a 15.75 GiB v5e (Adam state
-        # dominates); 16 layers compiles at ~14.4 GiB with margin
-        cfg = LlamaConfig(
-            vocab_size=32000, max_seq_len=S, num_layers=16,
-            num_heads=32, num_kv_heads=4, hidden_size=2048,
-            ffn_size=5632, remat=True, policy=get_policy("O2"))
+        B, S, iters = accel_bsi
+        cfg = accel_cfg(get_policy("O2"), S)
     else:
-        B, S, iters = 1, 512, 2
+        B, S, iters = 1, tiny_seq, 2
         cfg = dataclasses.replace(
-            LlamaConfig.tiny(policy=get_policy("O2")), max_seq_len=512,
+            LlamaConfig.tiny(policy=get_policy("O2")), max_seq_len=S,
             remat=True)
+        name = "Llama(tiny smoke)"
     model = Llama(cfg)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S)),
         jnp.int32)
     params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
     state, step = _amp_state_step(llama_loss_fn(model), params)
-    name = ("Llama-0.8B-16k-flash" if on_accel
-            else "Llama(tiny smoke)")
     return (state, step, (tokens,), B * S, iters,
             f"tokens/sec/chip {name} amp-O2 remat", "tokens/sec/chip",
-            12_000.0)
+            proxy)
+
+
+def bench_llama_longctx(on_accel):
+    from apex1_tpu.models.llama import LlamaConfig
+
+    # 16 layers: AOT memory analysis (tools/aot_check.py) showed the
+    # 22-layer variant needs 18.7 GiB on a 15.75 GiB v5e (Adam state
+    # dominates); 16 layers compiles at ~14.4 GiB with margin
+    return _bench_llama(
+        on_accel,
+        accel_cfg=lambda pol, S: LlamaConfig(
+            vocab_size=32000, max_seq_len=S, num_layers=16,
+            num_heads=32, num_kv_heads=4, hidden_size=2048,
+            ffn_size=5632, remat=True, policy=pol),
+        accel_bsi=(1, 16384, 4), tiny_seq=512,
+        name="Llama-0.8B-16k-flash", proxy=12_000.0)
+
+
+def bench_llama_block(on_accel):
+    """BASELINE config 4's single-chip proxy (VERDICT r2 item 6): a
+    Llama-3-8B-WIDTH decoder stack (hidden 4096, ffn 14336, 32 heads /
+    8 KV, full flash + fused RoPE/RMSNorm/CE path) at the depth that fits
+    one chip with full Adam state — tp=pp=1, remat. Times the exact
+    per-layer fused stack the dp2×pp2×tp4 flagship runs per stage, so
+    tokens/sec here × (depth ratio) bounds the full-model per-chip rate.
+    3 layers + 32k-vocab embedding/head ≈ 0.9B params ≈ 11 GiB Adam
+    state on a 16 GiB v5e."""
+    from apex1_tpu.models.llama import LlamaConfig
+
+    return _bench_llama(
+        on_accel,
+        accel_cfg=lambda pol, S: LlamaConfig(
+            vocab_size=32000, max_seq_len=S, num_layers=3,
+            num_heads=32, num_kv_heads=8, hidden_size=4096,
+            ffn_size=14336, remat=True, policy=pol),
+        accel_bsi=(2, 4096, 6), tiny_seq=256,
+        name="Llama-8B-width-3L", proxy=9_000.0)
 
 
 BENCHES = {
@@ -259,6 +318,7 @@ BENCHES = {
     "bert_large": functools.partial(bench_bert, large=True),
     "resnet": bench_resnet,
     "llama_longctx": bench_llama_longctx,
+    "llama_block": bench_llama_block,
 }
 
 
@@ -284,11 +344,13 @@ def main():
     fallback = {"metric": f"{unit} {args.config} [unreachable]",
                 "value": 0.0, "unit": unit, "vs_baseline": 0.0}
 
-    backend = probe_backend(args.probe_timeout, args.probe_retries)
+    backend, probe_stderr = probe_backend(args.probe_timeout,
+                                          args.probe_retries)
     if backend is None:
         fallback["error"] = (
             f"backend init unreachable after {args.probe_retries} probes "
-            f"x {args.probe_timeout:.0f}s")
+            f"x {args.probe_timeout:.0f}s"
+            + (f"; last stderr: {probe_stderr}" if probe_stderr else ""))
         _emit(fallback)
         return
 

@@ -39,9 +39,14 @@ run() { # name timeout cmd...
   echo "$name rc=$rc $(date -u +%H:%M:%S)" >> "$RES/status.log"
 }
 
-# Headline numbers first (most valuable if the tunnel dies again),
-# then batch scaling, per-op profile, per-kernel A/B sweeps.
+# Queue order per VERDICT r2 item 1: (a) on-device kernel NUMERICS parity
+# (2-min sweep — Mosaic numerics, not just lowering), (b) headline bench +
+# MFU, (c) remaining configs, (d) per-op profile + kernel A/B sweeps
+# (includes the fused_dense roofline and flat-vs-per-tensor optimizer A/B,
+# the open "measure-first" debts).
+run hw_numerics     1200 python tools/hw_numerics.py
 run bench_gpt2      1800 python bench.py --config gpt2
+run bench_llama_blk 2400 python bench.py --config llama_block
 run bench_bert_lg   1800 python bench.py --config bert_large
 run bench_llama16k  2400 python bench.py --config llama_longctx
 run bench_bert      1500 python bench.py --config bert
