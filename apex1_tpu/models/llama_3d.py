@@ -397,8 +397,12 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
 
     h_mb = jax.vmap(embed)(tokens)            # (M, S/(cp*tp), mb, E)
     local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_local)
+    # bubble-skip contract (schedules.pipeline_apply): ring attention
+    # rotates KV with ppermute, which must not sit inside the per-tick
+    # validity cond — mask bubbles instead when cp shards the sequence
     outs = pipeline_apply(stage, local, h_mb, num_chunks=cfg.num_chunks,
-                          broadcast_outputs=False)
+                          broadcast_outputs=False,
+                          skip_bubbles=cfg.cp == 1)
 
     o = rms_norm(outs, shared_local["final_norm"], eps=m.norm_eps)
     o = o.astype(dt)

@@ -27,9 +27,10 @@ Schedule math:
   V·M + P − 1 — bubble still P−1, matching interleaved 1F1B's bubble
   shrink vs running V·M microbatches through a V·P-deep pipe.
 
-Bubble ticks still execute ``stage_fn`` on zeros (SPMD); their outputs are
-masked and receive zero cotangents, so they cost FLOPs (fraction
-(P−1)/(VM+P−1)) but not correctness.
+Bubble ticks (fraction (P−1)/(VM+P−1)) SKIP the stage compute via a
+per-tick ``lax.cond`` — like 1F1B, the schedule does no redundant work;
+bubble ranks idle through the tick and forward zeros to the ring permute
+(measured: tools/pipeline_cost.py, docs/parallel.md "Pipeline cost model").
 """
 
 from __future__ import annotations
@@ -61,6 +62,7 @@ def pipeline_apply(
     broadcast_outputs: bool = True,
     remat_stage: bool = False,
     scan_unroll: int | bool = 1,
+    skip_bubbles: bool = True,
 ):
     """Run the pipelined forward. MUST be called inside ``shard_map`` over
     ``axis_name``.
@@ -99,6 +101,21 @@ def pipeline_apply(
       indicator), take grads, then ``psum`` the loss VALUE for logging;
       grads of pp-replicated leaves (tied embeddings, shared heads) combine
       with :func:`allreduce_embedding_grads`.
+
+    ``skip_bubbles`` (default True) elides bubble-tick stage compute with
+    a per-tick ``lax.cond``. CONTRACT: ``stage_fn`` must NOT contain
+    ``lax.ppermute`` (ring attention, halo exchange). XLA lowers ppermute
+    to ONE collective-permute whose rendezvous spans every device in the
+    mesh, so ranks that skip a tick desynchronize the pairing across ticks
+    and the data lands in the wrong tick (observed empirically; loss moves
+    by ~1e-3 rel on a pp2×cp2 ring-attention step). Group-scoped
+    collectives (``psum``/``all_gather``/``reduce_scatter``/
+    ``all_to_all``) rendezvous per replica-group and are verified safe
+    (mask-vs-skip exact match on a pp2×cp2 mesh for each class). Pass
+    ``skip_bubbles=False`` for ppermute-bearing stages — bubble ticks then
+    run ``stage_fn`` on zeros and mask the result (wall-time equivalent to
+    the reference's idle bubble; the skip saves power/FLOPs, not
+    critical-path latency).
     """
     if remat_stage:
         stage_fn = jax.checkpoint(stage_fn)
@@ -141,7 +158,28 @@ def pipeline_apply(
         x = jnp.where(s == 0, x0, x_recv)
 
         params_v = _tree_select_chunk(chunk_params, v)
-        y = stage_fn(params_v, x)
+        # Bubble ticks (fill/drain, fraction (P−1)/(VM+P−1)) carry no real
+        # microbatch: skip the stage compute entirely with a per-tick
+        # `lax.cond` (the `ring_attention` causal-skip pattern) instead of
+        # running `stage_fn` on zeros and masking — 1F1B does no redundant
+        # compute (SURVEY #55) and neither should the scan schedule. The
+        # predicate is uniform within a pp rank (and across its tp/cp/ep
+        # subgroups), so group-scoped collectives (psum / all_gather /
+        # reduce_scatter / all_to_all) inside `stage_fn` are safe: peers
+        # share (s, t), take the same branch, and each replica_group
+        # rendezvouses independently (verified mask-vs-skip exact-match,
+        # tools/pipeline_cost.py repro). ``ppermute`` is NOT safe — see
+        # the ``skip_bubbles`` contract in the docstring.
+        # (``skip_bubbles=False`` keeps the old mask-only path — the A/B
+        # lever tools/pipeline_cost.py times, since static cost_analysis
+        # prices a conditional's branches whether or not they execute.)
+        if skip_bubbles:
+            y = jax.lax.cond(valid,
+                             lambda ops: stage_fn(*ops),
+                             lambda ops: zeros_x,
+                             (params_v, x))
+        else:
+            y = stage_fn(params_v, x)
 
         out_ok = valid & (s == P - 1) & (v == V - 1)
         outs = jnp.where(out_ok,

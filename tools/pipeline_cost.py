@@ -61,13 +61,14 @@ def main():
                             unroll=True)
         return x
 
-    def pipe_loss(params, mbs, remat, unroll):
+    def pipe_loss(params, mbs, remat, unroll, skip=True):
         def inner(params, mbs):
             s = jax.lax.axis_index("pp")
             last = (s == P_ - 1).astype(jnp.float32)
             outs = pipeline_apply(stage, params[:, 0], mbs,
                                   broadcast_outputs=False,
-                                  remat_stage=remat, scan_unroll=unroll)
+                                  remat_stage=remat, scan_unroll=unroll,
+                                  skip_bubbles=skip)
             return last * jnp.mean(jnp.square(outs))
 
         return jax.shard_map(inner, mesh=mesh,
@@ -105,13 +106,40 @@ def main():
                            lambda p: pipe_loss(p, mbs, True, 1), params)
     pred = (M + P_ - 1) / M
     # fl_pipe is PER-DEVICE; the flat program runs the whole model on one
-    # device, so total pipeline work = P x per-device
-    print(f"\nbubble-FLOP ratio pipeline/flat: "
+    # device, so total pipeline work = P x per-device. NOTE: static
+    # cost_analysis prices a lax.cond's branches whether or not they run,
+    # so with skip_bubbles this ratio is an UPPER bound — the executed
+    # ratio is measured by the wall-clock A/B below.
+    print(f"\nbubble-FLOP ratio pipeline/flat (static): "
           f"{P_ * fl_pipe / fl_flat:.3f}  "
-          f"(predicted (M+P-1)/M = {pred:.3f})")
+          f"(mask-only predicted (M+P-1)/M = {pred:.3f})")
     print(f"activation temp: naive {tmp_pipe/2**20:.1f} MiB -> remat "
           f"{tmp_remat/2**20:.1f} MiB "
           f"({tmp_pipe / max(tmp_remat, 1):.2f}x reduction)")
+
+    # --- bubble-skip A/B: does the lax.cond actually elide the compute? ---
+    import time
+
+    def timed(fn, *a, iters=5):
+        c = jax.jit(jax.value_and_grad(fn)).lower(*a).compile()
+        hlo = c.as_text()
+        has_cond = " conditional(" in hlo or "conditional." in hlo
+        jax.block_until_ready(c(*a))  # warmup
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = c(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters, has_cond
+
+    t_skip, cond_in_hlo = timed(
+        lambda p: pipe_loss(p, mbs, False, 1, skip=True), params)
+    t_mask, _ = timed(
+        lambda p: pipe_loss(p, mbs, False, 1, skip=False), params)
+    # ideal executed-tick ratio: mask runs T=M+P-1 stage ticks, skip runs M
+    print(f"\nbubble-skip wall-clock A/B (fwd+bwd, rolled scan): "
+          f"mask {t_mask*1e3:.1f} ms -> cond-skip {t_skip*1e3:.1f} ms "
+          f"({t_mask/t_skip:.3f}x; ideal {(M+P_-1)/M:.3f}x), "
+          f"HLO conditional present: {cond_in_hlo}")
 
 
 if __name__ == "__main__":
