@@ -340,21 +340,27 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
         x = x + o.astype(x.dtype)
 
         h = rms_norm(x, lp["mlp_norm"], eps=m.norm_eps).astype(dt)
+        aux = jnp.zeros([], jnp.float32)
         if cfg.moe:
             # expert FFN on the SEQ-SHARDED tokens: each (tp, dp, ep)
             # rank dispatches its own token subset over the ep axis
             # (double all_to_all inside moe_shard_map_apply); expert
-            # weights are ep-sharded, tp/pp-replicated. The router's
-            # aux balance loss is not threaded through the pipeline
-            # boundary — use the GSPMD Llama (moe_every) path when the
-            # aux term matters.
+            # weights are ep-sharded, tp/pp-replicated. stats_axes
+            # psum-combines the router's load-balance statistics over
+            # every axis that shards this microbatch's tokens, so aux is
+            # the GLOBAL Switch balance term (≙ the flat model's sowed
+            # moe_aux, models/llama.py:152) — returned alongside y and
+            # carried out of the pipeline by with_aux.
             from apex1_tpu.transformer.moe import moe_shard_map_apply
 
+            stats_axes = (AXIS_TP, AXIS_DP, AXIS_EP)
+            if cfg.cp > 1:
+                stats_axes += (AXIS_CP,)
             S_l, mb = h.shape[0], h.shape[1]
-            y2, _aux = moe_shard_map_apply(
+            y2, aux = moe_shard_map_apply(
                 h.reshape(-1, E), lp["wg"].astype(dt), lp["w_moe1"],
                 lp["w_moe2"], cfg.moe_cfg, axis_name=AXIS_EP,
-                act=jax.nn.silu)
+                act=jax.nn.silu, stats_axes=stats_axes)
             y = y2.reshape(S_l, mb, E)
         else:
             # dense MLP: same SP pattern, one gather feeds gate+up
@@ -364,7 +370,7 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
                  * (h @ lp["w_up"].astype(dt))) @ lp["w_down"].astype(dt)
             y = mp.reduce_scatter_to_sequence_parallel_region(y, AXIS_TP,
                                                               0)
-        return x + y.astype(x.dtype)
+        return x + y.astype(x.dtype), aux
 
     if m.remat:
         layer = jax.checkpoint(layer)
@@ -372,9 +378,12 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
     def stage(p_stage, x):
         # p_stage leaves: (layers_per_stage, ...) — scan keeps the jaxpr
         # O(1) in depth (16 layers/stage at 8B scale); remat(layer) inside
-        # scan is the standard activation-checkpoint pattern
-        x, _ = jax.lax.scan(lambda x, lp: (layer(x, lp), None),
-                            x, p_stage)
+        # scan is the standard activation-checkpoint pattern. Per-layer
+        # MoE aux terms come out as scan outputs and sum to the stage's
+        # contribution (with_aux pipeline channel).
+        x, auxes = jax.lax.scan(lambda x, lp: layer(x, lp), x, p_stage)
+        if cfg.moe:
+            return x, jnp.sum(auxes)
         return x
 
     return stage
@@ -402,7 +411,10 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
     # validity cond — mask bubbles instead when cp shards the sequence
     outs = pipeline_apply(stage, local, h_mb, num_chunks=cfg.num_chunks,
                           broadcast_outputs=False,
-                          skip_bubbles=cfg.cp == 1)
+                          skip_bubbles=cfg.cp == 1,
+                          with_aux=cfg.moe)
+    if cfg.moe:
+        outs, moe_aux = outs
 
     o = rms_norm(outs, shared_local["final_norm"], eps=m.norm_eps)
     o = o.astype(dt)
@@ -417,7 +429,29 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
         sequence_parallel_input=True)
     last = (jax.lax.axis_index(AXIS_PP)
             == jax.lax.axis_size(AXIS_PP) - 1).astype(jnp.float32)
-    return last * jnp.mean(ce)
+    loss = last * jnp.mean(ce)
+    if cfg.moe:
+        # MoE aux under the PARTIAL-loss convention: each pp rank adds
+        # its own stages' (already globally-combined) balance terms, so
+        # psum over pp sums distinct layers; aux is per-(microbatch,
+        # layer) and the gold averages per-microbatch losses, hence /M.
+        #
+        # SEED MULTIPLICITY (docs/parallel.md "Pipeline gradient
+        # conventions"): the stats psum over (tp, dp, ep[, cp]) makes the
+        # aux REPLICATED over those axes, so with grad taken inside the
+        # shard_map every rank seeds it and psum's transpose multiplies
+        # the aux cotangent by the full group size R = tp·dp·ep·cp.
+        # combine_grads expects CE-convention terms — distinct per
+        # (dp, ep, cp) rank (pmean'd) and replicated over tp only
+        # (psum'd for norm/router leaves) — i.e. multiplicity tp, not R.
+        # Seeding aux/tp cancels the excess exactly for every param
+        # class; the stop_gradient completion restores the VALUE so the
+        # logged loss is CE + full aux.
+        inv = 1.0 / cfg.tp
+        aux_term = (moe_aux * inv
+                    + jax.lax.stop_gradient(moe_aux) * (1.0 - inv))
+        loss = loss + aux_term / tokens.shape[0]
+    return loss
 
 
 def combine_grads(g_chunk, g_shared, cfg: Llama3DConfig):

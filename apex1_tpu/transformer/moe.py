@@ -55,7 +55,7 @@ def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
     return max(1, cap)
 
 
-def router(x2, wg, cfg: MoEConfig, token_mask=None):
+def router(x2, wg, cfg: MoEConfig, token_mask=None, *, stats_axes=None):
     """Top-k routing for flat tokens ``x2`` (T, H) with gate ``wg`` (H, E).
 
     Returns ``(dispatch (T, E, C) bool-as-float, combine (T, E, C) float,
@@ -63,6 +63,15 @@ def router(x2, wg, cfg: MoEConfig, token_mask=None):
     masked cumsum, tokens beyond capacity get zero dispatch/combine.
     ``token_mask`` (T,) bool: False tokens (padding in packed batches)
     claim no capacity and are excluded from the load-balance statistics.
+
+    ``stats_axes``: mesh axis name(s) that shard ONE logical batch's
+    tokens across callers (tp sequence shards, ep/dp token subsets, cp
+    sequence shards). The Switch aux statistics (assignment fraction f,
+    mean router prob p) are then ``psum``-combined over those axes before
+    forming ``Σ f·p``, so every rank returns the aux loss of the GLOBAL
+    token set — matching the unpartitioned model exactly (Σ f·p is
+    nonlinear in the per-shard means, so summing per-shard aux would
+    not). Dispatch/combine stay local; capacity is per-shard.
     """
     T = x2.shape[0]
     E, k = cfg.num_experts, cfg.top_k
@@ -76,10 +85,17 @@ def router(x2, wg, cfg: MoEConfig, token_mask=None):
             else token_mask.astype(jnp.float32))
 
     # Switch aux loss over the TOP-1 assignment fraction (valid tokens)
-    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
     top1_hot = jax.nn.one_hot(gate_idx[:, 0], E) * mask[:, None]
-    f = jnp.sum(top1_hot, axis=0) / n_valid            # fraction per expert
-    p = (jnp.sum(probs * mask[:, None], axis=0) / n_valid)  # mean prob
+    n_sum = jnp.sum(mask)
+    f_sum = jnp.sum(top1_hot, axis=0)                  # count per expert
+    p_sum = jnp.sum(probs * mask[:, None], axis=0)     # prob mass
+    if stats_axes is not None:
+        n_sum = jax.lax.psum(n_sum, stats_axes)
+        f_sum = jax.lax.psum(f_sum, stats_axes)
+        p_sum = jax.lax.psum(p_sum, stats_axes)
+    n_valid = jnp.maximum(n_sum, 1.0)
+    f = f_sum / n_valid                                # fraction per expert
+    p = p_sum / n_valid                                # mean prob
     aux = cfg.aux_loss_weight * E * jnp.sum(f * p)
 
     dispatch = jnp.zeros((T, E, C), jnp.float32)
@@ -142,7 +158,7 @@ def param_specs(params, *, axis=AXIS_EP):
 
 def moe_shard_map_apply(x_local, wg, w1_local, w2_local, cfg: MoEConfig,
                         *, axis_name=AXIS_EP, act=jax.nn.gelu,
-                        token_mask=None):
+                        token_mask=None, stats_axes=None):
     """Explicit expert-parallel dataflow — call inside ``shard_map`` with
     tokens sharded over ``axis_name`` (x_local: (T_local, H)) and expert
     weights sharded over dim 0 (w1_local: (E_local, H, F)).
@@ -158,8 +174,8 @@ def moe_shard_map_apply(x_local, wg, w1_local, w2_local, cfg: MoEConfig,
     E = cfg.num_experts
     if E % ep:
         raise ValueError(f"num_experts {E} must divide by ep={ep}")
-    dispatch, combine, aux = router(x_local, wg, cfg,
-                                    token_mask)     # (T_l, E, C_l)
+    dispatch, combine, aux = router(x_local, wg, cfg, token_mask,
+                                    stats_axes=stats_axes)  # (T_l, E, C_l)
     dtype = x_local.dtype
     xe = jnp.einsum("tec,th->ech", dispatch.astype(dtype), x_local)
     # (E, C_l, H) -> split expert axis across devices, gather capacity:

@@ -63,6 +63,7 @@ def pipeline_apply(
     remat_stage: bool = False,
     scan_unroll: int | bool = 1,
     skip_bubbles: bool = True,
+    with_aux: bool = False,
 ):
     """Run the pipelined forward. MUST be called inside ``shard_map`` over
     ``axis_name``.
@@ -116,6 +117,14 @@ def pipeline_apply(
     run ``stage_fn`` on zeros and mask the result (wall-time equivalent to
     the reference's idle bubble; the skip saves power/FLOPs, not
     critical-path latency).
+
+    ``with_aux=True``: ``stage_fn`` returns ``(y, aux)`` with ``aux`` a
+    scalar side loss (e.g. the MoE router's load-balance term). The
+    pipeline sums aux over this rank's VALID ticks only and returns
+    ``(outputs, aux_sum)`` — per-rank partials over the pp axis (each
+    stage's layers contribute exactly once), so under the partial-loss
+    convention adding ``aux_sum`` to the rank's partial loss and psumming
+    over pp yields the whole model's aux term.
     """
     if remat_stage:
         stage_fn = jax.checkpoint(stage_fn)
@@ -134,7 +143,7 @@ def pipeline_apply(
     zeros_x = jnp.zeros(x_shape, dtype)
 
     def tick(carry, t):
-        x_recv, fifo, outs = carry
+        x_recv, fifo, outs, aux_acc = carry
         # stage-0 FIFO: record the activation that arrived this tick
         # (sent by stage P-1 at tick t-1, i.e. chunk-output of slot t-P)
         m_arr = jnp.mod(t - P, M)
@@ -173,13 +182,19 @@ def pipeline_apply(
         # (``skip_bubbles=False`` keeps the old mask-only path — the A/B
         # lever tools/pipeline_cost.py times, since static cost_analysis
         # prices a conditional's branches whether or not they execute.)
+        zero_aux = jnp.zeros([], jnp.float32)
+
+        def run(ops):
+            out = stage_fn(*ops)
+            return out if with_aux else (out, zero_aux)
+
         if skip_bubbles:
-            y = jax.lax.cond(valid,
-                             lambda ops: stage_fn(*ops),
-                             lambda ops: zeros_x,
-                             (params_v, x))
+            y, aux = jax.lax.cond(valid, run,
+                                  lambda ops: (zeros_x, zero_aux),
+                                  (params_v, x))
         else:
-            y = stage_fn(params_v, x)
+            y, aux = run((params_v, x))
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
 
         out_ok = valid & (s == P - 1) & (v == V - 1)
         outs = jnp.where(out_ok,
@@ -189,22 +204,25 @@ def pipeline_apply(
 
         y_send = jax.lax.ppermute(
             y, axis_name, perm=[(i, (i + 1) % P) for i in range(P)])
-        return (y_send, fifo, outs), None
+        return (y_send, fifo, outs, aux_acc), None
 
     init = (zeros_x,
             jnp.zeros((M,) + x_shape, dtype),
-            jnp.zeros((M,) + x_shape, dtype))
+            jnp.zeros((M,) + x_shape, dtype),
+            jnp.zeros([], jnp.float32))
     # scan_unroll > 1 lets XLA software-pipeline the tick loop (overlap a
     # tick's ppermute with the next tick's compute); True also makes every
     # tick visible to cost_analysis (tools/pipeline_cost.py)
-    (x_recv, fifo, outs), _ = jax.lax.scan(tick, init, jnp.arange(T),
-                                           unroll=scan_unroll)
+    (x_recv, fifo, outs, aux_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(T), unroll=scan_unroll)
 
     if not broadcast_outputs:
-        return outs  # accumulated on the last stage only; zeros elsewhere
+        # accumulated on the last stage only; zeros elsewhere
+        return (outs, aux_sum) if with_aux else outs
     # replicate last-stage outputs (transpose: cotangent flows to stage P-1)
     is_last = (s == P - 1).astype(outs.dtype)
-    return jax.lax.psum(outs * is_last, axis_name)
+    bcast = jax.lax.psum(outs * is_last, axis_name)
+    return (bcast, aux_sum) if with_aux else bcast
 
 
 # ---------------------------------------------------------------------------

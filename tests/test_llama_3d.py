@@ -192,17 +192,25 @@ def test_moe_ep_matches_unpartitioned(devices, rng):
         check_vma=False))(chunk, shared, tokens, labels)
 
     # gold: the flat MoE Llama, loss = per-replica mean CE averaged over
-    # the (dp, ep) replicas — each replica is one mb column
+    # the (dp, ep) replicas — each replica is one mb column — PLUS the
+    # sowed Switch aux balance terms (aux_loss_weight is the LlamaConfig
+    # default 1e-2 > 0, so this asserts the pipelined aux channel too)
     def gold(flat):
         def per_mb(tok_m, lbl_m):
-            logits = model.apply({"params": flat}, tok_m.transpose(1, 0))
-            return softmax_cross_entropy_loss(
+            logits, aux_vars = model.apply(
+                {"params": flat}, tok_m.transpose(1, 0),
+                mutable=["losses"])
+            ce = softmax_cross_entropy_loss(
                 logits.astype(jnp.float32), lbl_m.transpose(1, 0))
+            aux = sum(jnp.sum(jnp.asarray(v)) for v in
+                      jax.tree_util.tree_leaves(
+                          aux_vars.get("losses", {})))
+            return ce, aux
 
         # replica r owns mb column r: per-replica mean over (M, S) then
         # mean over replicas == overall mean here (equal token counts)
-        ces = jax.vmap(per_mb)(tokens, labels)
-        return jnp.mean(ces)
+        ces, auxes = jax.vmap(per_mb)(tokens, labels)
+        return jnp.mean(ces) + jnp.mean(auxes)
 
     want_loss, want_grads = jax.value_and_grad(gold)(flat)
     np.testing.assert_allclose(float(loss), float(want_loss), rtol=2e-5)
