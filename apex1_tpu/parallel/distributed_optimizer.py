@@ -61,7 +61,9 @@ def shard_opt_state_specs(opt_state, *, axis=AXIS_FSDP, param_specs=None):
         """Structure match is not enough: a degenerate params tree (e.g. a
         single leaf) structurally matches every scalar opt-state leaf, and
         substituting a rank-k spec onto a 0-d step/count leaf is invalid.
-        Require each spec's length to equal its candidate leaf's rank."""
+        Require len(spec) <= leaf rank for each candidate leaf (JAX treats
+        trailing unspecified dims as replicated, so SHORT specs are valid;
+        a spec LONGER than the rank is not)."""
         leaves = jax.tree_util.tree_leaves(node)
         specs = jax.tree_util.tree_leaves(
             param_specs, is_leaf=lambda v: isinstance(v, P))
