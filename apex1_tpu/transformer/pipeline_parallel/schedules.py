@@ -64,6 +64,8 @@ def pipeline_apply(
     scan_unroll: int | bool = 1,
     skip_bubbles: bool = True,
     with_aux: bool = False,
+    boundary_shape: tuple[int, ...] | None = None,
+    boundary_dtype=None,
 ):
     """Run the pipelined forward. MUST be called inside ``shard_map`` over
     ``axis_name``.
@@ -125,6 +127,21 @@ def pipeline_apply(
     stage's layers contribute exactly once), so under the partial-loss
     convention adding ``aux_sum`` to the rank's partial loss and psumming
     over pp yields the whole model's aux term.
+
+    VARIABLE BOUNDARY SHAPES (≙ the reference's ``decoder_seq_length`` /
+    ``_communicate`` shape negotiation, SURVEY #56): the reference's
+    host-driven p2p can send a different tensor shape between each stage
+    pair; a compiled SPMD scan cannot — every tick's ppermute carries ONE
+    static buffer. The mesh-native equivalent is PAD-TO-MAX: pass
+    ``boundary_shape`` (>= the microbatch trailing shape, elementwise) and
+    ``boundary_dtype``; stage-0 injections are zero-padded into that
+    buffer, ``stage_fn`` maps boundary-shaped x to boundary-shaped y
+    (masking per ``lax.axis_index`` where its real extent is narrower —
+    e.g. a T5 decoder stage using only the first ``decoder_seq_length``
+    rows), and outputs come back boundary-shaped for the caller to slice.
+    Zero-region garbage is dead by construction: it receives zero
+    cotangents (outputs sliced/masked) and bubble ticks never read it.
+    Parity-tested in ``test_pipeline.py::TestVariableBoundary``.
     """
     if remat_stage:
         stage_fn = jax.checkpoint(stage_fn)
@@ -138,8 +155,21 @@ def pipeline_apply(
             f"pipeline size ({P})")
     T = V * M + P - 1
 
-    x_shape = microbatches.shape[1:]
-    dtype = microbatches.dtype
+    x_shape = boundary_shape or microbatches.shape[1:]
+    dtype = boundary_dtype or microbatches.dtype
+    if len(x_shape) != microbatches.ndim - 1 or any(
+            b < m for b, m in zip(x_shape, microbatches.shape[1:])):
+        raise ValueError(
+            f"boundary_shape {x_shape} must have the microbatch rank and "
+            f"cover the microbatch shape {microbatches.shape[1:]}")
+    if tuple(x_shape) != microbatches.shape[1:]:
+        # pad-to-max once up front (XLA fuses the pad; the scan then
+        # carries the uniform boundary buffer)
+        pads = [(0, 0)] + [(0, b - m) for b, m in
+                           zip(x_shape, microbatches.shape[1:])]
+        microbatches = jnp.pad(microbatches.astype(dtype), pads)
+    else:
+        microbatches = microbatches.astype(dtype)
     zeros_x = jnp.zeros(x_shape, dtype)
 
     def tick(carry, t):

@@ -396,3 +396,77 @@ class TestSkipBubbles:
             assert mask == skip, (
                 f"{kind}: cond-skip diverged from masked bubbles "
                 f"({skip} vs {mask})")
+
+
+class TestVariableBoundary:
+    """SURVEY #56 (`decoder_seq_length` / `_communicate` shape
+    negotiation): heterogeneous stage shapes via the pad-to-max boundary.
+    Encoder-decoder toy — stage 0 consumes a 4-row microbatch padded into
+    an 8-row boundary; decoder stages mask to their 4-row extent by stage
+    index. Pipelined loss/grads must match the flat composition."""
+
+    def test_encdec_pad_to_max_matches_flat(self, devices):
+        from jax.sharding import PartitionSpec as Ps
+
+        P_, M_, S_in, S_b, mb = 4, 8, 4, 8, 2
+        mesh = make_mesh(pp=P_)
+        rng = np.random.default_rng(3)
+        params = jnp.asarray(rng.normal(size=(1, P_, D, D)) * 0.5,
+                             jnp.float32)
+        mbs = jnp.asarray(rng.normal(size=(M_, S_in, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, S_in, mb, D)), jnp.float32)
+        rows = jnp.arange(S_b)
+
+        def stage_at(w, x8, s):
+            enc = jnp.tanh(x8 @ w)                       # all 8 rows
+            dec = jnp.where((rows < S_in)[:, None, None],
+                            jnp.tanh(x8 @ w), 0.0)       # 4-row extent
+            return jnp.where(s == 0, enc, dec)
+
+        def pipe_loss(params, mbs):
+            def inner(params, mbs):
+                s = jax.lax.axis_index("pp")
+                outs = schedules.pipeline_apply(
+                    lambda w, x: stage_at(w, x, s),
+                    params[:, 0], mbs,
+                    boundary_shape=(S_b, mb, D))
+                return jnp.mean(jnp.square(outs[:, :S_in] - tgt))
+
+            return jax.shard_map(
+                inner, mesh=mesh, in_specs=(Ps(None, "pp"), Ps()),
+                out_specs=Ps(), check_vma=False)(params, mbs)
+
+        def flat_loss(params, mbs):
+            def one(x):
+                x8 = jnp.pad(x, ((0, S_b - S_in), (0, 0), (0, 0)))
+                for s in range(P_):
+                    x8 = stage_at(params[0, s], x8, s)
+                return x8
+            outs = jax.vmap(one)(mbs)
+            return jnp.mean(jnp.square(outs[:, :S_in] - tgt))
+
+        got, g_got = jax.value_and_grad(lambda p: pipe_loss(p, mbs))(params)
+        want, g_want = jax.value_and_grad(
+            lambda p: flat_loss(p, mbs))(params)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_boundary_shape_must_cover(self, devices):
+        mesh = make_mesh(pp=4)
+        from jax.sharding import PartitionSpec as Ps
+        params = jnp.zeros((1, 4, D, D))
+        mbs = jnp.zeros((4, 8, 2, D))
+
+        def run():
+            def inner(params, mbs):
+                return schedules.pipeline_apply(
+                    lambda w, x: x, params[:, 0], mbs,
+                    boundary_shape=(4, 2, D))  # narrower than microbatch
+            return jax.shard_map(inner, mesh=mesh,
+                                 in_specs=(Ps(None, "pp"), Ps()),
+                                 out_specs=Ps(), check_vma=False)(
+                params, mbs)
+
+        with pytest.raises(ValueError, match="cover"):
+            run()
