@@ -189,17 +189,21 @@ def _auto_blocks(Hp, block_t, block_v):
     bv = block_v if block_v is not None else min(
         512, max(16, cap_total - bt))
     if bt + bv > cap_total:
-        # only reachable via EXPLICIT block_t/block_v — auto sizing stays
-        # within cap_total. Warn (not clamp: the caller may know their
-        # generation better than the capability table) so a hardware OOM
-        # is attributable to the request, not to mis-sized defaults.
+        # only reachable when at least one block is EXPLICIT — auto
+        # sizing stays within cap_total. Warn (not clamp: the caller may
+        # know their generation better than the capability table) so a
+        # hardware OOM is attributable to the request, not to mis-sized
+        # defaults.
         import warnings
+        desc = " + ".join(
+            f"{name}={val} ({'requested' if req is not None else 'auto'})"
+            for name, val, req in (("block_t", bt, block_t),
+                                   ("block_v", bv, block_v)))
         warnings.warn(
-            f"linear_cross_entropy: requested blocks block_t={bt} + "
-            f"block_v={bv} exceed the measured VMEM headroom "
-            f"({cap_total} rows at Hp={Hp}) for this TPU generation — "
-            f"expect Mosaic VMEM OOM; drop the explicit blocks to use "
-            f"auto sizing", stacklevel=3)
+            f"linear_cross_entropy: {desc} exceed the measured VMEM "
+            f"headroom ({cap_total} rows at Hp={Hp}) for this TPU "
+            f"generation — expect Mosaic VMEM OOM; drop the explicit "
+            f"block(s) to use auto sizing", stacklevel=3)
     return bt, bv
 
 

@@ -111,7 +111,7 @@ def pipeline_apply(
     to ONE collective-permute whose rendezvous spans every device in the
     mesh, so ranks that skip a tick desynchronize the pairing across ticks
     and the data lands in the wrong tick (observed empirically; loss moves
-    by ~1e-3 rel on a pp2×cp2 ring-attention step). Group-scoped
+    by ~2e-3 rel on a pp2×cp2 ring-attention step). Group-scoped
     collectives (``psum``/``all_gather``/``reduce_scatter``/
     ``all_to_all``) rendezvous per replica-group and are verified safe
     (mask-vs-skip exact match on a pp2×cp2 mesh for each class). Pass
@@ -270,6 +270,7 @@ def pipeline_tied_apply(
     num_chunks: int = 1,
     axis_name: str = AXIS_PP,
     broadcast_outputs: bool = True,
+    **pipeline_kwargs,
 ):
     """Pipeline with a TIED input-embedding / LM-head weight — reference
     ``parallel_state.initialize_model_parallel``'s embedding group ({first,
@@ -303,7 +304,7 @@ def pipeline_tied_apply(
     h_mb = jax.vmap(lambda t: embed_fn(tied_params, t))(tokens_mb)
     outs = pipeline_apply(stage_fn, chunk_params, h_mb,
                           num_chunks=num_chunks, axis_name=axis_name,
-                          broadcast_outputs=False)
+                          broadcast_outputs=False, **pipeline_kwargs)
     z = head_fn(tied_params, outs)
     last = s == P - 1
     z = jax.tree_util.tree_map(lambda a: a * last.astype(a.dtype), z)
@@ -363,6 +364,7 @@ def pipelined_loss_fn(
     axis_name: str = AXIS_PP,
     params_spec=None,
     check_vma: bool = False,
+    **pipeline_kwargs,
 ):
     """Build ``f(chunk_params_stacked, microbatches, targets) -> loss`` that
     runs the pipeline under ``shard_map`` over ``mesh``; differentiate with
@@ -371,6 +373,9 @@ def pipelined_loss_fn(
     ``chunk_params_stacked`` leaves are (V, P, ...) — chunk-major, stage
     second — sharded on axis 1 over pp. ``loss_fn(outputs, targets) ->
     scalar`` runs replicated (outputs are broadcast from the last stage).
+    Extra keyword arguments (``skip_bubbles`` — REQUIRED False for
+    ppermute-bearing stages, ``remat_stage``, ``scan_unroll``,
+    ``boundary_shape``, ...) pass through to :func:`pipeline_apply`.
     """
     from jax.sharding import PartitionSpec as Ps
 
@@ -381,7 +386,8 @@ def pipelined_loss_fn(
         # drop the stage axis (size 1 locally)
         local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_params)
         outs = pipeline_apply(stage_fn, local, microbatches,
-                              num_chunks=num_chunks, axis_name=axis_name)
+                              num_chunks=num_chunks, axis_name=axis_name,
+                              **pipeline_kwargs)
         return loss_fn(outs, targets)
 
     smapped = jax.shard_map(

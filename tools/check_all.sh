@@ -1,11 +1,21 @@
 #!/bin/bash
-# Full pre-hardware validation: unit/parity suite on the virtual CPU
-# mesh, driver entry points, and AOT Mosaic/HBM checks for the real TPU
+# Pre-hardware validation: unit/parity suite on the virtual CPU mesh,
+# driver entry points, and AOT Mosaic/HBM checks for the real TPU
 # target. Exits non-zero on any failure.
+#
+# Default = the FAST gate: pytest -m "not slow" (<5 min warm) — the
+# check-everything habit should never cost half an hour. Pass --all to
+# run the composed-step/fuzz suites too (CI cadence / pre-commit on
+# pipeline/3D changes).
 set -e
 cd "$(dirname "$0")/.."
-echo "== pytest (8-device virtual CPU mesh) =="
-python -m pytest tests/ -q
+if [ "${1:-}" = "--all" ]; then
+  echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
+  python -m pytest tests/ -q
+else
+  echo "== pytest (8-device virtual CPU mesh, fast subset; --all for full) =="
+  python -m pytest tests/ -q -m "not slow"
+fi
 echo "== driver entry points =="
 python - <<'EOF'
 import os
