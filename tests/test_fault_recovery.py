@@ -22,6 +22,8 @@ from apex1_tpu.utils.debug import (assert_donation_safe,
                                    assert_same_program_across_processes,
                                    program_fingerprint)
 
+pytestmark = pytest.mark.slow  # composed-step suite: full run via check_all.sh --all
+
 
 class TestDebugTools:
     def test_fingerprint_stable_and_sensitive(self):

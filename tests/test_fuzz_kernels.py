@@ -15,6 +15,8 @@ from hypothesis import strategies as st  # noqa: E402
 from apex1_tpu.ops._common import force_impl
 from apex1_tpu.ops.attention import _xla_attention, flash_attention
 
+pytestmark = pytest.mark.slow  # composed-step / fuzz suite: full run via check_all.sh --all
+
 _SETTINGS = dict(max_examples=8, deadline=None,
                  suppress_health_check=list(HealthCheck))
 

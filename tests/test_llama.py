@@ -2,6 +2,7 @@
 the CPU mesh, ring-attention (context-parallel) equivalence, remat parity.
 (BASELINE configs 4/5 models at tiny sizes.)"""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -111,6 +112,7 @@ def test_context_parallel_matches_global(devices):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 class TestPackedBatches:
     """Varlen/packed batches (≙ reference fmha cu_seqlens): packing two
     documents into one row with segment_ids + per-segment positions must

@@ -18,6 +18,8 @@ from apex1_tpu.models.llama_3d import (Llama3DConfig, combine_grads,
                                        make_train_step)
 from apex1_tpu.ops import rope_tables, softmax_cross_entropy_loss
 
+pytestmark = pytest.mark.slow  # composed-step / fuzz suite: full run via check_all.sh --all
+
 DP, PP, TP = 2, 2, 2
 M, MB = 4, 2          # microbatches, global sequences per microbatch
 

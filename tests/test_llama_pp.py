@@ -5,6 +5,8 @@ embedding/head replicated. Parity vs the unpartitioned model, fwd + grads.
 (TP parity is covered in test_llama.py via GSPMD param_specs; the
 TP×PP×DP×SP composition compiles in __graft_entry__.dryrun_multichip.)"""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -14,6 +16,8 @@ from apex1_tpu.core.mesh import make_mesh
 from apex1_tpu.models.llama import Llama, LlamaBlock, LlamaConfig
 from apex1_tpu.ops import rope_tables, softmax_cross_entropy_loss
 from apex1_tpu.transformer.pipeline_parallel.schedules import pipeline_apply
+
+pytestmark = pytest.mark.slow  # composed-step / fuzz suite: full run via check_all.sh --all
 
 PP = 2
 LAYERS = 4

@@ -143,6 +143,7 @@ class TestASP:
     ("examples/llama_3d.py", ["--steps", "3", "--seq", "32",
                               "--hidden", "32", "--chunks", "2"]),
 ])
+@pytest.mark.slow
 def test_examples_smoke(script, args):
     """≙ reference examples/ as integration tests (SURVEY §4.1 L1)."""
     import os

@@ -2,6 +2,7 @@
 forward/loss/grad sanity, padding-mask semantics, SyncBN-in-model under a
 dp mesh (≙ examples/imagenet amp+DDP+SyncBN flow)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -95,6 +96,7 @@ class TestBert:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_forward_and_grads(self):
         cfg = ResNetConfig.tiny()
         model = ResNet(cfg)

@@ -205,6 +205,7 @@ class TestSyncBatchNorm:
 
 
 class TestDistributedFusedAdam:
+    @pytest.mark.slow
     def test_matches_unsharded_adam(self, fsdp_mesh, rng):
         params = {"w": jnp.asarray(rng.normal(size=(13, 5)), jnp.float32),
                   "b": jnp.asarray(rng.normal(size=(7,)), jnp.float32)}

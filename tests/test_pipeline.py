@@ -75,6 +75,7 @@ class TestPipelineForward:
             f(params, mbs, jnp.zeros_like(mbs))
 
 
+@pytest.mark.slow
 class TestPipelineBackward:
     @pytest.mark.parametrize("V,M", [(1, 4), (2, 4)])
     def test_grads_match_unpartitioned(self, mesh, rng, V, M):
@@ -113,6 +114,7 @@ class TestPipelineBackward:
         assert np.isfinite(float(l1)) and float(l1) == float(l2)
 
 
+@pytest.mark.slow
 class TestTiedEmbedding:
     """≙ the reference's embedding-group semantics: tied vocab embedding on
     first+last stages, grads combined by the embedding-group all-reduce,

@@ -2,6 +2,7 @@
 ``apex/transformer/testing``): distributed_mesh context, global args,
 standalone test models."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,6 +30,7 @@ def test_global_args_roundtrip():
     assert testing.get_args().seq_length == 32  # defaults restored
 
 
+@pytest.mark.slow
 def test_standalone_models_train_one_step(devices):
     for build in (testing.standalone_gpt, testing.standalone_bert):
         model, batch, params, loss_fn = build()

@@ -16,6 +16,8 @@ from apex1_tpu.ops.linear_xent import linear_cross_entropy
 from apex1_tpu.transformer.tensor_parallel import (
     vocab_parallel_linear_cross_entropy)
 
+pytestmark = pytest.mark.slow  # heavy kernel-parity suite: full run via check_all.sh --all
+
 TP = 4
 TOL = dict(rtol=3e-5, atol=3e-5)
 
