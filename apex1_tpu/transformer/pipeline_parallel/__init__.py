@@ -6,6 +6,7 @@ from apex1_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
+    one_f_one_b,
     pipeline_apply,
     pipeline_tied_apply,
     pipelined_loss_fn,

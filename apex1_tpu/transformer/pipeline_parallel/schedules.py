@@ -323,8 +323,218 @@ def allreduce_embedding_grads(tied_grads, axis_name: str = AXIS_PP):
 
 
 # ---------------------------------------------------------------------------
-# grad-accumulating no-pipelining schedule
+# true 1F1B: staggered forward/backward in ONE scan, VJP residual ring
 # ---------------------------------------------------------------------------
+
+def _x_dependent_mask(fn, *args, arg_index):
+    """Trace-time reachability: which flat outputs of ``fn(*args)`` depend
+    on ``args[arg_index]``? Conservative over sub-jaxprs (an equation with
+    any tainted input taints every output). Used to split VJP residuals
+    into activations (ring-buffered) vs parameter-only values (recomputed
+    for free at the backward tick — computing them needs no x)."""
+    from jax.extend.core import Literal
+
+    closed = jax.make_jaxpr(fn)(*args)
+    flat_per_arg = [len(jax.tree_util.tree_leaves(a)) for a in args]
+    lo = sum(flat_per_arg[:arg_index])
+    hi = lo + flat_per_arg[arg_index]
+    tainted = set(closed.jaxpr.invars[lo:hi])
+    for eqn in closed.jaxpr.eqns:
+        if any(not isinstance(v, Literal) and v in tainted
+               for v in eqn.invars):
+            tainted.update(eqn.outvars)
+    return [not isinstance(v, Literal) and v in tainted
+            for v in closed.jaxpr.outvars]
+
+
+def one_f_one_b(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    loss_mb: Callable,
+    *,
+    axis_name: str = AXIS_PP,
+    skip_idle: bool = True,
+    scan_unroll: int | bool = 1,
+):
+    """TRUE 1F1B (reference
+    ``forward_backward_pipelining_without_interleaving``): each stage
+    interleaves one microbatch's backward between forwards, so at most
+    ``P − s`` activation sets are ever live — the schedule's defining
+    memory property — WITHOUT the recompute that
+    ``pipeline_apply(remat_stage=True)`` + ``jax.grad`` pays.
+
+    Clocking (tick ``t`` of ``T = 2(M+P−1)``): stage ``s`` runs fwd of
+    microbatch ``m`` at ``t = 2m + s`` and bwd of ``m`` at
+    ``t = 2m + 2P−1−s``. Fwd and bwd ticks of one stage have opposite
+    parity (never collide); boundary activations ride a forward ring
+    ppermute one tick after production, cotangents a reverse ring one
+    tick after consumption — the compiled-SPMD form of the reference's
+    warmup/steady-1F1B/cooldown send-recv loop. Residual lifetime is
+    ``2P−1−2s`` ticks, so a depth-``P`` ring (slot ``m mod P``) suffices.
+
+    The ring stores ONLY the x-dependent VJP residual leaves (the
+    per-layer activations Megatron keeps between fwd and bwd);
+    parameter-only residuals (weights, their casts) are recomputed at
+    the bwd tick from a zeros-input VJP trace whose x-dependent half is
+    dead code — so ring memory is P × activations, not P × (activations
+    + params). Executed stage work with ``skip_idle``: exactly ``2M``
+    per stage (M fwd + M bwd) vs ``3M`` for the remat path (fwd +
+    recompute + bwd). The ``skip_bubbles`` collective contract
+    (ppermute-free stages) applies to ``skip_idle`` — for the stage AND
+    its transpose (psum/all_gather/reduce_scatter/all_to_all transpose
+    within the class; ppermute does not).
+
+    MUST be called inside ``shard_map`` over ``axis_name``. V=1 only —
+    the interleaved (V>1) schedule uses :func:`pipeline_apply` +
+    ``jax.grad``.
+
+    - ``stage_fn(stage_params, x) -> y`` — boundary in = boundary out
+      (shape/dtype), as in :func:`pipeline_apply`.
+    - ``loss_mb(y, m) -> scalar`` — microbatch ``m``'s loss, evaluated
+      on the LAST stage right after its forward; its grad seeds that
+      microbatch's backward (≙ the reference's ``loss_func`` +
+      ``backward_step`` seed). The objective is the SUM over
+      microbatches — fold any 1/M inside ``loss_mb``.
+
+    Returns ``(loss_sum, grads, dmicrobatches)``, per-rank PARTIALS:
+    ``loss_sum`` is real on the last stage (zeros elsewhere — psum over
+    pp for the value), ``grads`` (fp32, ``stage_params``-shaped) is this
+    stage's accumulated parameter gradient, and ``dmicrobatches``
+    (M, ...) is the per-microbatch input cotangent, real on stage 0 —
+    feed it to the embedding's VJP to finish the model backward.
+    """
+    P = jax.lax.axis_size(axis_name)
+    s = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = 2 * (M + P - 1)
+    x_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+    zeros_x = jnp.zeros(x_shape, dtype)
+    is_last = s == P - 1
+
+    def _vjp_leaves(p, x):
+        return jax.tree_util.tree_leaves(jax.vjp(stage_fn, p, x)[1])
+
+    # trace-time constants: residual treedef, leaf shapes, x-dependence
+    _, _vjp0 = jax.vjp(stage_fn, stage_params, zeros_x)  # arrays DCE'd
+    res_treedef = jax.tree_util.tree_structure(_vjp0)
+    res_sds = jax.eval_shape(_vjp_leaves, stage_params, zeros_x)
+    xdep = _x_dependent_mask(_vjp_leaves, stage_params, zeros_x,
+                             arg_index=1)
+    ring0 = [jnp.zeros((P,) + sd.shape, sd.dtype)
+             for sd, d in zip(res_sds, xdep) if d]
+
+    fwd_perm = [(i, (i + 1) % P) for i in range(P)]
+    bwd_perm = [(i, (i - 1) % P) for i in range(P)]
+
+    def tick(carry, t):
+        x_recv, dy_recv, ring, dy_ring, gacc, lacc, dmb = carry
+
+        # ---- forward subtick: fwd(m_f) at t = 2·m_f + s ----
+        u = t - s
+        m_f = jnp.clip(u // 2, 0, M - 1)
+        valid_f = (u >= 0) & (u % 2 == 0) & (u // 2 < M)
+        fresh = jax.lax.dynamic_index_in_dim(microbatches, m_f, axis=0,
+                                             keepdims=False)
+        x_in = jnp.where(s == 0, fresh, x_recv)
+
+        def run_fwd(x_in):
+            y, vjp_fn = jax.vjp(stage_fn, stage_params, x_in)
+            leaves = jax.tree_util.tree_leaves(vjp_fn)
+            dep = [lf for lf, d in zip(leaves, xdep) if d]
+            lm, dy_self = jax.value_and_grad(
+                lambda yy: loss_mb(yy, m_f).astype(jnp.float32))(y)
+            return y, dep, lm, dy_self.astype(dtype)
+
+        def zero_fwd(x_in):
+            return (zeros_x,
+                    [jnp.zeros(sd.shape, sd.dtype)
+                     for sd, d in zip(res_sds, xdep) if d],
+                    jnp.zeros([], jnp.float32), zeros_x)
+
+        if skip_idle:
+            y, dep, lm, dy_self = jax.lax.cond(valid_f, run_fwd,
+                                               zero_fwd, x_in)
+        else:
+            y, dep, lm, dy_self = run_fwd(x_in)
+            y = jnp.where(valid_f, y, zeros_x)
+
+        slot_f = jnp.mod(m_f, P)
+        ring = [jnp.where(valid_f,
+                          jax.lax.dynamic_update_index_in_dim(
+                              buf, lf, slot_f, axis=0),
+                          buf)
+                for buf, lf in zip(ring, dep)]
+        dy_ring = jnp.where(
+            valid_f & is_last,
+            jax.lax.dynamic_update_index_in_dim(dy_ring, dy_self, slot_f,
+                                                axis=0),
+            dy_ring)
+        lacc = lacc + jnp.where(valid_f & is_last, lm, 0.0)
+
+        # ---- backward subtick: bwd(m_b) at t = 2·m_b + 2P−1−s ----
+        v = t - (2 * P - 1 - s)
+        m_b = jnp.clip(v // 2, 0, M - 1)
+        valid_b = (v >= 0) & (v % 2 == 0) & (v // 2 < M)
+        slot_b = jnp.mod(m_b, P)
+        dy = jnp.where(is_last,
+                       jax.lax.dynamic_index_in_dim(dy_ring, slot_b,
+                                                    axis=0,
+                                                    keepdims=False),
+                       dy_recv)
+        stored = [jax.lax.dynamic_index_in_dim(buf, slot_b, axis=0,
+                                               keepdims=False)
+                  for buf in ring]
+
+        def run_bwd(ops):
+            dy_in, stored = ops
+            # parameter-only residuals are x-independent: recompute them
+            # from a zeros-x VJP (its x-dependent half is dead code),
+            # splice in the ring's activation leaves, rebuild the VJP
+            fresh_leaves = _vjp_leaves(stage_params, zeros_x)
+            it = iter(stored)
+            leaves = [next(it) if d else fl
+                      for fl, d in zip(fresh_leaves, xdep)]
+            vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
+            dp, dx = vjp_fn(dy_in)
+            return (jax.tree_util.tree_map(
+                        lambda g: g.astype(jnp.float32), dp),
+                    dx.astype(dtype))
+
+        def zero_bwd(ops):
+            return (jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                        stage_params),
+                    zeros_x)
+
+        if skip_idle:
+            dp, dx = jax.lax.cond(valid_b, run_bwd, zero_bwd,
+                                  (dy, stored))
+        else:
+            dp, dx = run_bwd((dy, stored))
+            dx = jnp.where(valid_b, dx, zeros_x)
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(valid_b, g, 0.0), gacc, dp)
+        dmb = jnp.where(valid_b & (s == 0),
+                        jax.lax.dynamic_update_index_in_dim(
+                            dmb, dx.astype(jnp.float32), m_b, axis=0),
+                        dmb)
+
+        y_send = jax.lax.ppermute(y, axis_name, fwd_perm)
+        dx_send = jax.lax.ppermute(dx, axis_name, bwd_perm)
+        return (y_send, dx_send, ring, dy_ring, gacc, lacc, dmb), None
+
+    init = (zeros_x, zeros_x, ring0,
+            jnp.zeros((P,) + x_shape, dtype),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                stage_params),
+            jnp.zeros([], jnp.float32),
+            jnp.zeros((M,) + x_shape, jnp.float32))
+    (_, _, _, _, grads, loss_sum, dmb), _ = jax.lax.scan(
+        tick, init, jnp.arange(T), unroll=scan_unroll)
+    return loss_sum, grads, dmb
 
 def forward_backward_no_pipelining(loss_fn, params, microbatches):
     """≙ ``fwd_bwd_no_pipelining``: sequential microbatches, one grad

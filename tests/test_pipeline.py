@@ -472,3 +472,112 @@ class TestVariableBoundary:
 
         with pytest.raises(ValueError, match="cover"):
             run()
+
+
+class Test1F1B:
+    """True 1F1B (`schedules.one_f_one_b`): staggered fwd/bwd in one scan
+    with the VJP-residual ring — loss, param grads, and microbatch-input
+    cotangents must match the flat composition, with and without the
+    idle-tick cond, and with group-scoped collectives in the stage."""
+
+    @staticmethod
+    def _run(mesh, P_, params, mbs, tgt, stage, skip):
+        from jax.sharding import PartitionSpec as Ps
+
+        M_ = mbs.shape[0]
+
+        def loss_mb(y, m):
+            t = jax.lax.dynamic_index_in_dim(tgt, m, 0, keepdims=False)
+            return jnp.mean(jnp.square(y - t)) / M_
+
+        def inner(params, mbs):
+            local = jax.tree_util.tree_map(lambda p: p[0], params)
+            loss, grads, dmb = schedules.one_f_one_b(
+                stage, local, mbs, loss_mb, skip_idle=skip)
+            return (jax.lax.psum(loss, "pp"),
+                    jax.tree_util.tree_map(lambda g: g[None], grads), dmb)
+
+        pspec = jax.tree_util.tree_map(lambda _: Ps("pp"), params)
+        extra = {ax: Ps() for ax in mesh.axis_names if ax != "pp"}
+        return jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=(pspec, Ps()),
+            out_specs=(Ps(), pspec, Ps()), check_vma=False))(params, mbs)
+
+    @pytest.mark.parametrize("skip", [True, False],
+                             ids=["cond-skip", "masked"])
+    def test_matches_flat(self, devices, skip):
+        mesh = make_mesh(pp=4)
+        P_, M_, mb = 4, 6, 3
+        rng = np.random.default_rng(5)
+        params = {
+            "w": jnp.asarray(rng.normal(size=(P_, D, D)) * 0.5,
+                             jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(P_, D)) * 0.1, jnp.float32)}
+        mbs = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+
+        def stage(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        loss, grads, dmb = self._run(mesh, P_, params, mbs, tgt, stage,
+                                     skip)
+
+        def flat(params, mbs):
+            def one(x, t):
+                for s in range(P_):
+                    x = stage(jax.tree_util.tree_map(lambda p: p[s],
+                                                     params), x)
+                return jnp.mean(jnp.square(x - t)) / M_
+            return jnp.sum(jax.vmap(one)(mbs, tgt))
+
+        want, (gp, gx) = jax.value_and_grad(flat, argnums=(0, 1))(
+            params, mbs)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(gp[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_collective_stage_matches_flat(self, devices):
+        """Stage contains an all_gather/psum_scatter pair over a second
+        mesh axis — its TRANSPOSE (reduce-scatter/all-gather) runs inside
+        the bwd cond; both directions must stay exact (the skip_bubbles
+        collective contract, applied to one_f_one_b's skip_idle)."""
+        mesh = make_mesh(pp=2, cp=2)
+        P_, M_, mb = 2, 4, 2
+        rng = np.random.default_rng(6)
+        params = {"w": jnp.asarray(rng.normal(size=(P_, D, D)) * 0.5,
+                                   jnp.float32)}
+        mbs = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+
+        def stage_sharded(p, x):
+            # SP-style: gather over cp, compute, mean back — replicated
+            # in/out so the flat gold is the plain average form
+            g = jax.lax.all_gather(x, "cp")            # (2, mb, D)
+            h = jnp.tanh((g[0] + g[1]) @ p["w"]) * 0.5
+            return x + jax.lax.pmean(h, "cp")
+
+        def stage_flat(p, x):
+            return x + jnp.tanh((x + x) @ p["w"]) * 0.5
+
+        loss, grads, dmb = self._run(mesh, P_, params, mbs, tgt,
+                                     stage_sharded, True)
+
+        def flat(params, mbs):
+            def one(x, t):
+                for s in range(P_):
+                    x = stage_flat({"w": params["w"][s]}, x)
+                return jnp.mean(jnp.square(x - t)) / M_
+            return jnp.sum(jax.vmap(one)(mbs, tgt))
+
+        want, (gp, gx) = jax.value_and_grad(flat, argnums=(0, 1))(
+            params, mbs)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(gp["w"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
+                                   rtol=1e-5, atol=1e-6)

@@ -117,6 +117,30 @@ def main():
           f"{tmp_remat/2**20:.1f} MiB "
           f"({tmp_pipe / max(tmp_remat, 1):.2f}x reduction)")
 
+    # --- true 1F1B: memory/FLOPs vs the grad-through-scan forms ---
+    from apex1_tpu.transformer.pipeline_parallel.schedules import (
+        one_f_one_b)
+
+    def fb_1f1b(params, mbs):
+        def loss_mb(y, m):
+            return jnp.mean(jnp.square(y)) / M
+
+        def inner(params, mbs):
+            loss, grads, dmb = one_f_one_b(stage, params[0, 0], mbs,
+                                           loss_mb)
+            return jax.lax.psum(loss, "pp"), grads[None, None], dmb
+
+        return jax.shard_map(inner, mesh=mesh,
+                             in_specs=(Ps(None, "pp"), Ps()),
+                             out_specs=(Ps(), Ps(None, "pp"), Ps()),
+                             check_vma=False)(params, mbs)
+
+    c = jax.jit(fb_1f1b).lower(params, mbs).compile()
+    mem = c.memory_analysis()
+    print(f"{'true 1F1B (one_f_one_b)':34s} flops      n/a   "
+          f"temp {mem.temp_size_in_bytes/2**20:8.1f} MiB   "
+          f"(ring: P x activations, no recompute)")
+
     # --- bubble-skip A/B: does the lax.cond actually elide the compute? ---
     import time
 
