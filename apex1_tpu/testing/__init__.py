@@ -75,6 +75,10 @@ def honor_jax_platforms_env() -> None:
 
     jax.config.update("jax_platforms", plat)
     want = [p.strip().lower() for p in plat.split(",") if p.strip()]
+    # The axon PJRT plugin is a tunnel to a real TPU: it registers under
+    # platform name 'axon' but its backend/devices report as 'tpu'.
+    if "axon" in want:
+        want.append("tpu")
     got = jax.default_backend()  # forces init under the requested config
     if got.lower() not in want:
         raise RuntimeError(

@@ -364,9 +364,13 @@ def main():
         # — only now, AFTER the subprocess probe succeeded and UNDER the
         # watchdog (the helper's verification initializes the in-process
         # backend, which blocks uninterruptibly on a dead tunnel)
-        from apex1_tpu.testing import honor_jax_platforms_env
+        from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                       honor_jax_platforms_env)
 
         honor_jax_platforms_env()
+        # compile-once economics: the measured loop is timed AFTER warmup,
+        # so a persistent cache only cuts re-run latency, never the number
+        enable_persistent_compilation_cache()
         on_accel = backend not in ("cpu",)
         kw = {}
         if args.config == "gpt2":

@@ -253,9 +253,13 @@ if __name__ == "__main__":
     ap.add_argument("--llama", action="store_true",
                     help="long-context llama shapes instead of GPT-2")
     args = ap.parse_args()
-    from apex1_tpu.testing import honor_jax_platforms_env
+    from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                   honor_jax_platforms_env)
 
     honor_jax_platforms_env()
+    # warmup absorbs compilation, so a warm cache never perturbs the timed
+    # numbers — it only makes a resumed sweep after a tunnel death cheap
+    enable_persistent_compilation_cache()
     print(f"backend={jax.default_backend()}", flush=True)
     if args.llama:
         attn_shape, xent = (1, 32, 16384, 64), (4096, 2048, 32000)

@@ -150,9 +150,14 @@ def _sweep(backend):
     import jax.numpy as jnp
 
     from apex1_tpu import ops
-    from apex1_tpu.testing import honor_jax_platforms_env
+    from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                   honor_jax_platforms_env)
 
     honor_jax_platforms_env()
+    # ~4 jit compiles per check x ~12 checks at 20-40s each over the
+    # tunnel: the first full sweep runs long, but a warm cache makes any
+    # re-run (or a sweep resumed after a dead-tunnel kill) near-free
+    enable_persistent_compilation_cache()
     rng = np.random.default_rng(0)
 
     def bf(*shape, scale=1.0):
