@@ -68,7 +68,7 @@ from apex1_tpu.models.llama import LlamaConfig
 from apex1_tpu.ops import apply_rotary_pos_emb, rms_norm, rope_tables
 from apex1_tpu.ops.attention import flash_attention
 from apex1_tpu.transformer.pipeline_parallel.schedules import (
-    allreduce_embedding_grads, pipeline_apply)
+    allreduce_embedding_grads, one_f_one_b, pipeline_apply)
 from apex1_tpu.transformer.tensor_parallel import mappings as mp
 from apex1_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_linear_cross_entropy)
@@ -89,9 +89,20 @@ class Llama3DConfig:
     num_microbatches: int = 4
     microbatch_size: int = 1          # sequences per (dp, ep) replica/mb
     learning_rate: float = 1e-4
+    # "scan": pipeline_apply + jax.grad (remat bounds activation memory);
+    # "1f1b": schedules.one_f_one_b — the reference 1F1B's staggered
+    # fwd/bwd with the VJP-residual ring (true bounded-activations
+    # schedule, 2M stage-works vs remat's 3M). V=1 only.
+    schedule: str = "scan"
 
     def __post_init__(self):
         m = self.model
+        if self.schedule not in ("scan", "1f1b"):
+            raise ValueError("schedule must be 'scan' or '1f1b'")
+        if self.schedule == "1f1b" and self.num_chunks > 1:
+            raise ValueError(
+                "schedule='1f1b' is V=1 only — the interleaved virtual "
+                "pipeline uses the scan schedule (see one_f_one_b docs)")
         if m.num_layers % (self.pp * self.num_chunks):
             raise ValueError("num_layers must divide by pp * num_chunks")
         if m.num_heads % self.tp or m.num_kv_heads % self.tp:
@@ -389,6 +400,20 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
     return stage
 
 
+def _embed_microbatches(cfg: Llama3DConfig, emb_w, tokens):
+    """(M, S, mb) tokens -> (M, S/(cp*tp), mb, E) boundary activations:
+    vocab-parallel embedding cast to the compute dtype, sequence-scattered
+    into the SP region. The ONE embedding-layout definition shared by the
+    scan and 1f1b paths (their parity depends on it staying identical)."""
+    dt = cfg.model.policy.compute_dtype
+
+    def one(tok_m):  # (S, mb) -> (S/tp, mb, E) seq shard
+        y = vocab_parallel_embedding(tok_m, emb_w.astype(dt))
+        return mp.scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
+
+    return jax.vmap(one)(tokens)
+
+
 def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
             cos, sin):
     """PARTIAL loss (sums to the global mean CE over the pp axis). Runs
@@ -400,11 +425,7 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
     dt = m.policy.compute_dtype
     stage = _stage_fn(cfg, cos, sin)
 
-    def embed(tok_m):  # (S, mb) -> (S/tp, mb, E) seq shard
-        y = vocab_parallel_embedding(tok_m, shared_local["emb"].astype(dt))
-        return mp.scatter_to_sequence_parallel_region(y, AXIS_TP, 0)
-
-    h_mb = jax.vmap(embed)(tokens)            # (M, S/(cp*tp), mb, E)
+    h_mb = _embed_microbatches(cfg, shared_local["emb"], tokens)
     local = jax.tree_util.tree_map(lambda p: p[:, 0], chunk_local)
     # bubble-skip contract (schedules.pipeline_apply): ring attention
     # rotates KV with ppermute, which must not sit inside the per-tick
@@ -452,6 +473,90 @@ def loss_fn(cfg: Llama3DConfig, chunk_local, shared_local, tokens, labels,
                     + jax.lax.stop_gradient(moe_aux) * (1.0 - inv))
         loss = loss + aux_term / tokens.shape[0]
     return loss
+
+
+def loss_and_grads_1f1b(cfg: Llama3DConfig, params, tokens, labels,
+                        cos, sin, scale_val):
+    """The flagship step's fwd+bwd on the TRUE 1F1B schedule
+    (`schedules.one_f_one_b`) — same objective, grads, and partial-loss
+    convention as ``jax.grad`` over :func:`loss_fn`, but with the
+    staggered-fwd/bwd residual ring instead of remat (bounded in-flight
+    activations at 2M stage-works per stage vs the scan path's 3M).
+    Runs inside shard_map; returns ``(grads, loss_part)`` with
+    ``grads`` SCALED by ``scale_val`` (unscale downstream, as the scan
+    path does) and ``loss_part`` the UNSCALED per-rank partial loss
+    (CE on the last stage + this rank's MoE aux share).
+
+    Post-process placement: final-norm + vocab-parallel fused CE run
+    per-microbatch inside ``loss_mb`` on the last stage (≙ the
+    reference's ``post_language_model_processing`` on the last rank),
+    with {final_norm, head} as the schedule's ``loss_params`` channel;
+    the embedding backward replays `vocab_parallel_embedding`'s VJP
+    from the schedule's ``dmicrobatches`` cotangents (real on stage 0).
+
+    MoE aux seed: the scan path seeds ``aux/tp`` per rank so the psum
+    transpose's replication (R = tp·dp·ep·cp seeds) collapses to the
+    CE-convention multiplicity `combine_grads` expects (tp). Here the
+    per-rank VJP runs the SAME psum transpose over the stats axes, so
+    the same ``scale/(tp·M)`` cotangent reproduces the scan path's
+    gradient exactly (parity-tested vs both the scan schedule and the
+    flat model)."""
+    m = cfg.model
+    tp = cfg.tp
+    dt = m.policy.compute_dtype
+    stage = _stage_fn(cfg, cos, sin)
+    M = tokens.shape[0]
+    chunk_local, shared_local = params["chunk"], params["shared"]
+
+    def embed_all(emb_w):
+        return _embed_microbatches(cfg, emb_w, tokens)
+
+    h_mb = embed_all(shared_local["emb"])
+    # (V=1, pp-local 1, L, ...) -> (L, ...): the stage's local layers
+    stage_local = jax.tree_util.tree_map(lambda p: p[0, 0], chunk_local)
+    lp = {"final_norm": shared_local["final_norm"],
+          "head": shared_local["head"]}
+
+    def loss_mb(lp_, y, mi):
+        o = rms_norm(y, lp_["final_norm"], eps=m.norm_eps).astype(dt)
+        S_loc, mb, E = o.shape
+        lbl_m = jax.lax.dynamic_index_in_dim(labels, mi, 0,
+                                             keepdims=False)
+        # local tokens seq-major; labels in the CE's gathered (tp-major)
+        # global order — the per-microbatch form of loss_fn's layout
+        ce = vocab_parallel_linear_cross_entropy(
+            o.reshape(-1, E), lp_["head"].astype(dt),
+            lbl_m.reshape(tp, S_loc, mb).reshape(-1),
+            sequence_parallel_input=True)
+        return scale_val * jnp.mean(ce) / M
+
+    skip = cfg.cp == 1                 # ring attention => mask, no cond
+    if cfg.moe:
+        loss_p, g_stage, dmb, dlp, aux_sum = one_f_one_b(
+            stage, stage_local, h_mb, loss_mb, loss_params=lp,
+            with_aux=True, aux_cotangent=scale_val / (tp * M),
+            skip_idle=skip)
+    else:
+        loss_p, g_stage, dmb, dlp = one_f_one_b(
+            stage, stage_local, h_mb, loss_mb, loss_params=lp,
+            skip_idle=skip)
+
+    # finish the model backward: embedding VJP from the boundary
+    # cotangents (real on stage 0; other pp groups contribute zeros and
+    # combine_grads' embedding-group psum completes them)
+    _, vjp_e = jax.vjp(embed_all, shared_local["emb"])
+    (demb,) = vjp_e(dmb.astype(h_mb.dtype))
+
+    grads = {
+        "chunk": jax.tree_util.tree_map(lambda g: g[None, None],
+                                        g_stage),
+        "shared": {"emb": demb, "head": dlp["head"],
+                   "final_norm": dlp["final_norm"]},
+    }
+    loss_part = loss_p / scale_val     # scale is a power of 2 — exact
+    if cfg.moe:
+        loss_part = loss_part + aux_sum / M
+    return grads, loss_part
 
 
 def combine_grads(g_chunk, g_shared, cfg: Llama3DConfig):
@@ -518,14 +623,22 @@ def build_step(cfg: Llama3DConfig, mesh):
     data_spec = P(None, AXIS_CP, (AXIS_DP, AXIS_EP))
 
     def train_step(state, tokens, labels):
-        def scalar(params):
-            loss = loss_fn(cfg, params["chunk"], params["shared"],
-                           tokens, labels, cos, sin)
-            if scaler is None:
-                return loss, loss
-            return scaler.scale(loss, state["scale"]), loss
+        if cfg.schedule == "1f1b":
+            scale_val = (jnp.float32(1.0) if scaler is None
+                         else state["scale"].scale)
+            grads, loss_part = loss_and_grads_1f1b(
+                cfg, state["params"], tokens, labels, cos, sin,
+                scale_val)
+        else:
+            def scalar(params):
+                loss = loss_fn(cfg, params["chunk"], params["shared"],
+                               tokens, labels, cos, sin)
+                if scaler is None:
+                    return loss, loss
+                return scaler.scale(loss, state["scale"]), loss
 
-        grads, loss_part = jax.grad(scalar, has_aux=True)(state["params"])
+            grads, loss_part = jax.grad(scalar, has_aux=True)(
+                state["params"])
         loss = jax.lax.psum(loss_part, AXIS_PP)
         loss = jax.lax.pmean(loss, (AXIS_DP, AXIS_EP, AXIS_CP))
         g_chunk, g_shared = combine_grads(grads["chunk"], grads["shared"],

@@ -356,6 +356,9 @@ def one_f_one_b(
     axis_name: str = AXIS_PP,
     skip_idle: bool = True,
     scan_unroll: int | bool = 1,
+    loss_params=None,
+    with_aux: bool = False,
+    aux_cotangent=None,
 ):
     """TRUE 1F1B (reference
     ``forward_backward_pipelining_without_interleaving``): each stage
@@ -397,12 +400,30 @@ def one_f_one_b(
       ``backward_step`` seed). The objective is the SUM over
       microbatches — fold any 1/M inside ``loss_mb``.
 
-    Returns ``(loss_sum, grads, dmicrobatches)``, per-rank PARTIALS:
-    ``loss_sum`` is real on the last stage (zeros elsewhere — psum over
-    pp for the value), ``grads`` (fp32, ``stage_params``-shaped) is this
-    stage's accumulated parameter gradient, and ``dmicrobatches``
-    (M, ...) is the per-microbatch input cotangent, real on stage 0 —
-    feed it to the embedding's VJP to finish the model backward.
+    ``loss_params`` (optional): a pytree of parameters the loss itself
+    uses (an LM head, a final norm — what the reference runs as the
+    last stage's ``post_process``). The signature becomes
+    ``loss_mb(loss_params, y, m)`` and the return gains
+    ``dloss_params`` — fp32 grads accumulated over the last stage's
+    forward ticks (zeros on other ranks; psum over pp combines, exactly
+    the embedding-group convention).
+
+    ``with_aux=True``: ``stage_fn`` returns ``(y, aux)`` with ``aux`` a
+    scalar side objective (MoE router balance). Each backward tick
+    seeds the stage VJP with cotangent ``(dy, aux_cotangent)`` — pass
+    the constant (traced scalars fine: fold the loss scale and any
+    replication correction in; see the llama_3d seed-multiplicity note)
+    — and the return gains ``aux_sum``: this rank's sum of aux VALUES
+    over its valid forward ticks (per-rank partial over pp, unscaled by
+    ``aux_cotangent``; weight it into the logged loss yourself).
+
+    Returns ``(loss_sum, grads, dmicrobatches[, dloss_params]
+    [, aux_sum])``, per-rank PARTIALS: ``loss_sum`` is real on the last
+    stage (zeros elsewhere — psum over pp for the value), ``grads``
+    (fp32, ``stage_params``-shaped) is this stage's accumulated
+    parameter gradient, and ``dmicrobatches`` (M, ...) is the
+    per-microbatch input cotangent, real on stage 0 — feed it to the
+    embedding's VJP to finish the model backward.
     """
     P = jax.lax.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
@@ -412,12 +433,35 @@ def one_f_one_b(
     dtype = microbatches.dtype
     zeros_x = jnp.zeros(x_shape, dtype)
     is_last = s == P - 1
+    zero_aux = jnp.zeros([], jnp.float32)
+    if with_aux and aux_cotangent is None:
+        raise ValueError(
+            "with_aux=True requires aux_cotangent — a zero default would "
+            "silently drop the aux objective from every gradient")
+    daux = (jnp.asarray(aux_cotangent, jnp.float32) if with_aux
+            else zero_aux)
+
+    def stage_pair(p, x):
+        # uniform (y, aux) shape so the VJP/residual machinery below is
+        # one code path; the dummy aux of a plain stage is a constant
+        # whose cotangent (daux = 0) contributes nothing
+        out = stage_fn(p, x)
+        y, aux = out if with_aux else (out, zero_aux)
+        return y, aux.astype(jnp.float32)
+
+    def _loss(lp, yy, m):
+        lm = (loss_mb(yy, m) if loss_params is None
+              else loss_mb(lp, yy, m))
+        return lm.astype(jnp.float32)
+
+    zeros_lp = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), loss_params)
 
     def _vjp_leaves(p, x):
-        return jax.tree_util.tree_leaves(jax.vjp(stage_fn, p, x)[1])
+        return jax.tree_util.tree_leaves(jax.vjp(stage_pair, p, x)[1])
 
     # trace-time constants: residual treedef, leaf shapes, x-dependence
-    _, _vjp0 = jax.vjp(stage_fn, stage_params, zeros_x)  # arrays DCE'd
+    _, _vjp0 = jax.vjp(stage_pair, stage_params, zeros_x)  # arrays DCE'd
     res_treedef = jax.tree_util.tree_structure(_vjp0)
     res_sds = jax.eval_shape(_vjp_leaves, stage_params, zeros_x)
     xdep = _x_dependent_mask(_vjp_leaves, stage_params, zeros_x,
@@ -429,7 +473,8 @@ def one_f_one_b(
     bwd_perm = [(i, (i - 1) % P) for i in range(P)]
 
     def tick(carry, t):
-        x_recv, dy_recv, ring, dy_ring, gacc, lacc, dmb = carry
+        (x_recv, dy_recv, ring, dy_ring, gacc, lacc, dmb, lpacc,
+         aux_acc) = carry
 
         # ---- forward subtick: fwd(m_f) at t = 2·m_f + s ----
         u = t - s
@@ -440,25 +485,31 @@ def one_f_one_b(
         x_in = jnp.where(s == 0, fresh, x_recv)
 
         def run_fwd(x_in):
-            y, vjp_fn = jax.vjp(stage_fn, stage_params, x_in)
+            (y, aux), vjp_fn = jax.vjp(stage_pair, stage_params, x_in)
             leaves = jax.tree_util.tree_leaves(vjp_fn)
             dep = [lf for lf, d in zip(leaves, xdep) if d]
-            lm, dy_self = jax.value_and_grad(
-                lambda yy: loss_mb(yy, m_f).astype(jnp.float32))(y)
-            return y, dep, lm, dy_self.astype(dtype)
+            lm, (dlp, dy_self) = jax.value_and_grad(
+                _loss, argnums=(0, 1))(loss_params, y, m_f)
+            dlp = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), dlp)
+            return y, aux, dep, lm, dy_self.astype(dtype), dlp
 
         def zero_fwd(x_in):
-            return (zeros_x,
+            return (zeros_x, zero_aux,
                     [jnp.zeros(sd.shape, sd.dtype)
                      for sd, d in zip(res_sds, xdep) if d],
-                    jnp.zeros([], jnp.float32), zeros_x)
+                    jnp.zeros([], jnp.float32), zeros_x, zeros_lp)
 
         if skip_idle:
-            y, dep, lm, dy_self = jax.lax.cond(valid_f, run_fwd,
-                                               zero_fwd, x_in)
+            y, aux, dep, lm, dy_self, dlp = jax.lax.cond(
+                valid_f, run_fwd, zero_fwd, x_in)
         else:
-            y, dep, lm, dy_self = run_fwd(x_in)
+            y, aux, dep, lm, dy_self, dlp = run_fwd(x_in)
             y = jnp.where(valid_f, y, zeros_x)
+        aux_acc = aux_acc + jnp.where(valid_f, aux, 0.0)
+        lp_ok = valid_f & is_last
+        lpacc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(lp_ok, g, 0.0), lpacc, dlp)
 
         slot_f = jnp.mod(m_f, P)
         ring = [jnp.where(valid_f,
@@ -497,7 +548,7 @@ def one_f_one_b(
             leaves = [next(it) if d else fl
                       for fl, d in zip(fresh_leaves, xdep)]
             vjp_fn = jax.tree_util.tree_unflatten(res_treedef, leaves)
-            dp, dx = vjp_fn(dy_in)
+            dp, dx = vjp_fn((dy_in, daux))
             return (jax.tree_util.tree_map(
                         lambda g: g.astype(jnp.float32), dp),
                     dx.astype(dtype))
@@ -523,7 +574,8 @@ def one_f_one_b(
 
         y_send = jax.lax.ppermute(y, axis_name, fwd_perm)
         dx_send = jax.lax.ppermute(dx, axis_name, bwd_perm)
-        return (y_send, dx_send, ring, dy_ring, gacc, lacc, dmb), None
+        return (y_send, dx_send, ring, dy_ring, gacc, lacc, dmb, lpacc,
+                aux_acc), None
 
     init = (zeros_x, zeros_x, ring0,
             jnp.zeros((P,) + x_shape, dtype),
@@ -531,10 +583,16 @@ def one_f_one_b(
                 lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
                 stage_params),
             jnp.zeros([], jnp.float32),
-            jnp.zeros((M,) + x_shape, jnp.float32))
-    (_, _, _, _, grads, loss_sum, dmb), _ = jax.lax.scan(
-        tick, init, jnp.arange(T), unroll=scan_unroll)
-    return loss_sum, grads, dmb
+            jnp.zeros((M,) + x_shape, jnp.float32),
+            zeros_lp, zero_aux)
+    (_, _, _, _, grads, loss_sum, dmb, dloss_params, aux_sum), _ = \
+        jax.lax.scan(tick, init, jnp.arange(T), unroll=scan_unroll)
+    out = (loss_sum, grads, dmb)
+    if loss_params is not None:
+        out = out + (dloss_params,)
+    if with_aux:
+        out = out + (aux_sum,)
+    return out
 
 def forward_backward_no_pipelining(loss_fn, params, microbatches):
     """≙ ``fwd_bwd_no_pipelining``: sequential microbatches, one grad

@@ -540,6 +540,74 @@ class Test1F1B:
         np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("skip", [True, False],
+                             ids=["cond-skip", "masked"])
+    def test_loss_params_and_aux_match_flat(self, devices, skip):
+        """The post-process channels: loss_params (an LM-head-style
+        parameter used only inside loss_mb, grads accumulated on the
+        last stage) and the aux side objective (stage returns (y, aux);
+        cotangent seeded per backward tick). Objective:
+        sum_m loss_mb + C_AUX * sum_{s,m} aux."""
+        from jax.sharding import PartitionSpec as Ps
+
+        mesh = make_mesh(pp=4)
+        P_, M_, mb = 4, 5, 2
+        C_AUX = 0.3
+        rng = np.random.default_rng(7)
+        params = {"w": jnp.asarray(rng.normal(size=(P_, D, D)) * 0.5,
+                                   jnp.float32)}
+        lp0 = {"v": jnp.asarray(rng.normal(size=(D, D)) * 0.5,
+                                jnp.float32)}
+        mbs = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+
+        def stage_aux(p, x):
+            y = jnp.tanh(x @ p["w"])
+            return y, jnp.mean(jnp.square(y)) * jnp.sum(p["w"][0, :2])
+
+        def loss_with_lp(lp, y, m):
+            t = jax.lax.dynamic_index_in_dim(tgt, m, 0, keepdims=False)
+            return jnp.mean(jnp.square(y @ lp["v"] - t)) / M_
+
+        def inner(params, lp, mbs):
+            local = jax.tree_util.tree_map(lambda p: p[0], params)
+            loss, grads, dmb, dlp, aux_sum = schedules.one_f_one_b(
+                stage_aux, local, mbs, loss_with_lp, skip_idle=skip,
+                loss_params=lp, with_aux=True, aux_cotangent=C_AUX)
+            total = jax.lax.psum(loss + C_AUX * aux_sum, "pp")
+            return (total,
+                    jax.tree_util.tree_map(lambda g: g[None], grads),
+                    dmb, jax.lax.psum(dlp["v"], "pp"))
+
+        pspec = jax.tree_util.tree_map(lambda _: Ps("pp"), params)
+        loss, grads, dmb, dv = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=(pspec, Ps(), Ps()),
+            out_specs=(Ps(), pspec, Ps(), Ps()), check_vma=False))(
+            params, lp0, mbs)
+
+        def flat(params, lp, mbs):
+            def one(x, t, m):
+                aux_tot = 0.0
+                for st in range(P_):
+                    x, a = stage_aux(
+                        jax.tree_util.tree_map(lambda p: p[st], params),
+                        x)
+                    aux_tot = aux_tot + a
+                return (jnp.mean(jnp.square(x @ lp["v"] - t)) / M_
+                        + C_AUX * aux_tot)
+            return jnp.sum(jax.vmap(one)(mbs, tgt, jnp.arange(M_)))
+
+        want, (gp, glp, gx) = jax.value_and_grad(
+            flat, argnums=(0, 1, 2))(params, lp0, mbs)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(gp["w"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(glp["v"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_collective_stage_matches_flat(self, devices):
         """Stage contains an all_gather/psum_scatter pair over a second
         mesh axis — its TRANSPOSE (reduce-scatter/all-gather) runs inside

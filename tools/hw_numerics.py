@@ -46,6 +46,13 @@ def probe(timeout_s=150.0):
 RESULTS = []
 
 
+class _Watchdog(BaseException):
+    """Deadline signal. Derives from BaseException so check()'s broad
+    ``except Exception`` (which must keep the sweep going on per-kernel
+    failures) can NOT swallow it — a swallowed watchdog would leave the
+    process sweeping past tpu_watch's `timeout`, wedging the queue."""
+
+
 def check(name, fn, pallas_args, gold_args=None, tol=2e-2, grad_tol=5e-2,
           grad_argnums=None, reduce_for_grad=None):
     """Compare fn under force_impl('pallas') vs force_impl('xla').
@@ -126,14 +133,19 @@ def main():
         return 1
 
     def _alarm(signum, frame):
-        raise TimeoutError("hw_numerics watchdog")
+        raise _Watchdog("hw_numerics watchdog")
 
     signal.signal(signal.SIGALRM, _alarm)
+    # tpu_watch's `timeout` SIGTERMs the whole process; route it into the
+    # same partial-summary path. (Neither handler can fire while blocked
+    # inside a native tunnel compile — the per-check flushed PASS/FAIL
+    # lines are the evidence that always survives.)
+    signal.signal(signal.SIGTERM, _alarm)
     signal.alarm(int(args.timeout))
     timed_out = False
     try:
         _sweep(backend)
-    except TimeoutError:
+    except _Watchdog:
         timed_out = True  # partial RESULTS still get summarized
     signal.alarm(0)
     n_fail = sum(not r["ok"] for r in RESULTS)
