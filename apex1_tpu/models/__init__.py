@@ -14,3 +14,5 @@ from apex1_tpu.models.llama import (  # noqa: F401
     Llama, LlamaConfig, llama_loss_fn)
 from apex1_tpu.models.resnet import (  # noqa: F401
     ResNet, ResNetConfig)
+from apex1_tpu.models.t5 import (  # noqa: F401
+    T5, T5Config, t5_loss_fn)
