@@ -16,3 +16,5 @@ from apex1_tpu.models.resnet import (  # noqa: F401
     ResNet, ResNetConfig)
 from apex1_tpu.models.t5 import (  # noqa: F401
     T5, T5Config, t5_loss_fn)
+from apex1_tpu.models.generate import (  # noqa: F401
+    generate, gpt2_decoder, llama_decoder)

@@ -1,0 +1,182 @@
+"""Autoregressive generation with a functional KV cache (beyond-reference:
+the reference accelerates training only; a complete framework needs the
+sampling loop its users run after fine-tuning).
+
+TPU-first design: the cache is an explicit pytree threaded through the
+model (no mutable state), so the whole decode loop is ONE ``lax.scan``
+inside ONE ``jit`` — token steps never return to the host, and the cache
+update is an in-place ``dynamic_update_slice`` XLA aliases into the donated
+carry. Prefill runs the normal flash-attention forward (filling the cache
+in one pass); each decode step attends over the static-shape cache with a
+position mask (S_max is static; no dynamic shapes on the MXU path).
+
+Supported: `models.gpt2.GPT2` and `models.llama.Llama` (GQA included) via
+``cache=``/``cache_index=`` on their ``__call__``; drive with
+:func:`generate` below.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.ops import NEG_INF
+from apex1_tpu.ops.attention import flash_attention
+
+
+def init_cache(num_layers: int, batch: int, num_kv_heads: int,
+               max_len: int, head_dim: int, dtype=jnp.bfloat16):
+    """Zeroed per-layer KV cache: {"layer{i}": {"k","v": (B, Hkv, S_max,
+    D)}}."""
+    one = lambda: {
+        "k": jnp.zeros((batch, num_kv_heads, max_len, head_dim), dtype),
+        "v": jnp.zeros((batch, num_kv_heads, max_len, head_dim), dtype),
+    }
+    return {f"layer{i}": one() for i in range(num_layers)}
+
+
+def cached_attention(q, k_new, v_new, cache, cache_index, *,
+                     sm_scale: Optional[float] = None):
+    """Attention through the KV cache. ``q``/``k_new``/``v_new``:
+    (B, H, S, D)/(B, Hkv, S, D) for the CURRENT tokens; ``cache`` holds
+    (B, Hkv, S_max, D); ``cache_index`` is the (traced) write position.
+
+    - Prefill (S > 1): must start from an empty cache at index 0 — runs
+      the normal causal flash kernel over the current tokens and writes
+      them into the cache.
+    - Decode (S == 1): composite matvec attention over the cache, masked
+      to positions ≤ cache_index (static S_max — no dynamic shapes).
+
+    Returns (attn (B, H, S, D), new_cache_entry).
+    """
+    B, Hq, S, D = q.shape
+    Hkv = k_new.shape[1]
+    idx = jnp.asarray(cache_index, jnp.int32)
+    k_all = jax.lax.dynamic_update_slice(
+        cache["k"], k_new.astype(cache["k"].dtype), (0, 0, idx, 0))
+    v_all = jax.lax.dynamic_update_slice(
+        cache["v"], v_new.astype(cache["v"].dtype), (0, 0, idx, 0))
+    new_entry = {"k": k_all, "v": v_all}
+    if S > 1:
+        attn = flash_attention(q, k_new, v_new, causal=True,
+                               sm_scale=sm_scale)
+        return attn, new_entry
+    scale = (D ** -0.5) if sm_scale is None else sm_scale
+    # GQA without materializing a repeated cache: group the q heads onto
+    # the kv-head axis and contract against the cache as-is (a repeated
+    # (B, Hq, S_max, D) copy would multiply the decode loop's memory
+    # traffic by the group factor)
+    group = Hq // Hkv
+    qg = q.reshape(B, Hkv, group, S, D)
+    scores = jnp.einsum("bhgsd,bhkd->bhgsk", qg, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    S_max = k_all.shape[2]
+    pos = jnp.arange(S_max)
+    scores = jnp.where(pos[None, None, None, None, :] <= idx, scores,
+                       NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    attn = jnp.einsum("bhgsk,bhkd->bhgsd", probs, v_all)
+    return attn.reshape(B, Hq, S, D), new_entry
+
+
+def sample_token(logits, rng, *, temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 vocab_size: Optional[int] = None):
+    """One sampling step from (B, V) logits. ``temperature == 0`` =
+    greedy argmax; otherwise softmax sampling, optionally truncated to the
+    ``top_k`` highest-probability tokens. ``vocab_size`` masks padded
+    vocab tail (GPT-2's padded_vocab)."""
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        mask = jnp.arange(logits.shape[-1]) < vocab_size
+        logits = jnp.where(mask, logits, NEG_INF)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, NEG_INF)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(apply_fn: Callable, params, prompt_tokens, *,
+             max_new_tokens: int, cache,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng=None, eos_id: Optional[int] = None, pad_id: int = 0,
+             vocab_size: Optional[int] = None):
+    """Prefill + single-dispatch decode loop.
+
+    ``apply_fn(params, tokens, cache, cache_index) -> (logits, cache)``
+    — the model's cached forward (see `models.gpt2`/`models.llama`
+    ``cache=`` support). ``cache`` must be sized >= prompt_len +
+    max_new_tokens. Returns (B, max_new_tokens) generated ids; sequences
+    that emit ``eos_id`` are padded with ``pad_id`` afterwards.
+
+    The decode loop is a ``lax.scan`` — jit the whole call (e.g.
+    ``jax.jit(functools.partial(generate, apply_fn, max_new_tokens=...,
+    ...))``) for one-dispatch generation.
+    """
+    B, S0 = prompt_tokens.shape
+    if rng is None:
+        rng = jax.random.key(0)
+    logits, cache = apply_fn(params, prompt_tokens, cache, 0)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_token(logits[:, -1], sub, temperature=temperature,
+                       top_k=top_k, vocab_size=vocab_size)
+    done = jnp.zeros((B,), bool) if eos_id is None else (nxt == eos_id)
+
+    def body(carry, _):
+        tok, idx, cache, rng, done = carry
+        logits, cache = apply_fn(params, tok[:, None], cache, idx)
+        rng, sub = jax.random.split(rng)
+        new = sample_token(logits[:, -1], sub, temperature=temperature,
+                           top_k=top_k, vocab_size=vocab_size)
+        new = jnp.where(done, pad_id, new)
+        if eos_id is not None:
+            done = done | (new == eos_id)
+        return (new, idx + 1, cache, rng, done), new
+
+    (_, _, _, _, _), rest = jax.lax.scan(
+        body, (nxt, jnp.asarray(S0, jnp.int32), cache, rng, done),
+        None, length=max_new_tokens - 1)
+    return jnp.concatenate([nxt[:, None], rest.T], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# model adapters
+# ---------------------------------------------------------------------------
+
+def _decoder(model, num_kv_heads: int, head_dim: int):
+    """Shared (apply_fn, make_cache) builder: both models take the same
+    ``positions``/``cache``/``cache_index`` kwargs, so the cached forward
+    is one code path and only the cache geometry differs."""
+    cfg = model.cfg
+
+    def apply_fn(params, tokens, cache, cache_index):
+        B, S = tokens.shape
+        positions = jnp.asarray(cache_index, jnp.int32) + jnp.arange(S)
+        logits, new_cache = model.apply(
+            {"params": params}, tokens,
+            positions=jnp.broadcast_to(positions[None], (B, S)),
+            cache=cache, cache_index=cache_index)
+        return logits, new_cache
+
+    def make_cache(batch: int, max_len: int, dtype=None):
+        return init_cache(cfg.num_layers, batch, num_kv_heads, max_len,
+                          head_dim, dtype or cfg.policy.compute_dtype)
+
+    return apply_fn, make_cache
+
+
+def gpt2_decoder(model):
+    """(apply_fn, make_cache) for `models.gpt2.GPT2`."""
+    cfg = model.cfg
+    return _decoder(model, cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+
+
+def llama_decoder(model):
+    """(apply_fn, make_cache) for `models.llama.Llama` (GQA-aware)."""
+    cfg = model.cfg
+    return _decoder(model, cfg.num_kv_heads, cfg.head_dim)

@@ -64,7 +64,8 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True, segment_ids=None):
+    def __call__(self, x, *, deterministic=True, segment_ids=None,
+                 cache=None, cache_index=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         h = cfg.hidden_size
@@ -90,7 +91,13 @@ class Block(nn.Module):
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        if cfg.use_flash:
+        new_cache = None
+        if cache is not None:
+            from apex1_tpu.models.generate import cached_attention
+            attn, new_cache = cached_attention(
+                q, k, v, cache, cache_index,
+                sm_scale=1.0 / math.sqrt(hd))
+        elif cfg.use_flash:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids,
                                    sm_scale=1.0 / math.sqrt(hd))
@@ -110,7 +117,8 @@ class Block(nn.Module):
         y = nn.Dense(cfg.mlp_ratio * h, dtype=dtype, name="fc_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(h, dtype=dtype, name="fc_out")(y)
-        return x + y
+        out = x + y
+        return out if new_cache is None else (out, new_cache)
 
 
 class GPT2(nn.Module):
@@ -120,10 +128,16 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, deterministic=True, return_hidden=False,
-                 segment_ids=None, positions=None):
+                 segment_ids=None, positions=None, cache=None,
+                 cache_index=None):
         """``segment_ids``/(B, S) ``positions`` enable packed batches
         (≙ fmha cu_seqlens varlen; see `runtime.pack_documents`) — tokens
-        attend within their segment, learned positions gather per row."""
+        attend within their segment, learned positions gather per row.
+
+        ``cache``/``cache_index`` enable KV-cached decoding (see
+        `models.generate`): the return becomes ``(logits, new_cache)``;
+        prefill (S>1) must start from an empty cache at index 0; don't
+        combine with ``segment_ids``."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -141,9 +155,16 @@ class GPT2(nn.Module):
             pos_emb = jnp.take(wpe, positions, axis=0, mode="fill",
                                fill_value=jnp.nan).astype(dtype)
         x = wte[tokens].astype(dtype) + pos_emb
+        new_cache = {}
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"h{i}")(x, deterministic=deterministic,
-                                         segment_ids=segment_ids)
+            out = Block(cfg, name=f"h{i}")(
+                x, deterministic=deterministic, segment_ids=segment_ids,
+                cache=None if cache is None else cache[f"layer{i}"],
+                cache_index=cache_index)
+            if cache is None:
+                x = out
+            else:
+                x, new_cache[f"layer{i}"] = out
         gamma = self.param("lnf_scale", nn.initializers.ones,
                            (cfg.hidden_size,), jnp.float32)
         beta = self.param("lnf_bias", nn.initializers.zeros,
@@ -158,7 +179,7 @@ class GPT2(nn.Module):
                             preferred_element_type=jnp.float32)
         # returned over padded_vocab — slice-free; consumers mask with
         # num_classes=cfg.vocab_size (the CE kernel does it in-lane)
-        return logits
+        return logits if cache is None else (logits, new_cache)
 
 
 # Megatron-style TP sharding as path-regex rules (see parallel/specs.py):

@@ -97,7 +97,8 @@ class LlamaBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, cos, sin, segment_ids=None):
+    def __call__(self, x, cos, sin, segment_ids=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         E, H, Hkv, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
@@ -121,7 +122,12 @@ class LlamaBlock(nn.Module):
         q = apply_rotary_pos_emb(q, cos, sin)
         k = apply_rotary_pos_emb(k, cos, sin)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        if self.seq_shard_axis is not None:
+        new_cache = None
+        if cache is not None:
+            from apex1_tpu.models.generate import cached_attention
+            attn, new_cache = cached_attention(q, k, v, cache,
+                                               cache_index)
+        elif self.seq_shard_axis is not None:
             if cfg.cp_impl == "ulysses":
                 attn = ulysses_attention(q, k, v, self.seq_shard_axis,
                                          causal=True,
@@ -150,7 +156,8 @@ class LlamaBlock(nn.Module):
                                else segment_ids >= 0))
             # surfaced via flax collections; llama_loss_fn adds it
             self.sow("losses", "moe_aux", aux)
-            return x + y.astype(x.dtype)
+            out = x + y.astype(x.dtype)
+            return out if new_cache is None else (out, new_cache)
         wg = self.param("w_gate", init, (E, cfg.ffn_size),
                         jnp.float32).astype(dtype)
         wu = self.param("w_up", init, (E, cfg.ffn_size),
@@ -158,7 +165,8 @@ class LlamaBlock(nn.Module):
         wd = self.param("w_down", init, (cfg.ffn_size, E),
                         jnp.float32).astype(dtype)
         y = (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
-        return x + y.astype(x.dtype)
+        out = x + y.astype(x.dtype)
+        return out if new_cache is None else (out, new_cache)
 
 
 class Llama(nn.Module):
@@ -169,11 +177,16 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
-                 return_hidden=False):
+                 return_hidden=False, cache=None, cache_index=None):
         """``segment_ids`` (B, S) enables PACKED batches (≙ the reference
         fmha's cu_seqlens varlen): tokens attend only within their own
         segment. Pass per-segment ``positions`` (B, S) so RoPE restarts
-        at each document (see `pack_documents`)."""
+        at each document (see `pack_documents`).
+
+        ``cache``/``cache_index`` enable KV-cached decoding (see
+        `models.generate`): the return becomes ``(logits, new_cache)``;
+        prefill (S>1) must start from an empty cache at index 0; don't
+        combine with ``segment_ids`` or ``seq_shard_axis``."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -197,13 +210,21 @@ class Llama(nn.Module):
             cos, sin = rope_tables(positions, cfg.head_dim,
                                    base=cfg.rope_base)
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(LlamaBlock, static_argnums=())
+        new_cache = {}
         for i in range(cfg.num_layers):
             use_moe = (cfg.moe_every > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
-            x = block(cfg, self.seq_shard_axis, use_moe,
-                      name=f"layer{i}")(x, cos, sin, segment_ids)
+            out = block(cfg, self.seq_shard_axis, use_moe,
+                        name=f"layer{i}")(
+                x, cos, sin, segment_ids,
+                cache=None if cache is None else cache[f"layer{i}"],
+                cache_index=cache_index)
+            if cache is None:
+                x = out
+            else:
+                x, new_cache[f"layer{i}"] = out
         g = self.param("norm", nn.initializers.ones, (cfg.hidden_size,),
                        jnp.float32)
         if not cfg.policy.keep_norms_fp32:
@@ -214,9 +235,10 @@ class Llama(nn.Module):
             return x.astype(dtype)
         head = self.param("output", nn.initializers.normal(0.02),
                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
-        return jnp.einsum("bsh,vh->bsv", x.astype(dtype),
-                          head.astype(dtype),
-                          preferred_element_type=jnp.float32)
+        logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),
+                            head.astype(dtype),
+                            preferred_element_type=jnp.float32)
+        return logits if cache is None else (logits, new_cache)
 
 
 # regex rules over flattened param paths -> PartitionSpec

@@ -356,19 +356,24 @@ def t5_loss_fn(model: T5, *, fuse_head: bool = True,
     def loss_fn(params, enc_tokens, dec_tokens, enc_pad_mask=None):
         bound = model.bind({"params": params})
         labels = dec_tokens[:, 1:]
+        # pad-row zeroing happens inside the CE kernels (padding_idx —
+        # zero loss AND grad in-lane); only the mean's denominator is
+        # computed here
         if fuse_head:
             h = bound(enc_tokens, dec_tokens[:, :-1],
                       enc_pad_mask=enc_pad_mask, return_hidden=True)
             w = bound.head_weight()
-            losses = linear_cross_entropy(h, w, labels)
+            losses = linear_cross_entropy(h, w, labels,
+                                          padding_idx=label_pad_id)
         else:
             logits = bound(enc_tokens, dec_tokens[:, :-1],
                            enc_pad_mask=enc_pad_mask)
             losses = softmax_cross_entropy_loss(
-                logits.astype(jnp.float32), labels)
+                logits.astype(jnp.float32), labels,
+                padding_idx=label_pad_id)
         if label_pad_id is None:
             return jnp.mean(losses)
-        keep = (labels != label_pad_id).astype(jnp.float32)
-        return jnp.sum(losses * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+        keep = jnp.sum((labels != label_pad_id).astype(jnp.float32))
+        return jnp.sum(losses) / jnp.maximum(keep, 1.0)
 
     return loss_fn

@@ -40,6 +40,7 @@ def run(steps, state, step_fn, tokens):
 
 
 class TestEndToEnd:
+    @pytest.mark.slow  # multi-step training loop; step math covered by parity tests
     def test_o0_trains(self):
         _, state, step, tokens = make_setup("O0")
         state, losses, m = run(8, state, step, tokens)

@@ -166,6 +166,7 @@ class TestExpertParallel:
 
 
 class TestLlamaMoE:
+    @pytest.mark.slow  # training loop; MoE math covered by parity tests
     def test_moe_llama_trains(self, rng):
         """Llama with every-2nd-block MoE: forward finite, aux loss joins
         the objective, grads reach router + experts + dense layers."""

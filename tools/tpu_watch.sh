@@ -10,9 +10,13 @@ cd /root/repo
 
 probe() {
   # a blocked init holds /tmp/libtpu_lockfile, which starves the AOT
-  # compile-only client — honor the pause flag and keep probes short
+  # compile-only client — honor the pause flag and keep probes short.
+  # -k 15: a probe stuck in uninterruptible axon init shrugs off the
+  # SIGTERM `timeout` sends, and `timeout` then waits forever — the
+  # watcher looked alive but never polled again (observed 06:03→06:12
+  # gap). SIGKILL after the grace period actually ends it.
   [ -e "$RES/pause" ] && return 1
-  timeout 150 python -c "
+  timeout -k 15 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
 print(float(jnp.sum((x @ x).astype(jnp.float32))))" >/dev/null 2>&1
@@ -51,8 +55,10 @@ run bench_bert_lg   1800 python bench.py --config bert_large
 run bench_llama16k  2400 python bench.py --config llama_longctx
 run bench_bert      1500 python bench.py --config bert
 run bench_resnet    1500 python bench.py --config resnet
+run bench_t5        1800 python bench.py --config t5
 run bench_gpt2_b24  1500 python bench.py --config gpt2 --batch 24
 run profile_gpt2    1500 python tools/profile_step.py --config gpt2 --top 40
+run cond_elision    900  python tools/cond_elision_probe.py
 run kern_all        4800 python tools/bench_kernels.py all
 run kern_all_llama  4800 python tools/bench_kernels.py all --llama
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
