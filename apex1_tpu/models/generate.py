@@ -11,8 +11,10 @@ in one pass); each decode step attends over the static-shape cache with a
 position mask (S_max is static; no dynamic shapes on the MXU path).
 
 Supported: `models.gpt2.GPT2` and `models.llama.Llama` (GQA included) via
-``cache=``/``cache_index=`` on their ``__call__``; drive with
-:func:`generate` below.
+``cache=``/``cache_index=`` on their ``__call__`` (drive with
+:func:`generate` below), and `models.t5.T5` seq2seq via
+:func:`t5_generate` (encode once; cached decoder self-attention with the
+rel-pos bias row at the current index).
 """
 
 from __future__ import annotations
@@ -38,16 +40,21 @@ def init_cache(num_layers: int, batch: int, num_kv_heads: int,
 
 
 def cached_attention(q, k_new, v_new, cache, cache_index, *,
-                     sm_scale: Optional[float] = None):
+                     sm_scale: Optional[float] = None, bias=None):
     """Attention through the KV cache. ``q``/``k_new``/``v_new``:
     (B, H, S, D)/(B, Hkv, S, D) for the CURRENT tokens; ``cache`` holds
     (B, Hkv, S_max, D); ``cache_index`` is the (traced) write position.
 
     - Prefill (S > 1): must start from an empty cache at index 0 — runs
-      the normal causal flash kernel over the current tokens and writes
-      them into the cache.
+      the normal causal flash kernel over the current tokens (or, with
+      ``bias``, the bias-bearing composite — T5's rel-pos path) and
+      writes them into the cache.
     - Decode (S == 1): composite matvec attention over the cache, masked
       to positions ≤ cache_index (static S_max — no dynamic shapes).
+
+    ``bias``: additive logit bias. For prefill, shaped over the CURRENT
+    tokens (1, H, S, S) with the causal mask already folded in; for
+    decode, the query row vs all cache slots (1, H, 1, S_max).
 
     Returns (attn (B, H, S, D), new_cache_entry).
     """
@@ -60,8 +67,18 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
         cache["v"], v_new.astype(cache["v"].dtype), (0, 0, idx, 0))
     new_entry = {"k": k_all, "v": v_all}
     if S > 1:
-        attn = flash_attention(q, k_new, v_new, causal=True,
-                               sm_scale=sm_scale)
+        if bias is None:
+            attn = flash_attention(q, k_new, v_new, causal=True,
+                                   sm_scale=sm_scale)
+        else:
+            from apex1_tpu.ops import scaled_masked_softmax
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_new,
+                                preferred_element_type=jnp.float32)
+            scale = (D ** -0.5) if sm_scale is None else sm_scale
+            probs = scaled_masked_softmax(
+                scores, bias.astype(jnp.float32), scale=scale)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
+                              v_new)
         return attn, new_entry
     scale = (D ** -0.5) if sm_scale is None else sm_scale
     # GQA without materializing a repeated cache: group the q heads onto
@@ -72,11 +89,16 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     qg = q.reshape(B, Hkv, group, S, D)
     scores = jnp.einsum("bhgsd,bhkd->bhgsk", qg, k_all,
                         preferred_element_type=jnp.float32) * scale
+    if bias is None:
+        scores_b = scores
+    else:
+        scores_b = scores + bias.astype(jnp.float32).reshape(
+            bias.shape[0], Hkv, group, S, -1)
     S_max = k_all.shape[2]
     pos = jnp.arange(S_max)
-    scores = jnp.where(pos[None, None, None, None, :] <= idx, scores,
-                       NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    scores_b = jnp.where(pos[None, None, None, None, :] <= idx, scores_b,
+                         NEG_INF)
+    probs = jax.nn.softmax(scores_b, axis=-1).astype(q.dtype)
     attn = jnp.einsum("bhgsk,bhkd->bhgsd", probs, v_all)
     return attn.reshape(B, Hq, S, D), new_entry
 
@@ -174,6 +196,41 @@ def gpt2_decoder(model):
     """(apply_fn, make_cache) for `models.gpt2.GPT2`."""
     cfg = model.cfg
     return _decoder(model, cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+
+
+def t5_generate(model, params, enc_tokens, *, max_new_tokens: int,
+                dec_start_id: int = 0, enc_pad_mask=None,
+                temperature: float = 0.0, top_k: Optional[int] = None,
+                rng=None, eos_id: Optional[int] = None, pad_id: int = 0):
+    """Seq2seq generation for `models.t5.T5`: encode once, then KV-cached
+    decoder sampling seeded with ``dec_start_id`` (T5's decoder start =
+    the pad token, id 0). Returns (B, max_new_tokens) ids. Decoder
+    self-attention is cached; cross-attention recomputes K/V from the
+    fixed memory each step (caching them per layer is a further
+    optimization the adapter keeps out of the model)."""
+    cfg = model.cfg
+    bound = model.bind({"params": params})
+    memory = bound.encode(enc_tokens, enc_pad_mask)
+    B = enc_tokens.shape[0]
+
+    def apply_fn(params, tokens, cache, cache_index):
+        return model.apply(
+            {"params": params}, tokens, memory,
+            enc_pad_mask=enc_pad_mask, cache=cache,
+            cache_index=cache_index, method=model.decode)
+
+    # 1 (start token) + max_new_tokens slots — generate() writes at
+    # indices 0..prompt_len+max_new-2, but sizing to the documented
+    # prompt_len + max_new_tokens contract keeps a slot of slack rather
+    # than relying on the final token never being written back
+    cache = init_cache(cfg.num_decoder_layers, B, cfg.num_heads,
+                       1 + max_new_tokens, cfg.head_dim,
+                       cfg.policy.compute_dtype)
+    prompt = jnp.full((B, 1), dec_start_id, jnp.int32)
+    return generate(apply_fn, params, prompt,
+                    max_new_tokens=max_new_tokens, cache=cache,
+                    temperature=temperature, top_k=top_k, rng=rng,
+                    eos_id=eos_id, pad_id=pad_id)
 
 
 def llama_decoder(model):

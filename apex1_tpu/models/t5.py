@@ -121,13 +121,18 @@ class RelPosBias(nn.Module):
     bidirectional: bool
 
     @nn.compact
-    def __call__(self, q_len: int, k_len: int):
+    def __call__(self, q_len: int, k_len: int, q_positions=None):
+        """``q_positions``: optional traced (q_len,) global query
+        positions — the KV-cached decode path asks for one bias row at
+        the current cache index."""
         cfg = self.cfg
         table = self.param("rel_bias",
                            nn.initializers.normal(0.02),
                            (cfg.rel_pos_buckets, cfg.num_heads),
                            jnp.float32)
-        qpos = jnp.arange(q_len)[:, None]
+        if q_positions is None:
+            q_positions = jnp.arange(q_len)
+        qpos = q_positions[:, None]
         kpos = jnp.arange(k_len)[None, :]
         bucket = relative_position_bucket(
             kpos - qpos, bidirectional=self.bidirectional,
@@ -157,7 +162,7 @@ class T5Attention(nn.Module):
     cfg: T5Config
 
     @nn.compact
-    def __call__(self, x, kv, bias=None):
+    def __call__(self, x, kv, bias=None, cache=None, cache_index=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         H, D = cfg.num_heads, cfg.head_dim
@@ -177,7 +182,12 @@ class T5Attention(nn.Module):
         q = (x @ wq).reshape(B, Sq, H, D).transpose(0, 2, 1, 3)
         k = (kv @ wk).reshape(B, Sk, H, D).transpose(0, 2, 1, 3)
         v = (kv @ wv).reshape(B, Sk, H, D).transpose(0, 2, 1, 3)
-        if bias is None:
+        new_cache = None
+        if cache is not None:
+            from apex1_tpu.models.generate import cached_attention
+            attn, new_cache = cached_attention(
+                q, k, v, cache, cache_index, sm_scale=1.0, bias=bias)
+        elif bias is None:
             attn = flash_attention(q, k, v, causal=False, sm_scale=1.0)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -186,7 +196,8 @@ class T5Attention(nn.Module):
                 scores, bias.astype(jnp.float32), scale=1.0)
             attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Sq, H * D)
-        return attn @ wo
+        out = attn @ wo
+        return out if new_cache is None else (out, new_cache)
 
 
 class T5FFN(nn.Module):
@@ -217,7 +228,8 @@ class T5Block(nn.Module):
     is_decoder: bool
 
     @nn.compact
-    def __call__(self, x, bias, memory=None, mem_bias=None):
+    def __call__(self, x, bias, memory=None, mem_bias=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
 
@@ -229,7 +241,11 @@ class T5Block(nn.Module):
             return rms_norm(z, g, eps=cfg.norm_eps).astype(dtype)
 
         h = T5Attention(cfg, name="self_attn")(norm("self_norm", x), None,
-                                               bias=bias)
+                                               bias=bias, cache=cache,
+                                               cache_index=cache_index)
+        new_cache = None
+        if cache is not None:
+            h, new_cache = h
         x = x + h.astype(x.dtype)
         if self.is_decoder:
             h = T5Attention(cfg, name="cross_attn")(
@@ -237,7 +253,8 @@ class T5Block(nn.Module):
                 memory.astype(dtype), bias=mem_bias)
             x = x + h.astype(x.dtype)
         h = T5FFN(cfg, name="ffn")(norm("ffn_norm", x))
-        return x + h.astype(x.dtype)
+        out = x + h.astype(x.dtype)
+        return out if new_cache is None else (out, new_cache)
 
 
 class T5Stack(nn.Module):
@@ -245,13 +262,24 @@ class T5Stack(nn.Module):
     is_decoder: bool
 
     @nn.compact
-    def __call__(self, x, memory=None, enc_pad_mask=None):
+    def __call__(self, x, memory=None, enc_pad_mask=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         S = x.shape[1]
-        bias = RelPosBias(cfg, bidirectional=not self.is_decoder,
-                          name="rel_pos")(S, S)
+        rel_pos = RelPosBias(cfg, bidirectional=not self.is_decoder,
+                             name="rel_pos")
+        if cache is not None and S == 1:
+            # decode: one bias row at the current position vs all cache
+            # slots (cached_attention masks slots > cache_index)
+            S_max = cache["layer0"]["k"].shape[2]
+            bias = rel_pos(1, S_max,
+                           q_positions=jnp.asarray([cache_index],
+                                                   jnp.int32))
+        else:
+            bias = rel_pos(S, S)
+            if self.is_decoder:
+                bias = bias + _causal_mask(S, S)
         if self.is_decoder:
-            bias = bias + _causal_mask(S, S)
             mem_bias = (None if enc_pad_mask is None
                         else _pad_bias(enc_pad_mask))
         else:
@@ -261,16 +289,24 @@ class T5Stack(nn.Module):
         n_layers = (cfg.num_decoder_layers if self.is_decoder
                     else cfg.num_encoder_layers)
         block = T5Block
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(T5Block, static_argnums=())
+        new_cache = {}
         for i in range(n_layers):
-            x = block(cfg, self.is_decoder, name=f"layer{i}")(
-                x, bias, memory, mem_bias)
+            out = block(cfg, self.is_decoder, name=f"layer{i}")(
+                x, bias, memory, mem_bias,
+                cache=None if cache is None else cache[f"layer{i}"],
+                cache_index=cache_index)
+            if cache is None:
+                x = out
+            else:
+                x, new_cache[f"layer{i}"] = out
         g = self.param("final_norm", nn.initializers.ones,
                        (cfg.d_model,), jnp.float32)
         if not cfg.policy.keep_norms_fp32:
             g = g.astype(cfg.policy.compute_dtype)
-        return rms_norm(x, g, eps=cfg.norm_eps)
+        out = rms_norm(x, g, eps=cfg.norm_eps)
+        return out if cache is None else (out, new_cache)
 
 
 class T5(nn.Module):
@@ -300,16 +336,25 @@ class T5(nn.Module):
         return self.encoder(x, enc_pad_mask=enc_pad_mask)
 
     def decode(self, dec_tokens, memory, enc_pad_mask=None,
-               return_hidden=False):
+               return_hidden=False, cache=None, cache_index=None):
+        """``cache``/``cache_index`` enable KV-cached decoding of the
+        self-attention (see `models.generate.t5_generate`; cross-attention
+        recomputes its K/V from the fixed memory each step). The return
+        becomes ``(logits, new_cache)``."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         y = self.shared[dec_tokens].astype(dtype)
-        h = self.decoder(y, memory=memory, enc_pad_mask=enc_pad_mask)
+        h = self.decoder(y, memory=memory, enc_pad_mask=enc_pad_mask,
+                         cache=cache, cache_index=cache_index)
+        new_cache = None
+        if cache is not None:
+            h, new_cache = h
         h = h.astype(dtype)
         if return_hidden:
-            return h
-        return jnp.einsum("bsh,vh->bsv", h, self.head_weight(),
-                          preferred_element_type=jnp.float32)
+            return h if cache is None else (h, new_cache)
+        logits = jnp.einsum("bsh,vh->bsv", h, self.head_weight(),
+                            preferred_element_type=jnp.float32)
+        return logits if cache is None else (logits, new_cache)
 
     def head_weight(self):
         """(vocab, d_model) LM-head weight in compute dtype — tied form
