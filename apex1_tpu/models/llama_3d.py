@@ -92,7 +92,9 @@ class Llama3DConfig:
     # "scan": pipeline_apply + jax.grad (remat bounds activation memory);
     # "1f1b": schedules.one_f_one_b — the reference 1F1B's staggered
     # fwd/bwd with the VJP-residual ring (true bounded-activations
-    # schedule, 2M stage-works vs remat's 3M). V=1 only.
+    # schedule, 2VM stage-works vs remat's 3VM); with num_chunks > 1 it
+    # runs the group-cycled interleaved schedule (requires
+    # num_microbatches % pp == 0).
     schedule: str = "scan"
 
     def __post_init__(self):
@@ -100,9 +102,14 @@ class Llama3DConfig:
         if self.schedule not in ("scan", "1f1b"):
             raise ValueError("schedule must be 'scan' or '1f1b'")
         if self.schedule == "1f1b" and self.num_chunks > 1:
-            raise ValueError(
-                "schedule='1f1b' is V=1 only — the interleaved virtual "
-                "pipeline uses the scan schedule (see one_f_one_b docs)")
+            if self.num_microbatches % self.pp:
+                raise ValueError(
+                    "interleaved 1F1B requires num_microbatches % pp == "
+                    "0 (the group-cycled chunk schedule; ≙ the "
+                    "reference's microbatches % pp assertion)")
+            if self.pp < 2:
+                raise ValueError(
+                    "interleaved 1F1B needs pipeline size >= 2")
         if m.num_layers % (self.pp * self.num_chunks):
             raise ValueError("num_layers must divide by pp * num_chunks")
         if m.num_heads % self.tp or m.num_kv_heads % self.tp:
@@ -512,8 +519,12 @@ def loss_and_grads_1f1b(cfg: Llama3DConfig, params, tokens, labels,
         return _embed_microbatches(cfg, emb_w, tokens)
 
     h_mb = embed_all(shared_local["emb"])
-    # (V=1, pp-local 1, L, ...) -> (L, ...): the stage's local layers
-    stage_local = jax.tree_util.tree_map(lambda p: p[0, 0], chunk_local)
+    VC = cfg.num_chunks
+    # (V, pp-local 1, L, ...) -> (V, L, ...) chunk-major local layers
+    # (one_f_one_b takes the V axis itself for the interleaved
+    # schedule; V=1 squeezes below)
+    stage_local = jax.tree_util.tree_map(
+        lambda p: p[0, 0] if VC == 1 else p[:, 0], chunk_local)
     lp = {"final_norm": shared_local["final_norm"],
           "head": shared_local["head"]}
 
@@ -534,12 +545,12 @@ def loss_and_grads_1f1b(cfg: Llama3DConfig, params, tokens, labels,
     if cfg.moe:
         loss_p, g_stage, dmb, dlp, aux_sum = one_f_one_b(
             stage, stage_local, h_mb, loss_mb, loss_params=lp,
-            with_aux=True, aux_cotangent=scale_val / (tp * M),
-            skip_idle=skip)
+            num_chunks=VC, with_aux=True,
+            aux_cotangent=scale_val / (tp * M), skip_idle=skip)
     else:
         loss_p, g_stage, dmb, dlp = one_f_one_b(
             stage, stage_local, h_mb, loss_mb, loss_params=lp,
-            skip_idle=skip)
+            num_chunks=VC, skip_idle=skip)
 
     # finish the model backward: embedding VJP from the boundary
     # cotangents (real on stage 0; other pp groups contribute zeros and
@@ -548,8 +559,8 @@ def loss_and_grads_1f1b(cfg: Llama3DConfig, params, tokens, labels,
     (demb,) = vjp_e(dmb.astype(h_mb.dtype))
 
     grads = {
-        "chunk": jax.tree_util.tree_map(lambda g: g[None, None],
-                                        g_stage),
+        "chunk": jax.tree_util.tree_map(
+            lambda g: g[None, None] if VC == 1 else g[:, None], g_stage),
         "shared": {"emb": demb, "head": dlp["head"],
                    "final_norm": dlp["final_norm"]},
     }

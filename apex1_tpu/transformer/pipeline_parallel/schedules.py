@@ -354,6 +354,7 @@ def one_f_one_b(
     loss_mb: Callable,
     *,
     axis_name: str = AXIS_PP,
+    num_chunks: int = 1,
     skip_idle: bool = True,
     scan_unroll: int | bool = 1,
     loss_params=None,
@@ -361,14 +362,16 @@ def one_f_one_b(
     aux_cotangent=None,
 ):
     """TRUE 1F1B (reference
-    ``forward_backward_pipelining_without_interleaving``): each stage
-    interleaves one microbatch's backward between forwards, so at most
-    ``P − s`` activation sets are ever live — the schedule's defining
-    memory property — WITHOUT the recompute that
+    ``forward_backward_pipelining_without_interleaving`` and, with
+    ``num_chunks`` V>1, ``..._with_interleaving``): each stage
+    interleaves one microbatch's backward between forwards, so the live
+    activation count is bounded by the schedule (O(P) for V=1, O(V·P)
+    interleaved) independent of M — the schedule's defining memory
+    property — WITHOUT the recompute that
     ``pipeline_apply(remat_stage=True)`` + ``jax.grad`` pays.
 
-    Clocking (tick ``t`` of ``T = 2(M+P−1)``): stage ``s`` runs fwd of
-    microbatch ``m`` at ``t = 2m + s`` and bwd of ``m`` at
+    Clocking, V=1 (tick ``t`` of ``T = 2(M+P−1)``): stage ``s`` runs fwd
+    of microbatch ``m`` at ``t = 2m + s`` and bwd of ``m`` at
     ``t = 2m + 2P−1−s``. Fwd and bwd ticks of one stage have opposite
     parity (never collide); boundary activations ride a forward ring
     ppermute one tick after production, cotangents a reverse ring one
@@ -376,28 +379,47 @@ def one_f_one_b(
     warmup/steady-1F1B/cooldown send-recv loop. Residual lifetime is
     ``2P−1−2s`` ticks, so a depth-``P`` ring (slot ``m mod P``) suffices.
 
+    Clocking, V>1 (Megatron's interleaved order: groups of P
+    microbatches cycle through all V chunks before the next group —
+    requires ``M % P == 0``, the reference's ``microbatches % pp == 0``
+    assertion, and P ≥ 2): with ``m = g·P + r``, stage ``s`` runs fwd of
+    (g, v, r) at ``t = 2(g·V·P + v·P + r) + s`` and bwd at
+    ``t = D + 2(g·V·P + (V−1−v)·P + r) + (2P−1−s)`` with fill delay
+    ``D = (V−1)·2P`` (even → the fwd/bwd parity split is preserved; at
+    V=1 every formula reduces to the non-interleaved clocking). Chunk
+    hand-off recirculates through depth-P FIFOs on both rings: stage
+    P−1's chunk-v output arrives at stage 0 P ticks before chunk v+1
+    consumes it, and stage 0's chunk-(v+1) cotangent arrives at stage
+    P−1 P ticks before chunk v's backward seeds from it. In steady
+    state every stage does useful work every tick (all even slots fwd,
+    all odd slots bwd — zero idle), total ticks
+    ``T = D + 2·V·M + 2P − 2``.
+
     The ring stores ONLY the x-dependent VJP residual leaves (the
     per-layer activations Megatron keeps between fwd and bwd);
     parameter-only residuals (weights, their casts) are recomputed at
     the bwd tick from a zeros-input VJP trace whose x-dependent half is
-    dead code — so ring memory is P × activations, not P × (activations
-    + params). Executed stage work with ``skip_idle``: exactly ``2M``
-    per stage (M fwd + M bwd) vs ``3M`` for the remat path (fwd +
-    recompute + bwd). The ``skip_bubbles`` collective contract
-    (ppermute-free stages) applies to ``skip_idle`` — for the stage AND
-    its transpose (psum/all_gather/reduce_scatter/all_to_all transpose
-    within the class; ppermute does not).
+    dead code. Ring capacity is sized from the worst-case residual
+    lifetime — ``G_live`` groups of V·P slots where ``G_live =
+    lifetime_max // (2·V·P) + 1`` (1 group at V=1 → the P-slot ring
+    above; 2 at V≥2) — so ring memory is O(V·P) activations, never
+    O(V·M). Executed stage work with ``skip_idle``: exactly ``2·V·M``
+    per stage vs ``3·V·M`` for the remat path. The ``skip_bubbles``
+    collective contract (ppermute-free stages) applies to ``skip_idle``
+    — for the stage AND its transpose (psum/all_gather/reduce_scatter/
+    all_to_all transpose within the class; ppermute does not).
 
-    MUST be called inside ``shard_map`` over ``axis_name``. V=1 only —
-    the interleaved (V>1) schedule uses :func:`pipeline_apply` +
-    ``jax.grad``.
+    MUST be called inside ``shard_map`` over ``axis_name``.
 
-    - ``stage_fn(stage_params, x) -> y`` — boundary in = boundary out
-      (shape/dtype), as in :func:`pipeline_apply`.
+    - ``stage_fn(stage_params, x) -> y`` — ONE chunk's forward; boundary
+      in = boundary out (shape/dtype), as in :func:`pipeline_apply`.
+      With ``num_chunks`` V>1, ``stage_params`` leaves carry a leading
+      (V, ...) chunk axis (chunk c = v·P + s lives on stage s, as in
+      :func:`pipeline_apply`) and the returned ``grads`` keep it.
     - ``loss_mb(y, m) -> scalar`` — microbatch ``m``'s loss, evaluated
-      on the LAST stage right after its forward; its grad seeds that
-      microbatch's backward (≙ the reference's ``loss_func`` +
-      ``backward_step`` seed). The objective is the SUM over
+      on the LAST stage right after its LAST-chunk forward; its grad
+      seeds that microbatch's backward (≙ the reference's ``loss_func``
+      + ``backward_step`` seed). The objective is the SUM over
       microbatches — fold any 1/M inside ``loss_mb``.
 
     ``loss_params`` (optional): a pytree of parameters the loss itself
@@ -428,7 +450,28 @@ def one_f_one_b(
     P = jax.lax.axis_size(axis_name)
     s = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
-    T = 2 * (M + P - 1)
+    V = num_chunks
+    if V > 1:
+        if M % P:
+            raise ValueError(
+                f"interleaved 1F1B requires num_microbatches ({M}) % "
+                f"pipeline size ({P}) == 0 (the reference's "
+                f"microbatches %% pp assertion)")
+        if P < 2:
+            raise ValueError("interleaved 1F1B needs pipeline size >= 2")
+        chunk_params = stage_params
+    else:
+        # lift to one chunk so V=1 and V>1 share the machinery
+        chunk_params = jax.tree_util.tree_map(lambda p: p[None],
+                                              stage_params)
+    D_ = (V - 1) * 2 * P
+    VP = V * P
+    T = D_ + 2 * V * M + 2 * P - 2
+    # residual-ring capacity: worst-case lifetime (v=0 residual at s=0)
+    # over the slot-reuse interval 2·V·P (same (v, r), next group)
+    lifetime_max = D_ + (V - 1) * 2 * P + 2 * P - 1
+    G_live = lifetime_max // (2 * VP) + 1
+    R = G_live * VP
     x_shape = microbatches.shape[1:]
     dtype = microbatches.dtype
     zeros_x = jnp.zeros(x_shape, dtype)
@@ -461,40 +504,103 @@ def one_f_one_b(
         return jax.tree_util.tree_leaves(jax.vjp(stage_pair, p, x)[1])
 
     # trace-time constants: residual treedef, leaf shapes, x-dependence
-    _, _vjp0 = jax.vjp(stage_pair, stage_params, zeros_x)  # arrays DCE'd
+    # (chunk-independent — every chunk shares stage_fn and shapes)
+    params0 = jax.tree_util.tree_map(lambda p: p[0], chunk_params)
+    _, _vjp0 = jax.vjp(stage_pair, params0, zeros_x)  # arrays DCE'd
     res_treedef = jax.tree_util.tree_structure(_vjp0)
-    res_sds = jax.eval_shape(_vjp_leaves, stage_params, zeros_x)
-    xdep = _x_dependent_mask(_vjp_leaves, stage_params, zeros_x,
+    res_sds = jax.eval_shape(_vjp_leaves, params0, zeros_x)
+    xdep = _x_dependent_mask(_vjp_leaves, params0, zeros_x,
                              arg_index=1)
-    ring0 = [jnp.zeros((P,) + sd.shape, sd.dtype)
+    ring0 = [jnp.zeros((R,) + sd.shape, sd.dtype)
              for sd, d in zip(res_sds, xdep) if d]
 
     fwd_perm = [(i, (i + 1) % P) for i in range(P)]
     bwd_perm = [(i, (i - 1) % P) for i in range(P)]
 
-    def tick(carry, t):
-        (x_recv, dy_recv, ring, dy_ring, gacc, lacc, dmb, lpacc,
-         aux_acc) = carry
+    def _decomp(uu):
+        """uu = g·V·P + v·P + r -> (g, v, r, m)."""
+        g = uu // VP
+        rem = jnp.mod(uu, VP)
+        v = rem // P
+        r = jnp.mod(rem, P)
+        return g, v, r, g * P + r
 
-        # ---- forward subtick: fwd(m_f) at t = 2·m_f + s ----
+    def tick(carry, t):
+        (x_recv, dy_recv, ring, dy_ring, fwd_fifo, dy_fifo, gacc, lacc,
+         dmb, lpacc, aux_acc) = carry
+
+        # ---- chunk-recirculation FIFO writes (statically elided at
+        # V=1, where the FIFO carries are empty tuples) ----
+        if V > 1:
+            # fwd arrival at stage 0: chunk-v output of (g, v, r) sent
+            # by stage P-1 at t-1 -> (t - P)/2 = g·VP + v·P + r
+            w1 = t - P
+            g1, v1, r1, _ = _decomp(w1 // 2)
+            arr1 = ((w1 >= 0) & (w1 % 2 == 0) & (w1 // 2 < V * M)
+                    & (v1 <= V - 2) & (s == 0))
+            fwd_fifo = jnp.where(
+                arr1,
+                jax.lax.dynamic_update_index_in_dim(fwd_fifo, x_recv,
+                                                    r1, axis=0),
+                fwd_fifo)
+            # bwd arrival at stage P-1: chunk-(v+1) input-cotangent of
+            # (g, r) sent by stage 0 at t-1 -> (t - D - 2P)/2 decomposes
+            # with vv = V-1-v_producer
+            w2 = t - D_ - 2 * P
+            g2, vv2, r2, _ = _decomp(w2 // 2)
+            arr2 = ((w2 >= 0) & (w2 % 2 == 0) & (w2 // 2 < V * M)
+                    & (vv2 <= V - 2) & is_last)
+            dy_fifo = jnp.where(
+                arr2,
+                jax.lax.dynamic_update_index_in_dim(dy_fifo, dy_recv,
+                                                    r2, axis=0),
+                dy_fifo)
+
+        # ---- forward subtick: fwd(g, v, r) at t = 2(g·VP+v·P+r)+s ----
         u = t - s
-        m_f = jnp.clip(u // 2, 0, M - 1)
-        valid_f = (u >= 0) & (u % 2 == 0) & (u // 2 < M)
+        uu = jnp.clip(u // 2, 0, V * M - 1)
+        g_f, v_f, r_f, m_f = _decomp(uu)
+        valid_f = (u >= 0) & (u % 2 == 0) & (u // 2 < V * M)
         fresh = jax.lax.dynamic_index_in_dim(microbatches, m_f, axis=0,
                                              keepdims=False)
-        x_in = jnp.where(s == 0, fresh, x_recv)
+        if V > 1:
+            recirc = jax.lax.dynamic_index_in_dim(fwd_fifo, r_f, axis=0,
+                                                  keepdims=False)
+            x0 = jnp.where(v_f == 0, fresh, recirc)
+        else:
+            x0 = fresh
+        x_in = jnp.where(s == 0, x0, x_recv)
+        params_f = _tree_select_chunk(chunk_params, v_f)
+        # the loss attaches only to the LAST chunk's output on the last
+        # stage — gate its (head-projection-sized) value_and_grad under
+        # a cond instead of computing-and-masking it on every rank and
+        # chunk (predicate uniform across each pp rank's tp/dp/ep/cp
+        # peers, so loss_mb's group-scoped collectives stay safe — the
+        # skip_bubbles contract)
+        pred_loss = is_last & (v_f == V - 1)
 
-        def run_fwd(x_in):
-            (y, aux), vjp_fn = jax.vjp(stage_pair, stage_params, x_in)
+        def run_fwd(ops):
+            p_f, x_in = ops
+            (y, aux), vjp_fn = jax.vjp(stage_pair, p_f, x_in)
             leaves = jax.tree_util.tree_leaves(vjp_fn)
             dep = [lf for lf, d in zip(leaves, xdep) if d]
-            lm, (dlp, dy_self) = jax.value_and_grad(
-                _loss, argnums=(0, 1))(loss_params, y, m_f)
-            dlp = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), dlp)
-            return y, aux, dep, lm, dy_self.astype(dtype), dlp
 
-        def zero_fwd(x_in):
+            def with_loss(y):
+                lm, (dlp, dy_self) = jax.value_and_grad(
+                    _loss, argnums=(0, 1))(loss_params, y, m_f)
+                return (lm,
+                        jax.tree_util.tree_map(
+                            lambda g: g.astype(jnp.float32), dlp),
+                        dy_self.astype(dtype))
+
+            def no_loss(y):
+                return jnp.zeros([], jnp.float32), zeros_lp, zeros_x
+
+            lm, dlp, dy_self = jax.lax.cond(pred_loss, with_loss,
+                                            no_loss, y)
+            return y, aux, dep, lm, dy_self, dlp
+
+        def zero_fwd(ops):
             return (zeros_x, zero_aux,
                     [jnp.zeros(sd.shape, sd.dtype)
                      for sd, d in zip(res_sds, xdep) if d],
@@ -502,48 +608,58 @@ def one_f_one_b(
 
         if skip_idle:
             y, aux, dep, lm, dy_self, dlp = jax.lax.cond(
-                valid_f, run_fwd, zero_fwd, x_in)
+                valid_f, run_fwd, zero_fwd, (params_f, x_in))
         else:
-            y, aux, dep, lm, dy_self, dlp = run_fwd(x_in)
+            y, aux, dep, lm, dy_self, dlp = run_fwd((params_f, x_in))
             y = jnp.where(valid_f, y, zeros_x)
         aux_acc = aux_acc + jnp.where(valid_f, aux, 0.0)
-        lp_ok = valid_f & is_last
+        # the loss attaches to the LAST chunk's output on the last stage
+        out_f = valid_f & is_last & (v_f == V - 1)
         lpacc = jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(lp_ok, g, 0.0), lpacc, dlp)
+            lambda a, g: a + jnp.where(out_f, g, 0.0), lpacc, dlp)
 
-        slot_f = jnp.mod(m_f, P)
+        slot_f = (jnp.mod(g_f, G_live) * VP + v_f * P + r_f)
         ring = [jnp.where(valid_f,
                           jax.lax.dynamic_update_index_in_dim(
                               buf, lf, slot_f, axis=0),
                           buf)
                 for buf, lf in zip(ring, dep)]
         dy_ring = jnp.where(
-            valid_f & is_last,
-            jax.lax.dynamic_update_index_in_dim(dy_ring, dy_self, slot_f,
+            out_f,
+            jax.lax.dynamic_update_index_in_dim(dy_ring, dy_self, r_f,
                                                 axis=0),
             dy_ring)
-        lacc = lacc + jnp.where(valid_f & is_last, lm, 0.0)
+        lacc = lacc + jnp.where(out_f, lm, 0.0)
 
-        # ---- backward subtick: bwd(m_b) at t = 2·m_b + 2P−1−s ----
-        v = t - (2 * P - 1 - s)
-        m_b = jnp.clip(v // 2, 0, M - 1)
-        valid_b = (v >= 0) & (v % 2 == 0) & (v // 2 < M)
-        slot_b = jnp.mod(m_b, P)
-        dy = jnp.where(is_last,
-                       jax.lax.dynamic_index_in_dim(dy_ring, slot_b,
-                                                    axis=0,
-                                                    keepdims=False),
-                       dy_recv)
+        # ---- backward subtick: bwd(g, v, r) at
+        #      t = D + 2(g·VP + (V−1−v)·P + r) + 2P−1−s ----
+        w = t - D_ - (2 * P - 1 - s)
+        ww = jnp.clip(w // 2, 0, V * M - 1)
+        g_b, vv_b, r_b, m_b = _decomp(ww)
+        v_b = V - 1 - vv_b
+        valid_b = (w >= 0) & (w % 2 == 0) & (w // 2 < V * M)
+        # last stage seeds chunk V-1 from the loss grad, lower chunks
+        # from the recirculated cotangent FIFO
+        seed = jax.lax.dynamic_index_in_dim(dy_ring, r_b, axis=0,
+                                            keepdims=False)
+        if V > 1:
+            seed = jnp.where(
+                v_b == V - 1, seed,
+                jax.lax.dynamic_index_in_dim(dy_fifo, r_b, axis=0,
+                                             keepdims=False))
+        dy = jnp.where(is_last, seed, dy_recv)
+        slot_b = (jnp.mod(g_b, G_live) * VP + v_b * P + r_b)
         stored = [jax.lax.dynamic_index_in_dim(buf, slot_b, axis=0,
                                                keepdims=False)
                   for buf in ring]
+        params_b = _tree_select_chunk(chunk_params, v_b)
 
         def run_bwd(ops):
-            dy_in, stored = ops
+            dy_in, stored, p_b = ops
             # parameter-only residuals are x-independent: recompute them
             # from a zeros-x VJP (its x-dependent half is dead code),
             # splice in the ring's activation leaves, rebuild the VJP
-            fresh_leaves = _vjp_leaves(stage_params, zeros_x)
+            fresh_leaves = _vjp_leaves(p_b, zeros_x)
             it = iter(stored)
             leaves = [next(it) if d else fl
                       for fl, d in zip(fresh_leaves, xdep)]
@@ -556,37 +672,43 @@ def one_f_one_b(
         def zero_bwd(ops):
             return (jax.tree_util.tree_map(
                         lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
-                        stage_params),
+                        params0),
                     zeros_x)
 
         if skip_idle:
             dp, dx = jax.lax.cond(valid_b, run_bwd, zero_bwd,
-                                  (dy, stored))
+                                  (dy, stored, params_b))
         else:
-            dp, dx = run_bwd((dy, stored))
+            dp, dx = run_bwd((dy, stored, params_b))
             dx = jnp.where(valid_b, dx, zeros_x)
         gacc = jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(valid_b, g, 0.0), gacc, dp)
-        dmb = jnp.where(valid_b & (s == 0),
+            lambda a, g: a.at[v_b].add(jnp.where(valid_b, g, 0.0)),
+            gacc, dp)
+        dmb = jnp.where(valid_b & (s == 0) & (v_b == 0),
                         jax.lax.dynamic_update_index_in_dim(
                             dmb, dx.astype(jnp.float32), m_b, axis=0),
                         dmb)
 
         y_send = jax.lax.ppermute(y, axis_name, fwd_perm)
         dx_send = jax.lax.ppermute(dx, axis_name, bwd_perm)
-        return (y_send, dx_send, ring, dy_ring, gacc, lacc, dmb, lpacc,
-                aux_acc), None
+        return (y_send, dx_send, ring, dy_ring, fwd_fifo, dy_fifo, gacc,
+                lacc, dmb, lpacc, aux_acc), None
 
+    fifo0 = (jnp.zeros((P,) + x_shape, dtype) if V > 1 else ())
     init = (zeros_x, zeros_x, ring0,
-            jnp.zeros((P,) + x_shape, dtype),
+            jnp.zeros((P,) + x_shape, dtype),      # dy_ring (loss seeds)
+            fifo0,                                 # fwd recirc FIFO
+            fifo0,                                 # dy recirc FIFO
             jax.tree_util.tree_map(
                 lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
-                stage_params),
+                chunk_params),
             jnp.zeros([], jnp.float32),
             jnp.zeros((M,) + x_shape, jnp.float32),
             zeros_lp, zero_aux)
-    (_, _, _, _, grads, loss_sum, dmb, dloss_params, aux_sum), _ = \
+    (_, _, _, _, _, _, grads, loss_sum, dmb, dloss_params, aux_sum), _ = \
         jax.lax.scan(tick, init, jnp.arange(T), unroll=scan_unroll)
+    if V == 1:
+        grads = jax.tree_util.tree_map(lambda g: g[0], grads)
     out = (loss_sum, grads, dmb)
     if loss_params is not None:
         out = out + (dloss_params,)

@@ -460,14 +460,46 @@ class Test1F1BSchedule:
                 err_msg=jax.tree_util.keystr(path),
                 rtol=2e-4, atol=2e-6)
 
-    def test_rejects_interleaved(self, rng):
+    def test_interleaved_matches_scan_schedule(self, devices, rng):
+        """V=2 interleaved 1F1B (group-cycled chunks) through the full
+        flagship step must match the scan schedule's loss and updated
+        params — the scan path's V>1 interleave is itself
+        flat-parity-tested above."""
         mcfg = LlamaConfig.tiny(num_layers=4, max_seq_len=32,
                                 vocab_size=64, num_heads=4,
                                 num_kv_heads=2, hidden_size=32,
                                 ffn_size=64, policy=get_policy("O0"))
-        with pytest.raises(ValueError, match="1f1b.*V=1|V=1.*1f1b"):
+        cfg = Llama3DConfig(model=mcfg, dp=2, pp=2, tp=2, num_chunks=2,
+                            num_microbatches=M, microbatch_size=1)
+        tokens = jnp.asarray(
+            rng.integers(0, 64, (M, mcfg.max_seq_len, 2)), jnp.int32)
+        labels = jnp.asarray(
+            rng.integers(0, 64, (M, mcfg.max_seq_len, 2)), jnp.int32)
+        model = Llama(mcfg)
+        flat = model.init(jax.random.key(0),
+                          tokens[0].transpose(1, 0))["params"]
+        params = {}
+        params["chunk"], params["shared"] = from_llama_params(flat, cfg)
+        (st_scan, loss_scan), (st_1f1b, loss_1f1b) = self._run_both(
+            cfg, tokens, labels, params)
+        np.testing.assert_allclose(loss_1f1b, loss_scan, rtol=2e-5)
+        flat_1f1b = dict(jax.tree_util.tree_leaves_with_path(
+            st_1f1b["params"]))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+                st_scan["params"]):
+            np.testing.assert_allclose(
+                np.asarray(flat_1f1b[path]), np.asarray(leaf),
+                err_msg=jax.tree_util.keystr(path),
+                rtol=2e-4, atol=2e-6)
+
+    def test_rejects_interleaved_bad_microbatches(self, rng):
+        mcfg = LlamaConfig.tiny(num_layers=4, max_seq_len=32,
+                                vocab_size=64, num_heads=4,
+                                num_kv_heads=2, hidden_size=32,
+                                ffn_size=64, policy=get_policy("O0"))
+        with pytest.raises(ValueError, match="interleaved 1F1B"):
             Llama3DConfig(model=mcfg, pp=2, tp=2, num_chunks=2,
-                          num_microbatches=M, schedule="1f1b")
+                          num_microbatches=3, schedule="1f1b")
 
 
 def test_train_step_runs_and_descends(setup, devices):

@@ -540,6 +540,90 @@ class Test1F1B:
         np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
                                    rtol=1e-5, atol=1e-6)
 
+    # the (2, 2, 8) case drives M/P = 4 > G_live = 2 groups, exercising
+    # residual-ring slot REUSE across groups (g mod G_live wraparound)
+    @pytest.mark.parametrize("V,P_,M_",
+                             [(2, 2, 4), (3, 2, 4), (2, 4, 4), (2, 2, 8)],
+                             ids=["V2P2", "V3P2", "V2P4", "V2P2M8-reuse"])
+    @pytest.mark.parametrize("skip", [True, False],
+                             ids=["cond-skip", "masked"])
+    def test_interleaved_matches_flat(self, devices, V, P_, M_, skip):
+        """Interleaved (V>1) true 1F1B: group-cycled chunk schedule with
+        recirculation FIFOs on both rings — loss, per-chunk param grads,
+        and input cotangents must match the flat V·P-deep composition."""
+        from jax.sharding import PartitionSpec as Ps
+
+        mesh = make_mesh(pp=P_)
+        mb = 3
+        rng = np.random.default_rng(5)
+        params = {
+            "w": jnp.asarray(rng.normal(size=(V, P_, D, D)) * 0.5,
+                             jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(V, P_, D)) * 0.1,
+                             jnp.float32)}
+        mbs = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+
+        def stage(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def loss_mb(y, m):
+            t = jax.lax.dynamic_index_in_dim(tgt, m, 0, keepdims=False)
+            return jnp.mean(jnp.square(y - t)) / M_
+
+        def inner(params, mbs):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], params)
+            loss, grads, dmb = schedules.one_f_one_b(
+                stage, local, mbs, loss_mb, num_chunks=V,
+                skip_idle=skip)
+            return (jax.lax.psum(loss, "pp"),
+                    jax.tree_util.tree_map(lambda g: g[:, None], grads),
+                    dmb)
+
+        pspec = jax.tree_util.tree_map(lambda _: Ps(None, "pp"), params)
+        loss, grads, dmb = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=(pspec, Ps()),
+            out_specs=(Ps(), pspec, Ps()), check_vma=False))(params, mbs)
+
+        def flat(params, mbs):
+            def one(x, t):
+                for v in range(V):
+                    for st in range(P_):
+                        x = stage(jax.tree_util.tree_map(
+                            lambda p: p[v, st], params), x)
+                return jnp.mean(jnp.square(x - t)) / M_
+            return jnp.sum(jax.vmap(one)(mbs, tgt))
+
+        want, (gp, gx) = jax.value_and_grad(flat, argnums=(0, 1))(
+            params, mbs)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(gp[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_interleaved_rejects_bad_m(self, devices):
+        from jax.sharding import PartitionSpec as Ps
+
+        mesh = make_mesh(pp=2)
+        params = {"w": jnp.zeros((2, 2, D, D))}
+        mbs = jnp.zeros((3, 2, D))  # 3 % 2 != 0
+
+        def inner(params, mbs):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], params)
+            return schedules.one_f_one_b(
+                stage_fn, local, mbs, lambda y, m: jnp.sum(y),
+                num_chunks=2)[0]
+
+        with pytest.raises(ValueError, match="interleaved 1F1B"):
+            jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(jax.tree_util.tree_map(
+                    lambda _: Ps(None, "pp"), params), Ps()),
+                out_specs=Ps(), check_vma=False)(params, mbs)
+
     @pytest.mark.parametrize("skip", [True, False],
                              ids=["cond-skip", "masked"])
     def test_loss_params_and_aux_match_flat(self, devices, skip):
@@ -596,6 +680,71 @@ class Test1F1B:
                 return (jnp.mean(jnp.square(x @ lp["v"] - t)) / M_
                         + C_AUX * aux_tot)
             return jnp.sum(jax.vmap(one)(mbs, tgt, jnp.arange(M_)))
+
+        want, (gp, glp, gx) = jax.value_and_grad(
+            flat, argnums=(0, 1, 2))(params, lp0, mbs)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(gp["w"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(glp["v"]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_interleaved_loss_params_and_aux(self, devices):
+        """The post-process channels at V>1: loss_params grads must
+        accumulate only on last-chunk/last-stage forwards, and every
+        chunk's aux must both sum into aux_sum and receive the seeded
+        cotangent."""
+        from jax.sharding import PartitionSpec as Ps
+
+        mesh = make_mesh(pp=2)
+        V, P_, M_, mb = 2, 2, 4, 2
+        C_AUX = 0.25
+        rng = np.random.default_rng(13)
+        params = {"w": jnp.asarray(rng.normal(size=(V, P_, D, D)) * 0.5,
+                                   jnp.float32)}
+        lp0 = {"v": jnp.asarray(rng.normal(size=(D, D)) * 0.5,
+                                jnp.float32)}
+        mbs = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(M_, mb, D)), jnp.float32)
+
+        def stage_aux(p, x):
+            y = jnp.tanh(x @ p["w"])
+            return y, jnp.mean(jnp.square(y)) * jnp.sum(p["w"][0, :2])
+
+        def loss_with_lp(lp, y, m):
+            t = jax.lax.dynamic_index_in_dim(tgt, m, 0, keepdims=False)
+            return jnp.mean(jnp.square(y @ lp["v"] - t)) / M_
+
+        def inner(params, lp, mbs):
+            local = jax.tree_util.tree_map(lambda p: p[:, 0], params)
+            loss, grads, dmb, dlp, aux_sum = schedules.one_f_one_b(
+                stage_aux, local, mbs, loss_with_lp, num_chunks=V,
+                loss_params=lp, with_aux=True, aux_cotangent=C_AUX)
+            total = jax.lax.psum(loss + C_AUX * aux_sum, "pp")
+            return (total,
+                    jax.tree_util.tree_map(lambda g: g[:, None], grads),
+                    dmb, jax.lax.psum(dlp["v"], "pp"))
+
+        pspec = jax.tree_util.tree_map(lambda _: Ps(None, "pp"), params)
+        loss, grads, dmb, dv = jax.jit(jax.shard_map(
+            inner, mesh=mesh, in_specs=(pspec, Ps(), Ps()),
+            out_specs=(Ps(), pspec, Ps(), Ps()), check_vma=False))(
+            params, lp0, mbs)
+
+        def flat(params, lp, mbs):
+            def one(x, t):
+                aux_tot = 0.0
+                for v in range(V):
+                    for st in range(P_):
+                        x, a = stage_aux(jax.tree_util.tree_map(
+                            lambda p: p[v, st], params), x)
+                        aux_tot = aux_tot + a
+                return (jnp.mean(jnp.square(x @ lp["v"] - t)) / M_
+                        + C_AUX * aux_tot)
+            return jnp.sum(jax.vmap(one)(mbs, tgt))
 
         want, (gp, glp, gx) = jax.value_and_grad(
             flat, argnums=(0, 1, 2))(params, lp0, mbs)
