@@ -21,6 +21,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex1_tpu.testing import honor_jax_platforms_env
+
+honor_jax_platforms_env()   # JAX_PLATFORMS=cpu must beat sitecustomize
+
+
 from apex1_tpu.amp import Amp
 from apex1_tpu.core.policy import get_policy
 from apex1_tpu.optim.fused_adam import fused_adam

@@ -20,6 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex1_tpu.testing import honor_jax_platforms_env
+
+honor_jax_platforms_env()   # JAX_PLATFORMS=cpu must beat sitecustomize
+
+
 from apex1_tpu.amp import Amp
 from apex1_tpu.core.policy import get_policy
 from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
@@ -42,6 +47,8 @@ def main():
     policy = get_policy(args.opt_level)
     cfg = (GPT2Config.tiny(policy=policy) if args.tiny
            else GPT2Config(policy=policy))
+    if args.seq > cfg.max_seq_len:   # --tiny keeps the default --seq
+        args.seq = cfg.max_seq_len
     model = GPT2(cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(

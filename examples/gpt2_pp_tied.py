@@ -20,6 +20,9 @@ import sys
 
 # direct `python examples/...` puts examples/ (not the repo root) on the
 # path; the smoke harness exec()s the source with no __file__ at all
+# (no import-time honor_jax_platforms_env here: this example calls
+# force_virtual_cpu_devices in main, which must win the first backend
+# init — an early default_backend() probe would pin 1 CPU device)
 _root = (os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
          if "__file__" in globals() else os.getcwd())
 sys.path.insert(0, _root)

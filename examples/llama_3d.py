@@ -16,6 +16,9 @@ import os
 import sys
 import time
 
+# (no import-time honor_jax_platforms_env here: this example calls
+# force_virtual_cpu_devices in main, which must win the first backend
+# init — an early default_backend() probe would pin 1 CPU device)
 _root = (os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
          if "__file__" in globals() else os.getcwd())
 sys.path.insert(0, _root)

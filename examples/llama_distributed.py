@@ -23,6 +23,11 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from apex1_tpu.testing import honor_jax_platforms_env
+
+honor_jax_platforms_env()   # JAX_PLATFORMS=cpu must beat sitecustomize
+
+
 from apex1_tpu.amp import Amp
 from apex1_tpu.core.mesh import make_mesh
 from apex1_tpu.core.policy import get_policy

@@ -1,0 +1,91 @@
+"""T5 seq2seq fine-tune + generate — the encoder-decoder family the
+reference's variable-shape pipeline machinery (``decoder_seq_length``)
+serves, end to end: amp mixed precision + fused Adam training on a
+synthetic SORTING task (the decoder must emit the encoder's tokens in
+ascending order — position-free, so it suits T5's relative-position
+attention), then KV-cached greedy generation
+(`models.generate.t5_generate`) to verify the model actually learned
+the mapping (expect ~60-80% strict token accuracy after the default
+schedule; duplicate counting is the genuinely hard residue of the
+task).
+
+``python examples/t5_seq2seq.py [--opt-level O2] [--steps 1500]``
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex1_tpu.testing import honor_jax_platforms_env
+
+honor_jax_platforms_env()   # JAX_PLATFORMS=cpu must beat sitecustomize
+
+from apex1_tpu.amp import Amp  # noqa: E402
+from apex1_tpu.core.policy import get_policy
+from apex1_tpu.models.generate import t5_generate
+from apex1_tpu.models.t5 import T5, T5Config, t5_loss_fn
+from apex1_tpu.optim.fused_adam import fused_adam
+
+
+def make_batch(rng, batch, seq, vocab, pad_id=0, bos_id=1):
+    """Sort task: encoder sees [2, vocab) tokens; the decoder target is
+    the ascending sort wrapped as [BOS, sorted..., PAD]."""
+    src = rng.integers(2, vocab, (batch, seq))
+    dec = np.concatenate(
+        [np.full((batch, 1), bos_id), np.sort(src, axis=1),
+         np.full((batch, 1), pad_id)], axis=1)
+    return jnp.asarray(src, jnp.int32), jnp.asarray(dec, jnp.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=1500)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=8)
+    ap.add_argument("--opt-level", default="O2")
+    args = ap.parse_args()
+
+    cfg = T5Config.tiny(vocab_size=32, d_model=128, num_heads=4,
+                        head_dim=32, d_ff=256, num_encoder_layers=2,
+                        num_decoder_layers=2,
+                        policy=get_policy(args.opt_level))
+    model = T5(cfg)
+    rng = np.random.default_rng(0)
+    src, dec = make_batch(rng, args.batch, args.seq, cfg.vocab_size)
+    params = model.init(jax.random.key(0), src, dec)["params"]
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    print(f"T5 tiny: {n_params/1e6:.2f}M params, opt {args.opt_level}")
+
+    amp = Amp(tx=fused_adam(1e-3, weight_decay=0.01),
+              opt_level=args.opt_level)
+    state = amp.init(params)
+    step = jax.jit(amp.make_train_step(
+        t5_loss_fn(model, label_pad_id=0)))
+
+    t0 = time.time()
+    for i in range(args.steps):
+        src, dec = make_batch(rng, args.batch, args.seq, cfg.vocab_size)
+        state, metrics = step(state, src, dec)
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(metrics['loss']):.4f}  "
+                  f"scale {float(metrics['loss_scale']):.0f}")
+    print(f"trained {args.steps} steps in {time.time()-t0:.1f}s")
+
+    # greedy generation: the decoder should sort a held-out batch
+    src, _ = make_batch(rng, 8, args.seq, cfg.vocab_size)
+    out = t5_generate(model, state.params, src,
+                      max_new_tokens=args.seq, dec_start_id=1)
+    want = np.sort(np.asarray(src), axis=1)
+    got = np.asarray(out)
+    acc = float((got == want).mean())
+    print(f"greedy decode sort accuracy: {acc:.1%}")
+    for i in range(2):
+        print(f"  src {np.asarray(src)[i].tolist()}")
+        print(f"  out {got[i].tolist()}")
+
+
+if __name__ == "__main__":
+    main()
