@@ -434,6 +434,23 @@ def main():
 
         report(f"flagship 8B long-ctx S=32k cp2 ({gen} x{fn_dev})",
                longctx_run)
+
+        # the same 8B step on the INTERLEAVED true 1F1B schedule (V=2
+        # group-cycled chunks, recirculation FIFOs, residual ring) —
+        # proves the staggered-scan schedule lowers through Mosaic at
+        # production scale, not just on the CPU test mesh
+        il_cfg = Llama3DConfig(model=mcfg, dp=dp, pp=pp, tp=tp,
+                               num_microbatches=2 * pp,
+                               microbatch_size=1, num_chunks=2,
+                               schedule="1f1b")
+
+        def interleaved_run():
+            step, _, _, _ = build_step(il_cfg, fmesh)
+            state, data = abstract_state(il_cfg, fmesh)
+            return step.lower(state, data, data)
+
+        report(f"flagship 8B interleaved-1F1B V=2 ({gen} x{fn_dev})",
+               interleaved_run)
         # analytic per-stage parameter budget (SPMD allocates the
         # pp-replicated embedding/head on every stage)
         m = fcfg.model

@@ -16,16 +16,19 @@ probe() {
   # watcher looked alive but never polled again (observed 06:03→06:12
   # gap). SIGKILL after the grace period actually ends it.
   [ -e "$RES/pause" ] && return 1
+  # 9>&- : children must NOT inherit the flock fd — an orphaned probe
+  # (or its sleep) would hold the single-instance lock after the
+  # watcher dies and block every restart
   timeout -k 15 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
-print(float(jnp.sum((x @ x).astype(jnp.float32))))" >/dev/null 2>&1
+print(float(jnp.sum((x @ x).astype(jnp.float32))))" >/dev/null 2>&1 9>&-
 }
 
 echo "watch start $(date -u +%H:%M:%S)" >> "$RES/status.log"
 until probe; do
   echo "down $(date -u +%H:%M:%S)" >> "$RES/status.log"
-  sleep 120
+  sleep 120 9>&-
 done
 echo "TPU BACK $(date -u +%H:%M:%S)" >> "$RES/status.log"
 
@@ -37,9 +40,12 @@ mkdir -p "$REPO_RES"
 
 run() { # name timeout cmd...
   local name=$1 to=$2; shift 2
-  stdbuf -oL -eL timeout "$to" "$@" 2>&1 | tee "$RES/$name.log" \
-    > "$REPO_RES/$name.log"
-  local rc=${PIPESTATUS[0]}   # the command's status, not tee's
+  # the whole pipeline runs with fd 9 closed (see probe) — tee must not
+  # inherit the lock either, or a surviving benchmark child blocks
+  # watcher restarts for its full timeout
+  local rc
+  { stdbuf -oL -eL timeout -k 30 "$to" "$@" 2>&1 | tee "$RES/$name.log" \
+    > "$REPO_RES/$name.log"; rc=${PIPESTATUS[0]}; } 9>&-
   echo "$name rc=$rc $(date -u +%H:%M:%S)" >> "$RES/status.log"
 }
 
@@ -59,6 +65,7 @@ run bench_t5        1800 python bench.py --config t5
 run bench_gpt2_b24  1500 python bench.py --config gpt2 --batch 24
 run profile_gpt2    1500 python tools/profile_step.py --config gpt2 --top 40
 run cond_elision    900  python tools/cond_elision_probe.py
+run aot_flagship    2400 python tools/aot_check.py --flagship
 run kern_all        4800 python tools/bench_kernels.py all
 run kern_all_llama  4800 python tools/bench_kernels.py all --llama
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
