@@ -39,6 +39,7 @@ from apex1_tpu.ops import (apply_rotary_pos_emb, linear_cross_entropy,
 from apex1_tpu.ops.attention import flash_attention
 from apex1_tpu.parallel.ring_attention import ring_attention
 from apex1_tpu.parallel.ulysses import ulysses_attention
+from apex1_tpu.transformer.tensor_parallel.random import checkpoint_policy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +54,13 @@ class LlamaConfig:
     rope_base: float = 500000.0
     norm_eps: float = 1e-5
     remat: bool = False
+    # jax.checkpoint_policies name — "nothing_saveable" = full recompute
+    # (the reference's activation checkpointing); "dots_saveable" /
+    # "dots_with_no_batch_dims_saveable" = SELECTIVE recompute (keep
+    # matmul outputs, recompute elementwise/norm/softmax — Megatron's
+    # --recompute-activations selective mode, trading a little memory
+    # for most of the recompute FLOPs)
+    remat_policy: str = "nothing_saveable"
     # MoE (beyond-reference, `transformer.moe`): every N-th block swaps
     # its dense FFN for a top-k-routed expert FFN; 0 = dense everywhere.
     moe_every: int = 0
@@ -84,6 +92,7 @@ class LlamaConfig:
         if self.cp_impl not in ("ring", "ulysses"):
             raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
                              f"{self.cp_impl!r}")
+        checkpoint_policy(self.remat_policy)  # fail fast on a typo
 
     @property
     def head_dim(self) -> int:
@@ -211,7 +220,8 @@ class Llama(nn.Module):
                                    base=cfg.rope_base)
         block = LlamaBlock
         if cfg.remat and cache is None:
-            block = nn.remat(LlamaBlock, static_argnums=())
+            block = nn.remat(LlamaBlock, static_argnums=(),
+                             policy=checkpoint_policy(cfg.remat_policy))
         new_cache = {}
         for i in range(cfg.num_layers):
             use_moe = (cfg.moe_every > 0

@@ -391,7 +391,9 @@ def _stage_fn(cfg: Llama3DConfig, cos, sin):
         return x + y.astype(x.dtype), aux
 
     if m.remat:
-        layer = jax.checkpoint(layer)
+        from apex1_tpu.transformer.tensor_parallel.random import (
+            checkpoint_with_policy)
+        layer = checkpoint_with_policy(layer, m.remat_policy)
 
     def stage(p_stage, x):
         # p_stage leaves: (layers_per_stage, ...) — scan keeps the jaxpr

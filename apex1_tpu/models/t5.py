@@ -39,6 +39,7 @@ from apex1_tpu.ops import (NEG_INF, linear_cross_entropy, rms_norm,
                            scaled_masked_softmax,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.transformer.tensor_parallel.random import checkpoint_policy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +57,11 @@ class T5Config:
     gated_act: bool = False      # True = gated-GELU (t5.1.1)
     tie_word_embeddings: bool = True
     remat: bool = False
+    # jax.checkpoint_policies name; see models.llama.LlamaConfig
+    remat_policy: str = "nothing_saveable"
+
+    def __post_init__(self):
+        checkpoint_policy(self.remat_policy)  # fail fast on a typo
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 
@@ -290,7 +296,8 @@ class T5Stack(nn.Module):
                     else cfg.num_encoder_layers)
         block = T5Block
         if cfg.remat and cache is None:
-            block = nn.remat(T5Block, static_argnums=())
+            block = nn.remat(T5Block, static_argnums=(),
+                             policy=checkpoint_policy(cfg.remat_policy))
         new_cache = {}
         for i in range(n_layers):
             out = block(cfg, self.is_decoder, name=f"layer{i}")(

@@ -82,8 +82,17 @@ checkpoint = jax.checkpoint
 def checkpoint_policy(name: str = "nothing_saveable"):
     """Remat policies: "nothing_saveable" (recompute all, the reference's
     full activation checkpointing), "dots_saveable" (keep matmul outputs),
-    "dots_with_no_batch_dims_saveable" (keep weight-stationary dots)."""
-    return getattr(jax.checkpoint_policies, name)
+    "dots_with_no_batch_dims_saveable" (keep weight-stationary dots —
+    Megatron's selective ``--recompute-activations``). Unknown names
+    raise immediately (config validation calls this too, so a typo'd
+    ``remat_policy`` fails at construction, not deep inside tracing)."""
+    pol = getattr(jax.checkpoint_policies, name, None)
+    if pol is None:
+        valid = [n for n in dir(jax.checkpoint_policies)
+                 if not n.startswith("_")]
+        raise ValueError(f"unknown checkpoint policy {name!r}; valid "
+                         f"names: {valid}")
+    return pol
 
 
 def checkpoint_with_policy(fn: Callable, policy_name: str):

@@ -40,9 +40,14 @@ def test_loss_and_grads_finite():
         assert np.all(np.isfinite(leaf))
 
 
-def test_remat_matches_no_remat():
+@pytest.mark.parametrize("policy", [
+    "nothing_saveable", "dots_saveable",
+    "dots_with_no_batch_dims_saveable"])
+def test_remat_matches_no_remat(policy):
+    """Remat (full or selective recompute) is a memory/FLOPs knob — it
+    must never change loss or gradients."""
     cfg, model, tokens, params = _tiny()
-    cfg_r = LlamaConfig.tiny(remat=True)
+    cfg_r = LlamaConfig.tiny(remat=True, remat_policy=policy)
     model_r = Llama(cfg_r)
     g1 = jax.grad(llama_loss_fn(model))(params, tokens)
     g2 = jax.grad(llama_loss_fn(model_r))(params, tokens)

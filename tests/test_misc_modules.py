@@ -151,6 +151,12 @@ def test_examples_smoke(script, args):
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8")
     env["APEX1_FORCE_CPU"] = "1"
+    # the driver environment exports JAX_PLATFORMS=axon; examples honor
+    # that env var by design (it must beat the sitecustomize pin), so
+    # the harness must hand the child a fully-specified platform env —
+    # an inherited 'axon' would override the jax.config cpu preamble
+    # and hang on a dead tunnel
+    env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
         [sys.executable, "-c",
          "import jax; jax.config.update('jax_platforms', 'cpu');"

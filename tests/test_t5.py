@@ -119,6 +119,23 @@ class TestT5Model:
             float(lf(params, enc, dec_np)),
             float(t5_loss_fn(model)(params, enc, dec_np)), rtol=1e-6)
 
+    @pytest.mark.parametrize("policy",
+                             ["nothing_saveable", "dots_saveable"])
+    def test_remat_matches_no_remat(self, tiny, policy):
+        """Remat (full or selective) must not change loss or grads."""
+        import dataclasses
+        cfg, model, params, enc, dec = tiny
+        model_r = T5(dataclasses.replace(cfg, remat=True,
+                                         remat_policy=policy))
+        l1, g1 = jax.value_and_grad(t5_loss_fn(model))(params, enc, dec)
+        l2, g2 = jax.value_and_grad(t5_loss_fn(model_r))(params, enc,
+                                                         dec)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_untied_head(self):
         cfg = T5Config.tiny(policy=get_policy("O0"),
                             tie_word_embeddings=False,

@@ -451,6 +451,24 @@ def main():
 
         report(f"flagship 8B interleaved-1F1B V=2 ({gen} x{fn_dev})",
                interleaved_run)
+
+        # SELECTIVE recompute (Megatron --recompute-activations) on both
+        # schedules — the source rows of docs/parallel.md's schedule x
+        # remat memory table; keep them reproducible by this command
+        import dataclasses as _dc
+        sel_m = _dc.replace(
+            mcfg, remat_policy="dots_with_no_batch_dims_saveable")
+        for sname, base in (("scan", fcfg), ("interleaved-1F1B V=2",
+                                             il_cfg)):
+            sel_cfg = _dc.replace(base, model=sel_m)
+
+            def sel_run(cfg_=sel_cfg):
+                step, _, _, _ = build_step(cfg_, fmesh)
+                state, data = abstract_state(cfg_, fmesh)
+                return step.lower(state, data, data)
+
+            report(f"flagship 8B {sname} + selective remat "
+                   f"({gen} x{fn_dev})", sel_run)
         # analytic per-stage parameter budget (SPMD allocates the
         # pp-replicated embedding/head on every stage)
         m = fcfg.model

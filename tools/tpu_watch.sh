@@ -26,11 +26,6 @@ print(float(jnp.sum((x @ x).astype(jnp.float32))))" >/dev/null 2>&1 9>&-
 }
 
 echo "watch start $(date -u +%H:%M:%S)" >> "$RES/status.log"
-until probe; do
-  echo "down $(date -u +%H:%M:%S)" >> "$RES/status.log"
-  sleep 120 9>&-
-done
-echo "TPU BACK $(date -u +%H:%M:%S)" >> "$RES/status.log"
 
 # Results ALSO land in the repo so they survive the session for the
 # next round's context (committed by the next session, not by this
@@ -49,6 +44,17 @@ run() { # name timeout cmd...
   echo "$name rc=$rc $(date -u +%H:%M:%S)" >> "$RES/status.log"
 }
 
+# The flagship AOT re-check is TUNNEL-FREE (compile-only topology
+# client) — run it before the revival wait so its memory table is
+# fresh even while the tunnel is dead (5 x ~5-min 8B compiles).
+run aot_flagship    3600 python tools/aot_check.py --flagship
+
+until probe; do
+  echo "down $(date -u +%H:%M:%S)" >> "$RES/status.log"
+  sleep 120 9>&-
+done
+echo "TPU BACK $(date -u +%H:%M:%S)" >> "$RES/status.log"
+
 # Queue order per VERDICT r2 item 1: (a) on-device kernel NUMERICS parity
 # (2-min sweep — Mosaic numerics, not just lowering), (b) headline bench +
 # MFU, (c) remaining configs, (d) per-op profile + kernel A/B sweeps
@@ -65,7 +71,6 @@ run bench_t5        1800 python bench.py --config t5
 run bench_gpt2_b24  1500 python bench.py --config gpt2 --batch 24
 run profile_gpt2    1500 python tools/profile_step.py --config gpt2 --top 40
 run cond_elision    900  python tools/cond_elision_probe.py
-run aot_flagship    2400 python tools/aot_check.py --flagship
 run kern_all        4800 python tools/bench_kernels.py all
 run kern_all_llama  4800 python tools/bench_kernels.py all --llama
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
