@@ -142,6 +142,8 @@ class TestASP:
                                   "--hidden", "32"]),
     ("examples/llama_3d.py", ["--steps", "3", "--seq", "32",
                               "--hidden", "32", "--chunks", "2"]),
+    ("examples/t5_seq2seq.py", ["--steps", "3", "--batch", "4"]),
+    ("examples/rnnt_speech.py", ["--steps", "3", "--batch", "4"]),
 ])
 @pytest.mark.slow
 def test_examples_smoke(script, args):
