@@ -17,4 +17,4 @@ from apex1_tpu.models.resnet import (  # noqa: F401
 from apex1_tpu.models.t5 import (  # noqa: F401
     T5, T5Config, t5_loss_fn)
 from apex1_tpu.models.generate import (  # noqa: F401
-    generate, gpt2_decoder, llama_decoder, t5_generate)
+    beam_search, generate, gpt2_decoder, llama_decoder, t5_generate)

@@ -170,6 +170,95 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
 # model adapters
 # ---------------------------------------------------------------------------
 
+def beam_search(apply_fn: Callable, params, prompt_tokens, *,
+                max_new_tokens: int, cache, num_beams: int = 4,
+                length_penalty: float = 0.0,
+                eos_id: Optional[int] = None, pad_id: int = 0,
+                vocab_size: Optional[int] = None):
+    """Beam search over the same cached decode step as :func:`generate`.
+
+    TPU-first shape discipline: beams ride the batch axis — the cache
+    and every decode step run at batch B·K (``cache`` must be built for
+    batch ``B * num_beams``), and each step's beam reorder is one
+    gather over that axis (XLA fuses it into the cache update). Prefill
+    runs ONCE at batch B (the first B cache lanes) and the filled cache
+    is tiled K-fold; the first expansion then takes the per-batch top-K
+    tokens from that single distribution, one per lane.
+
+    Scoring: sum of token log-probs over the VALID vocab (``vocab_size``
+    masks padded-vocab logits BEFORE the softmax, as `sample_token`
+    does), normalized at the END by ``length**length_penalty``
+    (GNMT-style; 0 = pure sum) where length counts each beam's tokens
+    up to and including its ``eos_id``. Finished beams stop
+    accumulating and pad with ``pad_id``. Returns
+    (tokens (B, max_new_tokens), scores (B,)) for the best beam.
+    """
+    B, S0 = prompt_tokens.shape
+    K = num_beams
+
+    def masked_logp(logits_row):
+        lg = logits_row.astype(jnp.float32)
+        if vocab_size is not None and vocab_size < lg.shape[-1]:
+            lg = jnp.where(jnp.arange(lg.shape[-1]) < vocab_size, lg,
+                           NEG_INF)
+        return jax.nn.log_softmax(lg, -1)
+
+    # prefill once at batch B on the cache's first B lanes, tile K-fold
+    pre_cache = jax.tree_util.tree_map(lambda c: c[:B], cache)
+    logits, pre_cache = apply_fn(params, prompt_tokens, pre_cache, 0)
+    cache = jax.tree_util.tree_map(
+        lambda c: jnp.repeat(c, K, axis=0), pre_cache)
+    logp = masked_logp(logits[:, -1])                     # (B, V)
+    V = logp.shape[-1]
+    scores, nxt = jax.lax.top_k(logp, K)                  # (B, K)
+    nxt = nxt.astype(jnp.int32)
+    done = (jnp.zeros((B, K), bool) if eos_id is None
+            else (nxt == eos_id))
+    lens = jnp.ones((B, K), jnp.float32)
+
+    # static-shape token buffer: the scan carries (B*K, max_new) and
+    # writes one column per step (a growing concat would re-trace)
+    toks_buf = jnp.full((B * K, max_new_tokens), pad_id, jnp.int32)
+    toks_buf = toks_buf.at[:, 0].set(nxt.reshape(-1))
+
+    def body(carry, t):
+        nxt, idx, cache, scores, done, lens, buf = carry
+        logits, cache = apply_fn(params, nxt.reshape(B * K, 1), cache,
+                                 idx)
+        logp = masked_logp(logits[:, -1]).reshape(B, K, V)
+        # a finished beam proposes exactly one zero-score continuation
+        # (pad) so its total never moves
+        pad_row = jnp.where(jnp.arange(V) == pad_id, 0.0, NEG_INF)
+        logp = jnp.where(done[..., None], pad_row, logp)
+        cand = scores[..., None] + logp
+        new_scores, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        beam_src = flat_idx // V
+        token = (flat_idx % V).astype(jnp.int32)
+        gidx = (jnp.arange(B)[:, None] * K + beam_src).reshape(-1)
+        cache = jax.tree_util.tree_map(lambda c: c[gidx], cache)
+        done = jnp.take_along_axis(done, beam_src, axis=1)
+        lens = jnp.take_along_axis(lens, beam_src, axis=1)
+        buf = buf[gidx]
+        # the emitted token counts toward length unless the beam had
+        # already finished BEFORE this step (eos itself counts)
+        lens = lens + jnp.where(done, 0.0, 1.0)
+        if eos_id is not None:
+            done = done | (token == eos_id)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, token.reshape(-1), t, axis=1)
+        return (token, idx + 1, cache, new_scores, done, lens,
+                buf), None
+
+    (nxt, _, cache, scores, done, lens, toks_buf), _ = jax.lax.scan(
+        body, (nxt, jnp.asarray(S0, jnp.int32), cache, scores, done,
+               lens, toks_buf),
+        jnp.arange(1, max_new_tokens))
+    norm = scores / jnp.maximum(lens, 1.0) ** length_penalty
+    best = jnp.argmax(norm, axis=1)                      # (B,)
+    toks = toks_buf.reshape(B, K, -1)[jnp.arange(B), best]
+    return toks, jnp.take_along_axis(norm, best[:, None], 1)[:, 0]
+
+
 def _decoder(model, num_kv_heads: int, head_dim: int):
     """Shared (apply_fn, make_cache) builder: both models take the same
     ``positions``/``cache``/``cache_index`` kwargs, so the cached forward

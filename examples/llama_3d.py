@@ -44,6 +44,11 @@ def main():
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--schedule", default="scan",
+                    choices=("scan", "1f1b"),
+                    help="pipeline schedule: scan (remat) or the true "
+                         "staggered-fwd/bwd 1F1B (interleaved with "
+                         "--chunks > 1)")
     args = ap.parse_args()
     if args.ep > 1:
         args.moe = True
@@ -69,7 +74,8 @@ def main():
                         cp=args.cp, ep=args.ep, moe=args.moe,
                         num_chunks=args.chunks,
                         num_microbatches=args.microbatches,
-                        microbatch_size=1, learning_rate=3e-3)
+                        microbatch_size=1, learning_rate=3e-3,
+                        schedule=args.schedule)
     step, state, _ = make_train_step(cfg)
     rng = np.random.default_rng(0)
     shape = (args.microbatches, args.seq, args.dp * args.ep)
