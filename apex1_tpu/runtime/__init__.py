@@ -57,7 +57,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # must fall back to NumPy, and ctypes raises AttributeError (not
         # OSError) for missing symbols
         lib.apex1_runtime_abi_version.restype = ctypes.c_int
-        if lib.apex1_runtime_abi_version() != 3:
+        if lib.apex1_runtime_abi_version() != 4:
             return None
         i64, vp = ctypes.c_int64, ctypes.c_void_p
         lib.apex1_flatten.argtypes = [ctypes.POINTER(vp),
@@ -81,6 +81,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.apex1_loader_fetch.argtypes = [vp, i64, vp]
         lib.apex1_loader_fetch.restype = ctypes.c_int
         lib.apex1_loader_close.argtypes = [vp]
+        lib.apex1_pack_fill.argtypes = [
+            vp, vp, vp, vp, vp, vp, vp, i64, vp, vp, vp, i64, i64,
+            ctypes.c_int32, ctypes.c_int]
+        lib.apex1_pack_plan.argtypes = [
+            vp, vp, i64, i64, ctypes.c_int, vp, vp, vp, vp, vp, vp]
+        lib.apex1_pack_plan.restype = i64
         return lib
     except (OSError, AttributeError):
         return None
@@ -449,42 +455,79 @@ def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
     models like GPT-2, whose position table would otherwise be indexed
     out of bounds and silently clamped).
     """
-    rows: list[list[tuple[np.ndarray, int]]] = []  # [(chunk, pos0), ...]
+    if seq_len <= 0:
+        # must precede the native branch: apex1_pack_plan's chunk loop
+        # cannot advance at seq_len <= 0 (unbounded writes, not an error)
+        raise ValueError(f"seq_len must be positive, got {seq_len}")
+    docs = [np.ascontiguousarray(np.asarray(d).ravel(), np.int32)
+            for d in docs]
+    doc_lens = np.asarray([len(d) for d in docs], np.int64)
+    doc_starts = np.zeros(len(docs) + 1, np.int64)
+    np.cumsum(doc_lens, out=doc_starts[1:])
+    flat = (np.concatenate(docs) if docs else np.zeros(0, np.int32))
+    n_chunks = int(np.sum(-(-doc_lens // seq_len)))
+
+    if _LIB is not None:
+        # native plan (first-fit placement) + threaded fill
+        starts = np.empty(n_chunks, np.int64)
+        lens64 = np.empty(n_chunks, np.int64)
+        rows64 = np.empty(n_chunks, np.int64)
+        cols64 = np.empty(n_chunks, np.int64)
+        segs32 = np.empty(n_chunks, np.int32)
+        pos032 = np.empty(n_chunks, np.int32)
+        n = _LIB.apex1_pack_plan(
+            doc_lens.ctypes.data, doc_starts.ctypes.data, len(docs),
+            seq_len, int(restart_chunk_positions), starts.ctypes.data,
+            lens64.ctypes.data, rows64.ctypes.data, cols64.ctypes.data,
+            segs32.ctypes.data, pos032.ctypes.data)
+        tokens = np.empty((n, seq_len), np.int32)
+        segs = np.empty((n, seq_len), np.int32)
+        pos = np.empty((n, seq_len), np.int32)
+        _LIB.apex1_pack_fill(
+            flat.ctypes.data, starts.ctypes.data, lens64.ctypes.data,
+            rows64.ctypes.data, cols64.ctypes.data, segs32.ctypes.data,
+            pos032.ctypes.data, n_chunks, tokens.ctypes.data,
+            segs.ctypes.data, pos.ctypes.data, n, seq_len, pad_id,
+            _N_THREADS)
+        return tokens, segs, pos
+
+    # ---- NumPy fallback: identical first-fit policy in Python ----
     space: list[int] = []
+    fill: list[int] = []       # next free column per row
+    nseg: list[int] = []       # segments placed per row
     open_rows: list[int] = []  # bounded first-fit window: corpus-scale
     MAX_OPEN = 256             # packing stays O(chunks · MAX_OPEN)
-    for doc in docs:
-        doc = np.asarray(doc)
+    plan: list[tuple[int, int, int, int, int, int]] = []
+    for di, doc in enumerate(docs):
         for lo in range(0, len(doc), seq_len):
-            chunk = doc[lo:lo + seq_len]
+            ln = min(seq_len, len(doc) - lo)
             for r in open_rows:
-                if space[r] >= len(chunk):
-                    rows[r].append(
-                        (chunk, 0 if restart_chunk_positions else lo))
-                    space[r] -= len(chunk)
-                    if space[r] == 0:
-                        open_rows.remove(r)
+                if space[r] >= ln:
                     break
             else:
-                rows.append(
-                    [(chunk, 0 if restart_chunk_positions else lo)])
-                space.append(seq_len - len(chunk))
-                if space[-1] > 0:  # full rows never enter the window
-                    open_rows.append(len(rows) - 1)
+                r = len(space)
+                space.append(seq_len)
+                fill.append(0)
+                nseg.append(0)
+                if ln < seq_len:   # full rows never enter the window
+                    open_rows.append(r)
                     if len(open_rows) > MAX_OPEN:
-                        open_rows.pop(0)  # evict by age to stay bounded
-    n = len(rows)
+                        open_rows.pop(0)  # evict by age, stays bounded
+            plan.append((int(doc_starts[di]) + lo, ln, r, fill[r],
+                         nseg[r], 0 if restart_chunk_positions else lo))
+            space[r] -= ln
+            fill[r] += ln
+            nseg[r] += 1
+            if space[r] == 0 and r in open_rows:
+                open_rows.remove(r)
+    n = len(space)
     tokens = np.full((n, seq_len), pad_id, np.int32)
     segs = np.full((n, seq_len), -1, np.int32)
     pos = np.zeros((n, seq_len), np.int32)
-    for r, chunks in enumerate(rows):
-        off = 0
-        for sid, (chunk, pos0) in enumerate(chunks):
-            ln = len(chunk)
-            tokens[r, off:off + ln] = chunk
-            segs[r, off:off + ln] = sid
-            pos[r, off:off + ln] = np.arange(pos0, pos0 + ln)
-            off += ln
+    for start, ln, r, c, sid, pos0 in plan:
+        tokens[r, c:c + ln] = flat[start:start + ln]
+        segs[r, c:c + ln] = sid
+        pos[r, c:c + ln] = np.arange(pos0, pos0 + ln)
     return tokens, segs, pos
 
 

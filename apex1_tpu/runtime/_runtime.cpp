@@ -269,6 +269,91 @@ void apex1_loader_close(void* h) {
   delete L;
 }
 
-int apex1_runtime_abi_version() { return 3; }
+// Packed-batch PLAN (the policy half of runtime.pack_documents): greedy
+// first-fit of doc chunks over a bounded window of open rows — must
+// match the Python fallback's semantics exactly (same MAX_OPEN window,
+// same age eviction). Outputs one record per chunk into caller-allocated
+// arrays sized n_chunks = sum(ceil(len/seq_len)); returns the row count.
+int64_t apex1_pack_plan(const int64_t* doc_lens, const int64_t* doc_starts,
+                        int64_t n_docs, int64_t seq_len,
+                        int restart_positions, int64_t* starts,
+                        int64_t* lens, int64_t* row, int64_t* col,
+                        int32_t* seg, int32_t* pos0) {
+  constexpr int64_t kMaxOpen = 256;
+  std::vector<int64_t> space, fill;
+  std::vector<int32_t> nseg;
+  std::vector<int64_t> open;  // age-ordered open-row window
+  int64_t ci = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    for (int64_t lo = 0; lo < doc_lens[d]; lo += seq_len) {
+      int64_t ln = std::min(seq_len, doc_lens[d] - lo);
+      int64_t r = -1;
+      for (size_t k = 0; k < open.size(); ++k) {
+        if (space[open[k]] >= ln) { r = open[k]; break; }
+      }
+      if (r < 0) {
+        r = static_cast<int64_t>(space.size());
+        space.push_back(seq_len);
+        fill.push_back(0);
+        nseg.push_back(0);
+        if (ln < seq_len) {  // full rows never enter the window
+          open.push_back(r);
+          if (static_cast<int64_t>(open.size()) > kMaxOpen)
+            open.erase(open.begin());  // evict by age, stays bounded
+        }
+      }
+      starts[ci] = doc_starts[d] + lo;
+      lens[ci] = ln;
+      row[ci] = r;
+      col[ci] = fill[r];
+      seg[ci] = nseg[r];
+      pos0[ci] = restart_positions ? 0 : static_cast<int32_t>(lo);
+      space[r] -= ln;
+      fill[r] += ln;
+      nseg[r] += 1;
+      if (space[r] == 0) {
+        for (size_t k = 0; k < open.size(); ++k) {
+          if (open[k] == r) { open.erase(open.begin() + k); break; }
+        }
+      }
+      ++ci;
+    }
+  }
+  return static_cast<int64_t>(space.size());
+}
+
+// Packed-batch fill (the byte-moving half of runtime.pack_documents —
+// placement comes from apex1_pack_plan or the Python fallback):
+// chunk i is flat_tokens[starts[i] : starts[i]+lens[i]], destined for
+// (row[i], col[i]) with segment id seg[i] and first position pos0[i].
+// tokens/segments/positions are (n_rows, seq_len) int32; this fills the
+// pad/-1/0 background by row, then scatters all chunks — both passes
+// threaded.
+void apex1_pack_fill(const int32_t* flat_tokens, const int64_t* starts,
+                     const int64_t* lens, const int64_t* row,
+                     const int64_t* col, const int32_t* seg,
+                     const int32_t* pos0, int64_t n_chunks,
+                     int32_t* tokens, int32_t* segments,
+                     int32_t* positions, int64_t n_rows,
+                     int64_t seq_len, int32_t pad_id, int threads) {
+  parallel_for(n_rows, threads, [&](int64_t r) {
+    int32_t* t = tokens + r * seq_len;
+    int32_t* s = segments + r * seq_len;
+    int32_t* p = positions + r * seq_len;
+    for (int64_t i = 0; i < seq_len; ++i) t[i] = pad_id;
+    for (int64_t i = 0; i < seq_len; ++i) s[i] = -1;
+    std::memset(p, 0, seq_len * 4);
+  });
+  parallel_for(n_chunks, threads, [&](int64_t i) {
+    int64_t off = row[i] * seq_len + col[i];
+    std::memcpy(tokens + off, flat_tokens + starts[i], lens[i] * 4);
+    int32_t* s = segments + off;
+    int32_t* p = positions + off;
+    for (int64_t j = 0; j < lens[i]; ++j) s[j] = seg[i];
+    for (int64_t j = 0; j < lens[i]; ++j) p[j] = pos0[i] + j;
+  });
+}
+
+int apex1_runtime_abi_version() { return 4; }
 
 }  // extern "C"

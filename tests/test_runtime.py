@@ -229,6 +229,34 @@ def test_pack_documents_long_doc_positions():
     assert len(np.unique(row0)) >= 1
 
 
+def test_pack_documents_native_matches_python():
+    """The native threaded fill (apex1_pack_fill) and the NumPy fallback
+    must be byte-identical across ragged docs, long-doc chunking, and
+    both position modes."""
+    rng = np.random.default_rng(11)
+    docs = [rng.integers(1, 500, int(n)).astype(np.int32)
+            for n in rng.integers(1, 70, 300)]
+    for restart in (False, True):
+        native = rt.pack_documents(docs, 24, pad_id=7,
+                                   restart_chunk_positions=restart)
+        lib, rt._LIB = rt._LIB, None
+        try:
+            fallback = rt.pack_documents(docs, 24, pad_id=7,
+                                         restart_chunk_positions=restart)
+        finally:
+            rt._LIB = lib
+        for a, b in zip(native, fallback):
+            np.testing.assert_array_equal(a, b)
+    # total token conservation + pad marking
+    t, s, p = rt.pack_documents(docs, 24)
+    assert int((s >= 0).sum()) == sum(len(d) for d in docs)
+    assert (t[s < 0] == 0).all()
+    # must raise BEFORE reaching the native planner (whose chunk loop
+    # cannot advance at seq_len <= 0)
+    with pytest.raises(ValueError, match="seq_len"):
+        rt.pack_documents(docs, 0)
+
+
 def test_sharded_token_dataset(tmp_path):
     """Global exact shuffle over concatenated shards: one epoch covers
     every sequence of every shard exactly once; single-file dataset over
