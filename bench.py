@@ -404,27 +404,58 @@ def main():
         # so a persistent cache only cuts re-run latency, never the number
         enable_persistent_compilation_cache()
         on_accel = backend not in ("cpu",)
-        kw = {}
-        if args.config == "gpt2":
-            kw = dict(batch=args.batch, seq=args.seq)
-        (state, step, batch, units_per_step, iters, metric, unit,
-         proxy) = BENCHES[args.config](on_accel, **kw)
+        # headline auto-tune: with no explicit --batch, measure the
+        # AOT-verified batch candidates and report the best (B=16 fits
+        # at 8.2 GiB on v5e; 24 fits with margin — both sized by
+        # tools/aot_check.py). A candidate that fails (OOM on a
+        # smaller-memory pool chip) is skipped, not fatal.
+        if args.config == "gpt2" and on_accel and args.batch is None:
+            cand_batches = [16, 24]
+        else:
+            cand_batches = [args.batch]
 
-        per_step, flops_per_step = timed_steps(step, state, batch, iters)
+        best = None
+        best_rate = -1.0
+        last_err = None
+        for b in cand_batches:
+            try:
+                kw = {}
+                if args.config == "gpt2":
+                    kw = dict(batch=b, seq=args.seq)
+                (state, step, batch, units_per_step, iters, metric, unit,
+                 proxy) = BENCHES[args.config](on_accel, **kw)
+                per_step, flops_per_step = timed_steps(step, state,
+                                                       batch, iters)
+                rate = units_per_step / per_step
+                if rate > best_rate:   # unrounded comparison
+                    best_rate = rate
+                    best = {
+                        "metric": f"{metric} [{backend}]",
+                        "value": round(rate, 1),
+                        "unit": unit,
+                        "vs_baseline": round(rate / proxy, 4),
+                    }
+                    if len(cand_batches) > 1:
+                        best["batch"] = b
+                    if flops_per_step is not None and on_accel:
+                        from apex1_tpu.core.capability import (
+                            get_capability)
+                        peak = get_capability().bf16_tflops * 1e12
+                        best["mfu"] = round(
+                            flops_per_step / per_step / peak, 4)
+                        best["step_ms"] = round(per_step * 1e3, 2)
+            except TimeoutError:
+                # the watchdog fired mid-candidate; a finished earlier
+                # candidate is still a valid headline — emit it rather
+                # than discarding a good number
+                break
+            except Exception as e:  # try the remaining candidates
+                last_err = e
         signal.alarm(0)
-        rate = units_per_step / per_step
-        record = {
-            "metric": f"{metric} [{backend}]",
-            "value": round(rate, 1),
-            "unit": unit,
-            "vs_baseline": round(rate / proxy, 4),
-        }
-        if flops_per_step is not None and on_accel:
-            from apex1_tpu.core.capability import get_capability
-            peak = get_capability().bf16_tflops * 1e12
-            record["mfu"] = round(flops_per_step / per_step / peak, 4)
-            record["step_ms"] = round(per_step * 1e3, 2)
-        _emit(record)
+        if best is None:
+            raise last_err if last_err is not None else RuntimeError(
+                "no benchmark candidate ran")
+        _emit(best)
     except Exception as e:  # the line must still print on any failure
         signal.alarm(0)
         fallback["metric"] = f"{unit} {args.config} [{backend}]"
