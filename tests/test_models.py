@@ -247,3 +247,26 @@ class TestParamSpecs:
         assert specs["mlm_bias"] == P("tp")
         self._check(bert_pretrain_loss_fn(model), params, specs,
                     self._tp_mesh(), batch)
+
+    def test_t5_specs(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from apex1_tpu.models import t5 as t
+        from apex1_tpu.models.t5 import T5, T5Config, t5_loss_fn
+        cfg = T5Config.tiny()
+        model = T5(cfg)
+        rng = np.random.default_rng(0)
+        enc = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)),
+                          jnp.int32)
+        dec = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)),
+                          jnp.int32)
+        params = model.init(jax.random.key(0), enc, dec)["params"]
+        specs = t.param_specs(params)
+        assert specs["shared_embedding"] == P("tp", None)
+        assert specs["encoder"]["layer0"]["self_attn"]["wq"] == \
+            P(None, "tp")
+        assert specs["encoder"]["layer0"]["self_attn"]["wo"] == \
+            P("tp", None)
+        assert specs["encoder"]["rel_pos"]["rel_bias"] == P()
+        self._check(t5_loss_fn(model), params, specs, self._tp_mesh(),
+                    enc, dec)

@@ -153,6 +153,10 @@ class TestT5Model:
         g = jax.grad(t5_loss_fn(model))(params, enc, dec)
         assert float(jnp.max(jnp.abs(g["lm_head"]))) > 0
 
+    # TP-sharded loss parity lives in
+    # test_models.py::TestParamSpecs::test_t5_specs (the shared harness
+    # GPT-2/BERT use).
+
     def test_pallas_xla_parity(self, tiny):
         """Whole-model logits, Pallas kernels (interpret on CPU) vs XLA
         composites."""
