@@ -290,32 +290,59 @@ def gpt2_decoder(model):
 def t5_generate(model, params, enc_tokens, *, max_new_tokens: int,
                 dec_start_id: int = 0, enc_pad_mask=None,
                 temperature: float = 0.0, top_k: Optional[int] = None,
-                rng=None, eos_id: Optional[int] = None, pad_id: int = 0):
+                rng=None, eos_id: Optional[int] = None, pad_id: int = 0,
+                num_beams: int = 1, length_penalty: float = 0.0):
     """Seq2seq generation for `models.t5.T5`: encode once, then KV-cached
     decoder sampling seeded with ``dec_start_id`` (T5's decoder start =
     the pad token, id 0). Returns (B, max_new_tokens) ids. Decoder
     self-attention is cached; cross-attention recomputes K/V from the
     fixed memory each step (caching them per layer is a further
-    optimization the adapter keeps out of the model)."""
+    optimization the adapter keeps out of the model).
+
+    ``num_beams > 1`` switches to :func:`beam_search` (sampling args
+    must be defaults — beam search is deterministic): the encoder still
+    runs ONCE at batch B; its memory and ``enc_pad_mask`` are tiled
+    K-fold for the beam lanes."""
     cfg = model.cfg
+    K = num_beams
+    if K > 1 and (temperature != 0.0 or top_k is not None):
+        # validate BEFORE the encoder forward — a bad call must not pay
+        # (or OOM on) a full encode first
+        raise ValueError("beam search is deterministic — "
+                         "temperature/top_k require num_beams=1")
     bound = model.bind({"params": params})
     memory = bound.encode(enc_tokens, enc_pad_mask)
     B = enc_tokens.shape[0]
+    # beam lanes are b-major (b·K + k): prefill runs at batch B against
+    # the UNtiled memory; decode steps run at B·K against the K-fold
+    # tile (memory[:B] of the tile would be b0 repeated — wrong batch)
+    memory_tiled = jnp.repeat(memory, K, axis=0) if K > 1 else memory
+    mask_tiled = (jnp.repeat(enc_pad_mask, K, axis=0)
+                  if K > 1 and enc_pad_mask is not None else enc_pad_mask)
 
     def apply_fn(params, tokens, cache, cache_index):
+        pre = tokens.shape[0] == B
+        mem = memory if pre else memory_tiled
+        mask = enc_pad_mask if pre else mask_tiled
         return model.apply(
-            {"params": params}, tokens, memory,
-            enc_pad_mask=enc_pad_mask, cache=cache,
+            {"params": params}, tokens, mem,
+            enc_pad_mask=mask, cache=cache,
             cache_index=cache_index, method=model.decode)
 
     # 1 (start token) + max_new_tokens slots — generate() writes at
     # indices 0..prompt_len+max_new-2, but sizing to the documented
     # prompt_len + max_new_tokens contract keeps a slot of slack rather
     # than relying on the final token never being written back
-    cache = init_cache(cfg.num_decoder_layers, B, cfg.num_heads,
+    cache = init_cache(cfg.num_decoder_layers, B * K, cfg.num_heads,
                        1 + max_new_tokens, cfg.head_dim,
                        cfg.policy.compute_dtype)
     prompt = jnp.full((B, 1), dec_start_id, jnp.int32)
+    if K > 1:
+        toks, _ = beam_search(apply_fn, params, prompt,
+                              max_new_tokens=max_new_tokens, cache=cache,
+                              num_beams=K, length_penalty=length_penalty,
+                              eos_id=eos_id, pad_id=pad_id)
+        return toks
     return generate(apply_fn, params, prompt,
                     max_new_tokens=max_new_tokens, cache=cache,
                     temperature=temperature, top_k=top_k, rng=rng,

@@ -165,6 +165,47 @@ class TestT5Generate:
                                    np.asarray(full[:, -1]),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_beam_matches_hand_built_beam_path(self):
+        """t5_generate(num_beams=K) must equal beam_search driven
+        through an INDEPENDENTLY constructed cached-decode closure
+        (explicit b-major memory tiling) — catches memory/lane-ordering
+        wiring bugs in the adapter, which a K=1 comparison cannot."""
+        from apex1_tpu.models.generate import (beam_search, init_cache,
+                                               t5_generate)
+        from apex1_tpu.models.t5 import T5, T5Config
+
+        cfg = T5Config.tiny(policy=get_policy("O0"))
+        model = T5(cfg)
+        rng = np.random.default_rng(14)
+        B, K, N = 3, 2, 5
+        enc = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, 7)),
+                          jnp.int32)
+        params = model.init(
+            jax.random.key(0), enc,
+            jnp.zeros((B, 1), jnp.int32))["params"]
+        got = t5_generate(model, params, enc, max_new_tokens=N,
+                          num_beams=K)
+
+        bound = model.bind({"params": params})
+        memory = bound.encode(enc)
+        mem_tiled = jnp.repeat(memory, K, axis=0)
+
+        def apply_fn(p, tokens, cache, cache_index):
+            mem = memory if tokens.shape[0] == B else mem_tiled
+            return model.apply({"params": p}, tokens, mem, cache=cache,
+                               cache_index=cache_index,
+                               method=model.decode)
+
+        cache = init_cache(cfg.num_decoder_layers, B * K, cfg.num_heads,
+                           1 + N, cfg.head_dim, jnp.float32)
+        want, _ = beam_search(apply_fn, params,
+                              jnp.zeros((B, 1), jnp.int32),
+                              max_new_tokens=N, cache=cache, num_beams=K)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        with pytest.raises(ValueError, match="deterministic"):
+            t5_generate(model, params, enc, max_new_tokens=N,
+                        num_beams=2, temperature=0.5)
+
     def test_enc_pad_mask_respected(self):
         from apex1_tpu.models.generate import t5_generate
         from apex1_tpu.models.t5 import T5, T5Config
