@@ -8,13 +8,12 @@ The reference has no model zoo; like `models.llama` this is a standalone
 model built from the framework's fused ops:
 
 - `ops.rms_norm` (Pallas) — T5's LayerNorm is RMSNorm (no mean/bias);
-- `ops.scaled_masked_softmax` (Pallas) for the bias-bearing self-attention
-  (T5's learned relative-position bias is an additive logit mask — the
-  same contract the reference's ``scaled_masked_softmax_cuda`` kernel
-  serves; its fmha, like ours, takes no arbitrary bias, so bias-bearing
-  attention composes matmul + fused-softmax, reference
-  ``apex/transformer/functional/fused_softmax.py`` pattern);
-- `ops.flash_attention` (Pallas) for the bias-free cross-attention;
+- `ops.flash_attention` (Pallas) with its additive-``bias`` operand for
+  the bias-bearing self-attention (T5's learned relative-position bias
+  rides the flash kernel — O(S·D) activations, dbias via the kernel's
+  broadcast-accumulating backward pass — where the reference composes
+  matmul + ``scaled_masked_softmax_cuda``, materializing O(S²); its
+  fmha takes no bias at all) and for the bias-free cross-attention;
 - `ops.linear_cross_entropy` for the (tied) LM head + CE.
 
 T5-specific semantics kept faithful to the public architecture: pre-norm
@@ -36,7 +35,6 @@ from jax.sharding import PartitionSpec as P
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
 from apex1_tpu.ops import (NEG_INF, linear_cross_entropy, rms_norm,
-                           scaled_masked_softmax,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
 from apex1_tpu.transformer.tensor_parallel.random import checkpoint_policy
@@ -154,21 +152,18 @@ def _causal_mask(sq: int, sk: int):
     return jnp.where(k > q, NEG_INF, 0.0)[None, None]    # (1, 1, Sq, Sk)
 
 
-def _pad_bias(pad_mask):
-    """(B, Sk) bool keep-mask -> (B, 1, 1, Sk) additive mask."""
-    return jnp.where(pad_mask, 0.0, NEG_INF)[:, None, None, :]
-
-
 class T5Attention(nn.Module):
-    """Self- or cross-attention, T5 form (no 1/sqrt(d) scale, no biases on
-    the projections). ``bias`` is the additive logit bias/mask; when it is
-    None the Pallas flash kernel runs, otherwise matmul + Pallas fused
-    softmax (the reference's bias-bearing composition)."""
+    """Self- or cross-attention, T5 form (no 1/sqrt(d) scale, no biases
+    on the projections). Always the flash kernel: ``bias`` (rel-pos +
+    folded causal, broadcast (1, H, Sq, Sk)) rides its additive-bias
+    operand and ``kv_keep`` (a (B, Sk) bool key-padding mask) rides its
+    ``segment_ids`` — never a materialized O(B·H·S²) mask."""
 
     cfg: T5Config
 
     @nn.compact
-    def __call__(self, x, kv, bias=None, cache=None, cache_index=None):
+    def __call__(self, x, kv, bias=None, kv_keep=None, cache=None,
+                 cache_index=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         H, D = cfg.num_heads, cfg.head_dim
@@ -188,19 +183,25 @@ class T5Attention(nn.Module):
         q = (x @ wq).reshape(B, Sq, H, D).transpose(0, 2, 1, 3)
         k = (kv @ wk).reshape(B, Sk, H, D).transpose(0, 2, 1, 3)
         v = (kv @ wv).reshape(B, Sk, H, D).transpose(0, 2, 1, 3)
+        segs = None
+        if kv_keep is not None:
+            # key padding as segment ids: every query in segment 0,
+            # padded keys in segment 1 — equality masking excludes them
+            segs = (jnp.zeros((B, Sq), jnp.int32),
+                    jnp.where(kv_keep, 0, 1).astype(jnp.int32))
         new_cache = None
         if cache is not None:
             from apex1_tpu.models.generate import cached_attention
             attn, new_cache = cached_attention(
                 q, k, v, cache, cache_index, sm_scale=1.0, bias=bias)
-        elif bias is None:
-            attn = flash_attention(q, k, v, causal=False, sm_scale=1.0)
         else:
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                                preferred_element_type=jnp.float32)
-            probs = scaled_masked_softmax(
-                scores, bias.astype(jnp.float32), scale=1.0)
-            attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
+            # bias (rel-pos + folded causal) rides the flash kernel's
+            # additive-bias operand — O(S·D) activations even for the
+            # bias-bearing stacks (the kernel's dbias pass handles the
+            # rel-pos table gradient); on non-TPU backends the same call
+            # dispatches to the biased XLA composite
+            attn = flash_attention(q, k, v, causal=False, sm_scale=1.0,
+                                   bias=bias, segment_ids=segs)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, Sq, H * D)
         out = attn @ wo
         return out if new_cache is None else (out, new_cache)
@@ -234,8 +235,11 @@ class T5Block(nn.Module):
     is_decoder: bool
 
     @nn.compact
-    def __call__(self, x, bias, memory=None, mem_bias=None, cache=None,
+    def __call__(self, x, bias, memory=None, kv_keep=None, cache=None,
                  cache_index=None):
+        """``kv_keep`` (B, S_enc) bool: encoder key-padding — masks the
+        encoder self-attention's keys and the decoder cross-attention's
+        memory keys."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
 
@@ -246,9 +250,10 @@ class T5Block(nn.Module):
                 g = g.astype(dtype)
             return rms_norm(z, g, eps=cfg.norm_eps).astype(dtype)
 
-        h = T5Attention(cfg, name="self_attn")(norm("self_norm", x), None,
-                                               bias=bias, cache=cache,
-                                               cache_index=cache_index)
+        h = T5Attention(cfg, name="self_attn")(
+            norm("self_norm", x), None, bias=bias,
+            kv_keep=None if self.is_decoder else kv_keep,
+            cache=cache, cache_index=cache_index)
         new_cache = None
         if cache is not None:
             h, new_cache = h
@@ -256,7 +261,7 @@ class T5Block(nn.Module):
         if self.is_decoder:
             h = T5Attention(cfg, name="cross_attn")(
                 norm("cross_norm", x),
-                memory.astype(dtype), bias=mem_bias)
+                memory.astype(dtype), kv_keep=kv_keep)
             x = x + h.astype(x.dtype)
         h = T5FFN(cfg, name="ffn")(norm("ffn_norm", x))
         out = x + h.astype(x.dtype)
@@ -285,13 +290,9 @@ class T5Stack(nn.Module):
             bias = rel_pos(S, S)
             if self.is_decoder:
                 bias = bias + _causal_mask(S, S)
-        if self.is_decoder:
-            mem_bias = (None if enc_pad_mask is None
-                        else _pad_bias(enc_pad_mask))
-        else:
-            mem_bias = None
-            if enc_pad_mask is not None:
-                bias = bias + _pad_bias(enc_pad_mask)
+        # enc_pad_mask stays a (B, S_enc) KEY mask end to end (the flash
+        # kernel's segment_ids channel) — folding it into the additive
+        # bias would batch-expand it to O(B·H·S²)
         n_layers = (cfg.num_decoder_layers if self.is_decoder
                     else cfg.num_encoder_layers)
         block = T5Block
@@ -301,7 +302,7 @@ class T5Stack(nn.Module):
         new_cache = {}
         for i in range(n_layers):
             out = block(cfg, self.is_decoder, name=f"layer{i}")(
-                x, bias, memory, mem_bias,
+                x, bias, memory, enc_pad_mask,
                 cache=None if cache is None else cache[f"layer{i}"],
                 cache_index=cache_index)
             if cache is None:

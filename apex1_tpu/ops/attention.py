@@ -109,13 +109,16 @@ def _mask_for(qi, ki, bq, bk, *, causal, true_sq, true_sk, q_off, k_off,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
-                scale, causal, true_sq, true_sk, has_segs, n_k):
+                scale, causal, true_sq, true_sk, has_segs, has_bias, n_k):
+    rest = list(seg_and_out)
     if has_segs:
-        qseg_ref, kseg_ref, o_ref, lse_ref, acc, m_scr, l_scr = seg_and_out
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
         qseg, kseg = qseg_ref[0], kseg_ref[0]  # (bq,1), (1,bk)
     else:
-        o_ref, lse_ref, acc, m_scr, l_scr = seg_and_out
         qseg = kseg = None
+    bias_ref = rest.pop(0) if has_bias else None
+    o_ref, lse_ref, acc, m_scr, l_scr = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
@@ -133,6 +136,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
         # ~8x slower); running statistics stay fp32
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            # additive logit bias (T5 rel-pos / arbitrary masks):
+            # s = qk·scale + bias, matching scaled_masked_softmax
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
                          true_sk=true_sk, q_off=qo_ref[0, 0],
                          k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
@@ -169,13 +176,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
                    qo_ref, ko_ref, *seg_and_out,
-                   scale, causal, true_sq, true_sk, has_segs, n_k):
+                   scale, causal, true_sq, true_sk, has_segs, has_bias,
+                   n_k):
+    rest = list(seg_and_out)
     if has_segs:
-        qseg_ref, kseg_ref, dq_ref, dq_acc = seg_and_out
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
         qseg, kseg = qseg_ref[0], kseg_ref[0]
     else:
-        dq_ref, dq_acc = seg_and_out
         qseg = kseg = None
+    bias_ref = rest.pop(0) if has_bias else None
+    dq_ref, dq_acc = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
@@ -188,6 +199,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
                          true_sk=true_sk, q_off=qo_ref[0, 0],
                          k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
@@ -214,19 +227,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
                     qo_ref, ko_ref, *seg_and_out,
-                    scale, causal, true_sq, true_sk, has_segs, n_q, group):
+                    scale, causal, true_sq, true_sk, has_segs, has_bias,
+                    n_q, group):
     # Grid (b, hkv, ki, gi, qi): the GQA group axis sits between the key
     # block and the (innermost) query block, so dk/dv for one kv head
     # accumulate across the whole group in VMEM scratch and are written
     # ONCE at Hkv granularity — no (B, Hq, Sk, D) fp32 partials in HBM
     # (VERDICT r1 weak#4), and each k/v block is fetched once per group
     # sweep instead of once per q head.
+    rest = list(seg_and_out)
     if has_segs:
-        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = seg_and_out
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
         qseg, kseg = qseg_ref[0], kseg_ref[0]
     else:
-        dk_ref, dv_ref, dk_acc, dv_acc = seg_and_out
         qseg = kseg = None
+    bias_ref = rest.pop(0) if has_bias else None
+    dk_ref, dv_ref, dk_acc, dv_acc = rest
     ki, gi, qi = pl.program_id(2), pl.program_id(3), pl.program_id(4)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
@@ -240,6 +257,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
                          true_sk=true_sk, q_off=qo_ref[0, 0],
                          k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
@@ -266,6 +285,60 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     def _():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
+                  qo_ref, ko_ref, *seg_and_out,
+                  scale, causal, true_sq, true_sk, has_segs, n_r):
+    """dbias = Σ_broadcast p·(dp − δ + dlse) — one extra recompute pass.
+    Grid (Bb, Hb, qi, ki, r) with the broadcast sweep r INNERMOST: every
+    revisit of a dbias output block is consecutive, so accumulation
+    lives in VMEM scratch and each block is written once (no O(B·H·S²)
+    partials in HBM — the whole point of biasing the flash kernel)."""
+    rest = list(seg_and_out)
+    if has_segs:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+        qseg, kseg = qseg_ref[0], kseg_ref[0]
+    else:
+        qseg = kseg = None
+    bias_ref, dbias_ref, db_acc = rest
+    qi, ki, r = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(r == 0)
+    def _():
+        db_acc[...] = jnp.zeros_like(db_acc)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        # p must come from the FULL logits (qk·scale + bias) minus the
+        # saved lse, which was computed over the biased scores
+        s = s + bias_ref[0, 0].astype(jnp.float32)
+        mask = _mask_for(qi, ki, bq, bk, causal=causal, true_sq=true_sq,
+                         true_sk=true_sk, q_off=qo_ref[0, 0],
+                         k_off=ko_ref[0, 0], qseg=qseg, kseg=kseg)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+        do = do_ref[0, 0]
+        v = v_ref[0, 0]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # dS w.r.t. the PRE-scale logits s_full — no trailing ·scale
+        # (that factor belongs to d(qk), not d(bias))
+        db_acc[...] += p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0])
+
+    if causal:
+        pl.when((ki * bk + ko_ref[0, 0])
+                <= (qi * bq + bq - 1 + qo_ref[0, 0]))(compute)
+    else:
+        compute()
+
+    @pl.when(r == n_r - 1)
+    def _():
+        dbias_ref[0, 0] = db_acc[...].astype(dbias_ref.dtype)
 
 
 def _prep(q, k, v, qseg, kseg, has_segs, block_q, block_k):
@@ -350,6 +423,44 @@ def _off_arrays(q_off, k_off):
             jnp.asarray(k_off, jnp.int32).reshape(1, 1))
 
 
+def _prep_bias(bias, g):
+    """Pad the additive-bias operand to block multiples. Accepts
+    (1|B, 1|Hq, Sq, Sk); broadcast dims stay size-1 all the way into the
+    kernels via their index maps."""
+    B, Hq = g["B"], g["Hq"]
+    if bias.ndim != 4:
+        raise ValueError(f"bias must be (1|B, 1|H, Sq, Sk), got rank "
+                         f"{bias.ndim}")
+    Bb, Hb, sq, sk = bias.shape
+    if Bb not in (1, B) or Hb not in (1, Hq):
+        raise ValueError(f"bias batch/head dims {Bb, Hb} must be 1 or "
+                         f"match (B={B}, H={Hq})")
+    if (sq, sk) != (g["Sq"], g["Sk"]):
+        raise ValueError(f"bias trailing dims {sq, sk} must equal "
+                         f"(Sq={g['Sq']}, Sk={g['Sk']})")
+    bp, _ = pad_to(bias, 2, g["bq"])
+    bp, _ = pad_to(bp, 3, g["bk"])
+    return bp, Bb, Hb
+
+
+def _bias_spec(g, Bb, Hb, *, dkv=False):
+    """Bias block spec for the fwd/dq grid (b, h, qi, ki) or — with
+    ``dkv`` — the dk/dv grid (b, hkv, ki, gi, qi)."""
+    group = g["group"]
+    if dkv:
+        return pl.BlockSpec(
+            (1, 1, g["bq"], g["bk"]),
+            lambda b, hkv, ki, gi, qi: (
+                b if Bb > 1 else 0,
+                (hkv * group + gi) if Hb > 1 else 0, qi, ki),
+            memory_space=pltpu.VMEM)
+    return pl.BlockSpec(
+        (1, 1, g["bq"], g["bk"]),
+        lambda b, h, qi, ki: (b if Bb > 1 else 0, h if Hb > 1 else 0,
+                              qi, ki),
+        memory_space=pltpu.VMEM)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def _flash(q, k, v, qseg, kseg, q_off, k_off,
            scale, causal, has_segs, block_q, block_k):
@@ -359,7 +470,8 @@ def _flash(q, k, v, qseg, kseg, q_off, k_off,
 
 
 def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
-                    scale, causal, has_segs, block_q, block_k):
+                    scale, causal, has_segs, block_q, block_k,
+                    bias=None):
     qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
                                   block_q, block_k)
     q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
@@ -369,11 +481,17 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
         args += [qs, ks]
+    has_bias = bias is not None
+    if has_bias:
+        bp, Bb, Hb = _prep_bias(bias, g)
+        in_specs += [_bias_spec(g, Bb, Hb)]
+        args += [bp]
     Sqp = g["n_q"] * g["bq"]
     out_p, lse_p = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           true_sq=g["Sq"], true_sk=g["Sk"],
-                          has_segs=has_segs, n_k=g["n_k"]),
+                          has_segs=has_segs, has_bias=has_bias,
+                          n_k=g["n_k"]),
         grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
         in_specs=in_specs,
         out_specs=(q_spec, stat_spec),
@@ -399,7 +517,8 @@ def _flash_fwd(q, k, v, qseg, kseg, q_off, k_off,
     return (out, lse), (q, k, v, qseg, kseg, q_off, k_off, out, lse_p)
 
 
-def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
+def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
+                    bias=None):
     q, k, v, qseg, kseg, q_off, k_off, out, lse_p = res
     dout, dlse = cts
     qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
@@ -414,6 +533,9 @@ def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
     dlse_p, _ = pad_to(dlse.astype(jnp.float32)[..., None], 2, g["bq"])
 
     stat_args = [lse_p, dlt_p, dlse_p, *_off_arrays(q_off, k_off)]
+    has_bias = bias is not None
+    if has_bias:
+        bp, Bb, Hb = _prep_bias(bias, g)
     kern = dict(scale=scale, causal=causal, true_sq=g["Sq"],
                 true_sk=g["Sk"], has_segs=has_segs)
 
@@ -426,8 +548,12 @@ def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
         args += [qs, ks]
+    if has_bias:
+        in_specs += [_bias_spec(g, Bb, Hb)]
+        args += [bp]
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, n_k=g["n_k"], **kern),
+        functools.partial(_bwd_dq_kernel, n_k=g["n_k"],
+                          has_bias=has_bias, **kern),
         grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -448,10 +574,13 @@ def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
         args += [qs, ks]
+    if has_bias:
+        in_specs += [_bias_spec(g, Bb, Hb, dkv=True)]
+        args += [bp]
     Skp = g["n_k"] * g["bk"]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, n_q=g["n_q"], group=g["group"],
-                          **kern),
+                          has_bias=has_bias, **kern),
         grid=(g["B"], g["Hkv"], g["n_k"], g["group"], g["n_q"]),
         in_specs=in_specs,
         out_specs=(dkv_spec, dkv_spec),
@@ -466,16 +595,111 @@ def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
     )(*args)
     dk = dk[:, :, :g["Sk"], :g["D"]]
     dv = dv[:, :, :g["Sk"], :g["D"]]
+
+    dbias = None
+    if has_bias:
+        # dbias pass: grid (Bb, Hb, qi, ki, r) — the broadcast sweep r
+        # is innermost so the (bb, hb, qi, ki) output block's revisits
+        # are consecutive and accumulate in scratch
+        RB, RH = g["B"] // Bb, g["Hq"] // Hb
+        n_r = RB * RH
+
+        def bidx(bb, r):
+            return bb + (r // RH) * Bb
+
+        def hidx(hb, r):
+            return hb + (r % RH) * Hb
+
+        def spec4(blk, imap):
+            return pl.BlockSpec(blk, imap, memory_space=pltpu.VMEM)
+
+        q_spec_b = spec4((1, 1, g["bq"], g["Dp"]),
+                         lambda bb, hb, qi, ki, r:
+                         (bidx(bb, r), hidx(hb, r), qi, 0))
+        kv_spec_b = spec4((1, 1, g["bk"], g["Dp"]),
+                          lambda bb, hb, qi, ki, r:
+                          (bidx(bb, r), hidx(hb, r) // g["group"], ki, 0))
+        stat_spec_b = spec4((1, 1, g["bq"], 1),
+                            lambda bb, hb, qi, ki, r:
+                            (bidx(bb, r), hidx(hb, r), qi, 0))
+        off_spec_b = pl.BlockSpec((1, 1), lambda *_: (0, 0),
+                                  memory_space=pltpu.SMEM)
+        qseg_spec_b = spec4((1, g["bq"], 1),
+                            lambda bb, hb, qi, ki, r: (bidx(bb, r), qi, 0))
+        kseg_spec_b = spec4((1, 1, g["bk"]),
+                            lambda bb, hb, qi, ki, r: (bidx(bb, r), 0, ki))
+        bias_spec_b = spec4((1, 1, g["bq"], g["bk"]),
+                            lambda bb, hb, qi, ki, r: (bb, hb, qi, ki))
+        db_spec = spec4((1, 1, g["bq"], g["bk"]),
+                        lambda bb, hb, qi, ki, r: (bb, hb, qi, ki))
+        in_specs = [q_spec_b, kv_spec_b, kv_spec_b, q_spec_b, stat_spec_b,
+                    stat_spec_b, stat_spec_b, off_spec_b, off_spec_b]
+        args = [qp, kp, vp, dop] + stat_args
+        if has_segs:
+            in_specs += [qseg_spec_b, kseg_spec_b]
+            args += [qs, ks]
+        in_specs += [bias_spec_b]
+        args += [bp]
+        dbias_p = pl.pallas_call(
+            functools.partial(_dbias_kernel, n_r=n_r, **kern),
+            grid=(Bb, Hb, g["n_q"], g["n_k"], n_r),
+            in_specs=in_specs,
+            out_specs=db_spec,
+            out_shape=jax.ShapeDtypeStruct(
+                (Bb, Hb, Sqp, g["n_k"] * g["bk"]), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((g["bq"], g["bk"]), jnp.float32)],
+            interpret=interpret_mode(),
+        )(*args)
+        dbias = dbias_p[:, :, :g["Sq"], :g["Sk"]]
+
     f0 = lambda x: np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            f0(qseg), f0(kseg), f0(q_off), f0(k_off))
+    grads = (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+             f0(qseg), f0(kseg), f0(q_off), f0(k_off))
+    return grads, dbias
+
+
+def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
+    grads, _ = _flash_bwd_impl(scale, causal, has_segs, block_q, block_k,
+                               res, cts)
+    return grads
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
+def _flash_with_bias(q, k, v, bias, qseg, kseg, q_off, k_off,
+                     scale, causal, has_segs, block_q, block_k):
+    out, lse, _ = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
+                                  scale, causal, has_segs, block_q,
+                                  block_k, bias=bias)
+    return out, lse
+
+
+def _flash_with_bias_fwd(q, k, v, bias, qseg, kseg, q_off, k_off,
+                         scale, causal, has_segs, block_q, block_k):
+    out, lse, lse_p = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
+                                      scale, causal, has_segs,
+                                      block_q, block_k, bias=bias)
+    return (out, lse), (q, k, v, bias, qseg, kseg, q_off, k_off, out,
+                        lse_p)
+
+
+def _flash_with_bias_bwd(scale, causal, has_segs, block_q, block_k, res,
+                         cts):
+    q, k, v, bias, qseg, kseg, q_off, k_off, out, lse_p = res
+    grads, dbias = _flash_bwd_impl(
+        scale, causal, has_segs, block_q, block_k,
+        (q, k, v, qseg, kseg, q_off, k_off, out, lse_p), cts, bias=bias)
+    dq, dk, dv, fqs, fks, fqo, fko = grads
+    return (dq, dk, dv, dbias.astype(bias.dtype), fqs, fks, fqo, fko)
+
+
+_flash_with_bias.defvjp(_flash_with_bias_fwd, _flash_with_bias_bwd)
+
+
 def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
-                   with_lse=False):
+                   with_lse=False, bias=None):
     """XLA-composite gold: identical semantics incl. empty-row handling."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -485,6 +709,8 @@ def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     row = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
     mask = jnp.ones((B, 1, Sq, Sk), bool)
@@ -524,7 +750,7 @@ def _norm_segments(segment_ids, Sq, Sk):
 def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                     sm_scale: float | None = None, q_offset=0, k_offset=0,
                     block_q: int | None = None, block_k: int | None = None,
-                    return_lse: bool = False):
+                    return_lse: bool = False, bias=None):
     """Flash attention over (B, H, S, D) operands.
 
     ``segment_ids``: (B, S) int array (self-attention) or a
@@ -534,6 +760,10 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
     causal mask (used by ring/context parallelism; 0 for plain use).
     ``return_lse``: also return the fp32 logsumexp (B, H, Sq) — needed to
     merge partial-attention results (ring attention).
+    ``bias``: additive logit bias (1|B, 1|H, Sq, Sk) — T5-style relative
+    position bias or an arbitrary additive mask; differentiable (dbias
+    via a dedicated broadcast-accumulating backward pass), so the O(S²)
+    composite path is never needed for bias-bearing attention.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("expected (B, H, S, D) operands")
@@ -545,16 +775,37 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
     block_q, block_k = _auto_blocks(q.shape[3], block_q, block_k)
     has_segs, qseg, kseg = _norm_segments(segment_ids, q.shape[2],
                                           k.shape[2])
+    if bias is not None:
+        # validate for BOTH backends: a bias shape the kernel rejects
+        # must not silently broadcast on the XLA fallback (code
+        # validated on CPU would then crash on TPU)
+        B, Hq, Sq = q.shape[0], q.shape[1], q.shape[2]
+        Sk = k.shape[2]
+        if bias.ndim != 4:
+            raise ValueError(f"bias must be (1|B, 1|H, Sq, Sk), got "
+                             f"rank {bias.ndim}")
+        if (bias.shape[0] not in (1, B) or bias.shape[1] not in (1, Hq)
+                or bias.shape[2:] != (Sq, Sk)):
+            raise ValueError(f"bias shape {bias.shape} must be "
+                             f"(1|{B}, 1|{Hq}, {Sq}, {Sk})")
     if use_pallas():
         dummy = jnp.zeros((1, 1), jnp.int32)
-        out, lse = _flash(q, k, v,
-                          qseg if has_segs else dummy,
-                          kseg if has_segs else dummy,
-                          q_offset, k_offset,
-                          scale, causal, has_segs, block_q, block_k)
+        if bias is not None:
+            out, lse = _flash_with_bias(
+                q, k, v, bias,
+                qseg if has_segs else dummy,
+                kseg if has_segs else dummy,
+                q_offset, k_offset,
+                scale, causal, has_segs, block_q, block_k)
+        else:
+            out, lse = _flash(q, k, v,
+                              qseg if has_segs else dummy,
+                              kseg if has_segs else dummy,
+                              q_offset, k_offset,
+                              scale, causal, has_segs, block_q, block_k)
     else:
         out, lse = _xla_attention(q, k, v, qseg, kseg, q_offset, k_offset,
-                                  scale, causal, with_lse=True)
+                                  scale, causal, with_lse=True, bias=bias)
     return (out, lse) if return_lse else out
 
 

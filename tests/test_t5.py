@@ -158,20 +158,31 @@ class TestT5Model:
     # GPT-2/BERT use).
 
     def test_pallas_xla_parity(self, tiny):
-        """Whole-model logits, Pallas kernels (interpret on CPU) vs XLA
-        composites."""
+        """Whole-model loss AND grads, Pallas kernels (interpret on CPU)
+        vs XLA composites — WITH a padded encoder batch, so the
+        bias-bearing flash self-attention, the rel-pos dbias pass, and
+        the segment-ids key-padding path are all on the Pallas route
+        (a (B,1,1,Sk) mask once crashed exactly here)."""
         from apex1_tpu.ops import _common
         cfg, model, params, enc, dec = tiny
+        mask = jnp.asarray([[True] * 9 + [False] * 3, [True] * 12])
 
-        def logits_with(impl):
+        def loss_grads(impl):
             def f(params):
                 with _common.force_impl(impl):
-                    return model.apply({"params": params}, enc, dec)
-            return f(params)
+                    return t5_loss_fn(model)(params, enc, dec,
+                                             enc_pad_mask=mask)
+            return jax.value_and_grad(f)(params)
 
-        np.testing.assert_allclose(np.asarray(logits_with("pallas")),
-                                   np.asarray(logits_with("xla")),
-                                   rtol=2e-4, atol=2e-4)
+        lp, gp = loss_grads("pallas")
+        lx, gx = loss_grads("xla")
+        np.testing.assert_allclose(float(lp), float(lx), rtol=2e-4)
+        for (path, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(gp),
+                jax.tree_util.tree_leaves_with_path(gx)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg=jax.tree_util.keystr(path))
 
 
 class TestT5AmpStep:

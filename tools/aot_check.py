@@ -170,6 +170,14 @@ def main():
               [gq, gkv, gkv])
         check("flash longctx GQA (Hq32/Hkv4,16k,64) fwd+bwd", fa,
               [gq, gkv, gkv], grad=True)
+        # additive-bias flash (T5 rel-pos path): dbias rides the extra
+        # broadcast-accumulating backward pass — bias replicated (head
+        # bias shared across the dp shards)
+        fab = lambda q, k, v, b: flash_attention(q, k, v, bias=b)
+        bshp = (2, 8, 1024, 64)
+        check("flash bias T5-ish (2,8,1024,64) fwd+bwd", fab,
+              [bshp, bshp, bshp, (1, 8, 1024, 1024)],
+              in_specs=(P("dp"), P("dp"), P("dp"), P()), grad=True)
 
         T, Hid, V = 16 * 1023, 768, 50432
         check(f"linear_xent gpt2 ({T},{Hid},{V}) fwd+bwd",

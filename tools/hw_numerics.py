@@ -201,6 +201,10 @@ def _sweep(backend):
           lambda q, k, v: ops.flash_attention(q, k, v, causal=True,
                                               segment_ids=segs),
           (q, k, v), grad_argnums=(0, 1, 2))
+    att_bias = jnp.asarray(rng.normal(size=(1, H, S, S)), jnp.float32)
+    check("flash_fwd_bwd_bias",
+          lambda q, k, v, b: ops.flash_attention(q, k, v, bias=b),
+          (q, k, v, att_bias), grad_argnums=(0, 1, 2, 3))
     check("flash_fwd_ring_offset",
           lambda q, k, v: ops.flash_attention(
               q, k, v, causal=True, q_offset=S, k_offset=0,
