@@ -46,15 +46,16 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     (B, Hkv, S_max, D); ``cache_index`` is the (traced) write position.
 
     - Prefill (S > 1): must start from an empty cache at index 0 — runs
-      the normal causal flash kernel over the current tokens (or, with
-      ``bias``, the bias-bearing composite — T5's rel-pos path) and
-      writes them into the cache.
+      the causal flash kernel over the current tokens (with ``bias``
+      riding its additive-bias operand — T5's rel-pos path stays
+      O(S·D)) and writes them into the cache.
     - Decode (S == 1): composite matvec attention over the cache, masked
       to positions ≤ cache_index (static S_max — no dynamic shapes).
 
     ``bias``: additive logit bias. For prefill, shaped over the CURRENT
-    tokens (1, H, S, S) with the causal mask already folded in; for
-    decode, the query row vs all cache slots (1, H, 1, S_max).
+    tokens (1, H, S, S) (causality comes from the kernel's causal flag,
+    not the bias); for decode, the query row vs all cache slots
+    (1, H, 1, S_max).
 
     Returns (attn (B, H, S, D), new_cache_entry).
     """
@@ -67,18 +68,10 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
         cache["v"], v_new.astype(cache["v"].dtype), (0, 0, idx, 0))
     new_entry = {"k": k_all, "v": v_all}
     if S > 1:
-        if bias is None:
-            attn = flash_attention(q, k_new, v_new, causal=True,
-                                   sm_scale=sm_scale)
-        else:
-            from apex1_tpu.ops import scaled_masked_softmax
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_new,
-                                preferred_element_type=jnp.float32)
-            scale = (D ** -0.5) if sm_scale is None else sm_scale
-            probs = scaled_masked_softmax(
-                scores, bias.astype(jnp.float32), scale=scale)
-            attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype),
-                              v_new)
+        # prefill is always autoregressive; with bias the flash kernel's
+        # additive-bias operand keeps this O(S·D) too
+        attn = flash_attention(q, k_new, v_new, causal=True,
+                               sm_scale=sm_scale, bias=bias)
         return attn, new_entry
     scale = (D ** -0.5) if sm_scale is None else sm_scale
     # GQA without materializing a repeated cache: group the q heads onto

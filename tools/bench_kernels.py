@@ -92,6 +92,38 @@ def bench_attn(shape):
             print(f"  flash bq={bq} bk={bk}: {type(e).__name__}: "
                   f"{str(e)[:120]}", flush=True)
 
+    # additive-bias A/B (T5 rel-pos path): flash+bias (O(S·D) activations
+    # + the dbias pass) vs the biased XLA composite (O(S²) scores) —
+    # the number behind docs/ops.md's bias-row claim. Skipped at long-ctx
+    # shapes: the (1, H, S, S) bias itself is O(S²) host memory (~17 GiB
+    # at 16k), so the A/B is only meaningful at rel-pos-scale S
+    if S > 4096:
+        print(f"  (bias A/B skipped at S={S}: the bias operand itself "
+              f"is O(S²))", flush=True)
+        return
+    bias = jnp.asarray(
+        rng.normal(size=(1, H, S, S)).astype(np.float32), jnp.bfloat16)
+
+    def xla_bias_grad(q, k, v, b):
+        return jax.grad(lambda q, k, v, b: jnp.sum(
+            _xla_attention(q, k, v, None, None, 0, 0, 0.125, False,
+                           bias=b).astype(jnp.float32)),
+            argnums=(0, 1, 2, 3))(q, k, v, b)
+
+    def flash_bias_grad(q, k, v, b):
+        return jax.grad(lambda q, k, v, b: jnp.sum(
+            flash_attention(q, k, v, bias=b).astype(jnp.float32)),
+            argnums=(0, 1, 2, 3))(q, k, v, b)
+
+    for name, fn in (("xla +bias fwd+bwd", xla_bias_grad),
+                     ("flash +bias fwd+bwd", flash_bias_grad)):
+        try:
+            dt = timeit(fn, q, k, v, bias)
+            print(f"  {name:22s} {dt*1e3:8.2f} ms", flush=True)
+        except Exception as e:
+            print(f"  {name}: {type(e).__name__}: {str(e)[:120]}",
+                  flush=True)
+
 
 def bench_xent(T, H, V):
     from apex1_tpu.ops.linear_xent import (_xla_linear_xent,
