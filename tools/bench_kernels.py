@@ -284,6 +284,10 @@ if __name__ == "__main__":
                              "xent_plain", "dense", "opt", "all"])
     ap.add_argument("--llama", action="store_true",
                     help="long-context llama shapes instead of GPT-2")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny shapes for the CPU rehearsal of the "
+                         "tpu_watch queue — validates every code path, "
+                         "not the timings")
     args = ap.parse_args()
     from apex1_tpu.testing import (enable_persistent_compilation_cache,
                                    honor_jax_platforms_env)
@@ -293,31 +297,36 @@ if __name__ == "__main__":
     # numbers — it only makes a resumed sweep after a tunnel death cheap
     enable_persistent_compilation_cache()
     print(f"backend={jax.default_backend()}", flush=True)
-    if args.llama:
+    if args.tiny:
+        attn_shape, xent = (1, 2, 256, 64), (256, 128, 512)
+        norm_shape, sm_shape = (256, 128), (1, 2, 128)
+        rope_shape, xp_shape = (1, 256, 2, 256), (256, 512)
+        dense_shape, opt_shape = (256, 128, 256), (4, (64, 32))
+    elif args.llama:
         attn_shape, xent = (1, 32, 16384, 64), (4096, 2048, 32000)
+        norm_shape, sm_shape = (16384, 2048), (8, 12, 1024)
+        rope_shape, xp_shape = (1, 16384, 32, 64), (4096, 32000)
+        dense_shape, opt_shape = (16384, 2048, 5632), (32, (2048, 2048))
     else:
         attn_shape, xent = (8, 12, 1024, 64), (8184, 768, 50432)
+        norm_shape, sm_shape = (8192, 768), (8, 12, 1024)
+        rope_shape, xp_shape = (1, 1024, 12, 64), (8184, 50432)
+        dense_shape, opt_shape = (16384, 768, 3072), (148, (1024, 768))
     if args.what in ("attn", "all"):
         bench_attn(attn_shape)
     if args.what in ("xent", "all"):
         bench_xent(*xent)
     if args.what in ("norm", "all"):
-        bench_norm(8192 if not args.llama else 16384,
-                   768 if not args.llama else 2048)
+        bench_norm(*norm_shape)
     if args.what in ("softmax", "all"):
-        # GPT-2 shape in both modes: the llama 16k score matrix would
+        # GPT-2 shape in llama mode too: the llama 16k score matrix would
         # materialize (1,32,16k,16k) fp32 = 32 GiB — flash owns that case
-        bench_softmax(8, 12, 1024)
+        bench_softmax(*sm_shape)
     if args.what in ("rope", "all"):
-        bench_rope(1, 16384 if args.llama else 1024,
-                   32 if args.llama else 12, 64)
+        bench_rope(*rope_shape)
     if args.what in ("xent_plain", "all"):
-        bench_xent_plain(*((4096, 32000) if args.llama else (8184, 50432)))
+        bench_xent_plain(*xp_shape)
     if args.what in ("dense", "all"):
-        if args.llama:
-            bench_dense(16384, 2048, 5632)
-        else:
-            bench_dense(16384, 768, 3072)
+        bench_dense(*dense_shape)
     if args.what in ("opt", "all"):
-        bench_opt(*(((32, (2048, 2048)) if args.llama else
-                     (148, (1024, 768)))))
+        bench_opt(*opt_shape)

@@ -44,6 +44,7 @@ def probe(timeout_s=150.0):
 
 
 RESULTS = []
+ONLY = None  # --only substring filter; None = run every check
 
 
 class _Watchdog(BaseException):
@@ -65,6 +66,8 @@ def check(name, fn, pallas_args, gold_args=None, tol=2e-2, grad_tol=5e-2,
 
     from apex1_tpu.ops import force_impl
 
+    if ONLY is not None and ONLY not in name:
+        return
     gold_args = gold_args if gold_args is not None else pallas_args
     t0 = time.time()
     try:
@@ -134,7 +137,13 @@ def main():
                     help="smoke-test the harness on CPU (Pallas runs in "
                          "interpret mode — validates the script, not "
                          "Mosaic numerics)")
+    ap.add_argument("--only", default=None,
+                    help="run only checks whose name contains this "
+                         "substring (e.g. 'bias' for the one check added "
+                         "after the round-3 hardware window)")
     args = ap.parse_args()
+    global ONLY
+    ONLY = args.only
 
     backend = probe()
     if backend is None or (backend == "cpu" and not args.allow_cpu):
@@ -159,13 +168,15 @@ def main():
         timed_out = True  # partial RESULTS still get summarized
     signal.alarm(0)
     n_fail = sum(not r["ok"] for r in RESULTS)
+    # an --only filter that matches nothing must not read as a pass
+    ran_any = len(RESULTS) > 0
     print(json.dumps({
-        "ok": n_fail == 0 and not timed_out, "backend": backend,
+        "ok": n_fail == 0 and not timed_out and ran_any, "backend": backend,
         "timed_out": timed_out,
         "n_pass": len(RESULTS) - n_fail, "n_fail": n_fail,
         "failures": [r["name"] for r in RESULTS if not r["ok"]],
     }), flush=True)
-    return 0 if (n_fail == 0 and not timed_out) else 1
+    return 0 if (n_fail == 0 and not timed_out and ran_any) else 1
 
 
 def _sweep(backend):

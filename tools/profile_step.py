@@ -32,10 +32,21 @@ def build_step(config):
 def parse_xspace(path):
     """Walk the XSpace proto: planes -> lines -> events; return
     [(plane_name, line_name, event_name, total_ps, count)] aggregated."""
-    try:
-        from tensorboard_plugin_profile.protobuf import xplane_pb2
-    except ImportError:
-        from xprof.protobuf import xplane_pb2  # type: ignore
+    # import-location roulette across TF/profiler versions; this image
+    # ships it under tensorflow.tsl (verified in the r4 CPU rehearsal —
+    # the first two locations exist but are empty namespace dirs)
+    xplane_pb2 = None
+    for modname in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                    "tensorboard_plugin_profile.protobuf.xplane_pb2",
+                    "xprof.protobuf.xplane_pb2"):
+        try:
+            import importlib
+            xplane_pb2 = importlib.import_module(modname)
+            break
+        except ImportError:
+            continue
+    if xplane_pb2 is None:
+        raise ImportError("no xplane_pb2 proto module found")
     data = open(path, "rb").read()
     if path.endswith(".gz"):
         data = gzip.decompress(data)
@@ -62,6 +73,21 @@ def main():
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--steps", type=int, default=3)
     args = ap.parse_args()
+
+    # Honor JAX_PLATFORMS despite the container sitecustomize pinning
+    # jax_platforms=axon,cpu (the r4 CPU rehearsal caught this script
+    # initializing the axon backend under JAX_PLATFORMS=cpu and hanging
+    # on the dead tunnel), and probe the backend in a killable
+    # SUBPROCESS first — in-process init on a dead tunnel blocks
+    # uninterruptibly and would eat the whole tpu_watch phase budget.
+    from apex1_tpu.testing import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    import bench
+    backend, probe_stderr = bench.probe_backend()
+    if backend is None:
+        print(f"backend init unreachable; last stderr: {probe_stderr}",
+              flush=True)
+        sys.exit(1)
 
     print(f"backend={jax.default_backend()}", flush=True)
     jstep, state, batch = build_step(args.config)
