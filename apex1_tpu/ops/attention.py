@@ -53,8 +53,11 @@ def _block(size: int, requested: int) -> int:
 
 def _auto_blocks(D, block_q, block_k):
     """Default block sizes. Small tiles (128×128) make the grid huge and
-    the per-step MXU work tiny — grid/DMA overheads then dominate (measured
-    ~5× on GPT-2 shapes, v5e). Defaults target a ≤1 MiB fp32 score tile
+    the per-step MXU work tiny — grid/DMA overheads then dominate (round-1
+    v5e profile attributed ~5× to the 128×128 grid on GPT-2 shapes,
+    BASELINE.md "Round 1 measurements"; raw trace not retained, the block
+    sweep in tools/bench_kernels.py re-measures). Defaults target a ≤1 MiB
+    fp32 score tile
     (512×512) and shrink with the padded head dim so q/k/v blocks +
     accumulators + double-buffered operands stay inside the generation's
     VMEM budget (`core.capability.vmem_budget` — the runtime analog of the

@@ -181,8 +181,9 @@ def _auto_blocks(Hp, block_t, block_v):
     acc_budget = vmem_budget() // 4
     # BOTH fp32 accumulators (dx (bt, Hp) + dw (bv, Hp)) share the frame
     # with double-buffered operand tiles; bound their SUM, with the 3/4
-    # headroom measured on v5p at H=4096 (bt+bv=512 OOMs, 384 fits —
-    # AOT-verified in tools/aot_check.py --flagship)
+    # headroom established by AOT memory analysis at H=4096 (bt+bv=512
+    # OOMs, 384 fits — tools/aot_check.py --flagship,
+    # perf_results/aot_full_r3.log; not yet timed on hardware)
     cap_total = max(32, int(acc_budget * 0.75) // (4 * Hp) // 16 * 16)
     bt = block_t if block_t is not None else min(
         256, max(16, cap_total // 3 // 16 * 16))
@@ -200,7 +201,7 @@ def _auto_blocks(Hp, block_t, block_v):
             for name, val, req in (("block_t", bt, block_t),
                                    ("block_v", bv, block_v)))
         warnings.warn(
-            f"linear_cross_entropy: {desc} exceed the measured VMEM "
+            f"linear_cross_entropy: {desc} exceed the AOT-verified VMEM "
             f"headroom ({cap_total} rows at Hp={Hp}) for this TPU "
             f"generation — expect Mosaic VMEM OOM; drop the explicit "
             f"block(s) to use auto sizing", stacklevel=3)
