@@ -135,6 +135,7 @@ def make_hybrid_mesh(
     dcn_dp: int = 1,
     devices: Sequence[jax.Device] | None = None,
     process_is_granule: bool = False,
+    granule_ids: Sequence[int] | None = None,
     **axis_sizes: int,
 ) -> Mesh:
     """Multi-slice mesh: the outer data-parallel axis rides DCN (slice to
@@ -152,6 +153,13 @@ def make_hybrid_mesh(
     Call from a multi-controller job after ``jax.distributed.initialize``
     (`parallel.multiproc`); ``process_is_granule=True`` is the fallback
     for platforms without ``slice_index`` device attributes.
+
+    ``granule_ids``: explicit per-device slice assignment (one id in
+    ``[0, dcn_dp)`` per device, in ``devices`` order). For virtual/CPU
+    topologies whose devices carry neither ``slice_index`` nor distinct
+    ``process_index`` — e.g. the 8-device CPU mesh the dryrun and tests
+    run on — this builds the same slice-major dp ordering with REAL
+    (runnable) devices, which the FakeDev path cannot.
     """
     config, devices = _normalize_mesh_args(config, axis_sizes, devices)
     if dcn_dp < 1:
@@ -164,6 +172,29 @@ def make_hybrid_mesh(
             "slices")
     per_slice = len(devices) // dcn_dp
     config = config.resolve(per_slice)
+    dp_axis = MESH_AXES.index(AXIS_DP)
+    if granule_ids is not None:
+        if len(granule_ids) != len(devices):
+            raise ValueError(
+                f"granule_ids has {len(granule_ids)} entries for "
+                f"{len(devices)} devices")
+        slices: list[list] = [[] for _ in range(dcn_dp)]
+        for d, g in zip(devices, granule_ids):
+            if not 0 <= g < dcn_dp:
+                raise ValueError(f"granule id {g} outside [0, {dcn_dp})")
+            slices[g].append(d)
+        if any(len(s) != per_slice for s in slices):
+            raise ValueError(
+                f"granule_ids must assign exactly {per_slice} devices per "
+                f"slice, got {[len(s) for s in slices]}")
+        # slice-major dp: stack each slice's ICI mesh along the dp axis,
+        # so dp index a // ici_dp = slice — identical ordering semantics
+        # to create_hybrid_device_mesh
+        per_arrays = [
+            np.asarray(make_mesh(config, devices=s).devices)
+            for s in slices]
+        dev_array = np.concatenate(per_arrays, axis=dp_axis)
+        return Mesh(dev_array, MESH_AXES)
     from jax.experimental import mesh_utils
 
     dcn_shape = tuple(dcn_dp if ax == AXIS_DP else 1 for ax in MESH_AXES)

@@ -69,6 +69,39 @@ class TestMesh:
             slices = {d.slice_index for d in arr[a].ravel()}
             assert slices == {a}, f"dp index {a} spans slices {slices}"
 
+    def test_hybrid_mesh_granule_ids_runnable(self, devices):
+        """granule_ids builds the slice-major dp order from REAL devices
+        (virtual CPU devices carry no slice_index), so the hybrid mesh is
+        runnable — a psum over the DCN-outer dp axis must execute."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as Ps
+
+        devs = list(devices)[:8]
+        m = mesh_lib.make_hybrid_mesh(
+            MeshConfig(dp=1, pp=2, tp=2), dcn_dp=2, devices=devs,
+            granule_ids=[i // 4 for i in range(8)])
+        assert m.shape["dp"] == 2
+        arr = np.asarray(m.devices)
+        dp_ax = mesh_lib.MESH_AXES.index("dp")
+        for a in range(2):
+            ids = {d.id for d in np.take(arr, a, axis=dp_ax).ravel()}
+            want = {d.id for d in devs[a * 4:(a + 1) * 4]}
+            assert ids == want, f"dp index {a} not slice-major: {ids}"
+
+        def f(x):
+            return jax.lax.psum(x, "dp")
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=m, in_specs=Ps("dp"), out_specs=Ps()))(
+                jnp.arange(2, dtype=jnp.float32))
+        assert float(out[0]) == 1.0  # 0 + 1 across the DCN-outer axis
+
+        with pytest.raises(ValueError, match="granule"):
+            mesh_lib.make_hybrid_mesh(
+                MeshConfig(dp=1, pp=2, tp=2), dcn_dp=2, devices=devs,
+                granule_ids=[0] * 8)
+
     def test_hybrid_mesh_single_slice_delegates(self, devices):
         m = mesh_lib.make_hybrid_mesh(dcn_dp=1, dp=2, tp=4)
         assert m.shape["dp"] == 2 and m.shape["tp"] == 4
