@@ -40,7 +40,8 @@ def init_cache(num_layers: int, batch: int, num_kv_heads: int,
 
 
 def cached_attention(q, k_new, v_new, cache, cache_index, *,
-                     sm_scale: Optional[float] = None, bias=None):
+                     sm_scale: Optional[float] = None, bias=None,
+                     segment_ids=None, valid_start=None):
     """Attention through the KV cache. ``q``/``k_new``/``v_new``:
     (B, H, S, D)/(B, Hkv, S, D) for the CURRENT tokens; ``cache`` holds
     (B, Hkv, S_max, D); ``cache_index`` is the (traced) write position.
@@ -57,6 +58,13 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     not the bias); for decode, the query row vs all cache slots
     (1, H, 1, S_max).
 
+    RAGGED batches (left-padded prompts of different lengths — see
+    ``generate(prompt_lens=...)``): ``segment_ids`` (B, S) rides the
+    flash kernel's varlen operand at prefill so pad and real tokens
+    never attend across; ``valid_start`` (B,) masks decode attention to
+    cache slots ≥ each row's first real position (the left-pad K/V slots
+    are garbage by construction).
+
     Returns (attn (B, H, S, D), new_cache_entry).
     """
     B, Hq, S, D = q.shape
@@ -68,10 +76,21 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
         cache["v"], v_new.astype(cache["v"].dtype), (0, 0, idx, 0))
     new_entry = {"k": k_all, "v": v_all}
     if S > 1:
+        # prefill attends only over the CURRENT tokens — valid only from
+        # an empty cache. Fail fast on a concrete nonzero index (the
+        # common prefill call passes a Python 0); a traced nonzero index
+        # remains the documented precondition (ADVICE r3).
+        if isinstance(cache_index, int) and cache_index != 0:
+            raise ValueError(
+                f"cached_attention prefill (S={S} > 1) requires an empty "
+                f"cache at cache_index 0, got {cache_index} — it attends "
+                f"only over the new tokens, so a non-empty cache would "
+                f"be silently ignored")
         # prefill is always autoregressive; with bias the flash kernel's
         # additive-bias operand keeps this O(S·D) too
         attn = flash_attention(q, k_new, v_new, causal=True,
-                               sm_scale=sm_scale, bias=bias)
+                               sm_scale=sm_scale, bias=bias,
+                               segment_ids=segment_ids)
         return attn, new_entry
     scale = (D ** -0.5) if sm_scale is None else sm_scale
     # GQA without materializing a repeated cache: group the q heads onto
@@ -89,8 +108,11 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
             bias.shape[0], Hkv, group, S, -1)
     S_max = k_all.shape[2]
     pos = jnp.arange(S_max)
-    scores_b = jnp.where(pos[None, None, None, None, :] <= idx, scores_b,
-                         NEG_INF)
+    keep = pos[None, None, None, None, :] <= idx
+    if valid_start is not None:
+        keep = keep & (pos[None, None, None, None, :]
+                       >= valid_start.reshape(B, 1, 1, 1, 1))
+    scores_b = jnp.where(keep, scores_b, NEG_INF)
     probs = jax.nn.softmax(scores_b, axis=-1).astype(q.dtype)
     attn = jnp.einsum("bhgsk,bhkd->bhgsd", probs, v_all)
     return attn.reshape(B, Hq, S, D), new_entry
@@ -111,7 +133,17 @@ def sample_token(logits, rng, *, temperature: float = 0.0,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # clamp to the VALID vocab: a larger top_k would (a) raise an
+        # opaque trace-time IndexError past the full width and (b) pick
+        # a NEG_INF masked-tail entry as the kth threshold, silently
+        # disabling truncation (ADVICE r3)
+        eff_v = logits.shape[-1]
+        if vocab_size is not None and vocab_size < eff_v:
+            eff_v = vocab_size
+        k = min(int(top_k), eff_v)
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits >= kth, logits, NEG_INF)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
@@ -120,7 +152,7 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
              max_new_tokens: int, cache,
              temperature: float = 0.0, top_k: Optional[int] = None,
              rng=None, eos_id: Optional[int] = None, pad_id: int = 0,
-             vocab_size: Optional[int] = None):
+             vocab_size: Optional[int] = None, prompt_lens=None):
     """Prefill + single-dispatch decode loop.
 
     ``apply_fn(params, tokens, cache, cache_index) -> (logits, cache)``
@@ -129,6 +161,20 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     max_new_tokens. Returns (B, max_new_tokens) generated ids; sequences
     that emit ``eos_id`` are padded with ``pad_id`` afterwards.
 
+    RAGGED batches: pass ``prompt_lens`` (B,) with ``prompt_tokens``
+    right-padded to a common S0. TPU-first shape discipline — instead of
+    per-row dynamic cache indices (a scatter per step), rows are
+    LEFT-aligned once up front so every row's last real token sits at
+    S0−1: the cache write index stays one scalar, decode steps stay one
+    ``dynamic_update_slice``, and the pad prefix is masked out by the
+    flash kernel's ``segment_ids`` at prefill and a per-row
+    ``valid_start`` at decode (garbage pad K/V slots are never read).
+    Each row's positions count from ITS OWN start (RoPE/learned
+    positions see 0..len−1), so short rows decode exactly as if they
+    were alone. Requires an ``apply_fn`` with the
+    ``positions``/``segment_ids``/``valid_start`` kwargs
+    (`gpt2_decoder`/`llama_decoder` provide them).
+
     The decode loop is a ``lax.scan`` — jit the whole call (e.g.
     ``jax.jit(functools.partial(generate, apply_fn, max_new_tokens=...,
     ...))``) for one-dispatch generation.
@@ -136,7 +182,22 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     B, S0 = prompt_tokens.shape
     if rng is None:
         rng = jax.random.key(0)
-    logits, cache = apply_fn(params, prompt_tokens, cache, 0)
+    kw = {}
+    lens = None
+    if prompt_lens is not None:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        pad = S0 - lens                             # left-pad widths (B,)
+        # left-align: row b shifts right by pad_b (one gather); the
+        # wrapped-in entries land in the pad region and are masked
+        gidx = (jnp.arange(S0)[None, :] - pad[:, None]) % S0
+        prompt_tokens = jnp.take_along_axis(prompt_tokens, gidx, axis=1)
+        kw = dict(
+            positions=jnp.maximum(
+                jnp.arange(S0)[None, :] - pad[:, None], 0),
+            segment_ids=(jnp.arange(S0)[None, :]
+                         >= pad[:, None]).astype(jnp.int32),
+            valid_start=pad)
+    logits, cache = apply_fn(params, prompt_tokens, cache, 0, **kw)
     rng, sub = jax.random.split(rng)
     nxt = sample_token(logits[:, -1], sub, temperature=temperature,
                        top_k=top_k, vocab_size=vocab_size)
@@ -144,7 +205,14 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
 
     def body(carry, _):
         tok, idx, cache, rng, done = carry
-        logits, cache = apply_fn(params, tok[:, None], cache, idx)
+        if lens is None:
+            dkw = {}
+        else:
+            # per-row positions continue each row's own count; the scalar
+            # cache index keeps advancing uniformly past S0
+            dkw = dict(positions=(lens + (idx - S0))[:, None],
+                       valid_start=S0 - lens)
+        logits, cache = apply_fn(params, tok[:, None], cache, idx, **dkw)
         rng, sub = jax.random.split(rng)
         new = sample_token(logits[:, -1], sub, temperature=temperature,
                            top_k=top_k, vocab_size=vocab_size)
@@ -180,11 +248,16 @@ def beam_search(apply_fn: Callable, params, prompt_tokens, *,
 
     Scoring: sum of token log-probs over the VALID vocab (``vocab_size``
     masks padded-vocab logits BEFORE the softmax, as `sample_token`
-    does), normalized at the END by ``length**length_penalty``
-    (GNMT-style; 0 = pure sum) where length counts each beam's tokens
-    up to and including its ``eos_id``. Finished beams stop
-    accumulating and pad with ``pad_id``. Returns
-    (tokens (B, max_new_tokens), scores (B,)) for the best beam.
+    does). With ``length_penalty`` > 0, candidates compete at EVERY
+    step on GNMT length-normalized scores ``sum / length**penalty``
+    (length counts each beam's tokens up to and including its
+    ``eos_id``), so a short finished hypothesis is never pruned by a
+    longer unfinished one merely for having fewer summed terms; the
+    carried scores stay unnormalized sums so accumulation is exact.
+    ``length_penalty=0`` reduces to pure-sum ranking. Finished beams
+    stop accumulating and pad with ``pad_id``. Returns
+    (tokens (B, max_new_tokens), scores (B,)) for the best beam, scored
+    by the same normalization.
     """
     B, S0 = prompt_tokens.shape
     K = num_beams
@@ -224,7 +297,15 @@ def beam_search(apply_fn: Callable, params, prompt_tokens, *,
         pad_row = jnp.where(jnp.arange(V) == pad_id, 0.0, NEG_INF)
         logp = jnp.where(done[..., None], pad_row, logp)
         cand = scores[..., None] + logp
-        new_scores, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        # rank on length-normalized scores (ADVICE r3: pure-sum in-beam
+        # pruning under length_penalty > 0 let longer unfinished beams
+        # evict shorter finished ones); carry the raw sums forward
+        cand_len = (lens + jnp.where(done, 0.0, 1.0))[..., None]
+        cand_rank = (cand / jnp.maximum(cand_len, 1.0) ** length_penalty
+                     if length_penalty else cand)
+        _, flat_idx = jax.lax.top_k(cand_rank.reshape(B, K * V), K)
+        new_scores = jnp.take_along_axis(cand.reshape(B, K * V),
+                                         flat_idx, axis=1)
         beam_src = flat_idx // V
         token = (flat_idx % V).astype(jnp.int32)
         gidx = (jnp.arange(B)[:, None] * K + beam_src).reshape(-1)
@@ -255,16 +336,21 @@ def beam_search(apply_fn: Callable, params, prompt_tokens, *,
 def _decoder(model, num_kv_heads: int, head_dim: int):
     """Shared (apply_fn, make_cache) builder: both models take the same
     ``positions``/``cache``/``cache_index`` kwargs, so the cached forward
-    is one code path and only the cache geometry differs."""
+    is one code path and only the cache geometry differs. The optional
+    keyword-only args carry the RAGGED (left-padded) batch masking —
+    ``generate(prompt_lens=...)`` supplies them; plain calls never do."""
     cfg = model.cfg
 
-    def apply_fn(params, tokens, cache, cache_index):
+    def apply_fn(params, tokens, cache, cache_index, *, positions=None,
+                 segment_ids=None, valid_start=None):
         B, S = tokens.shape
-        positions = jnp.asarray(cache_index, jnp.int32) + jnp.arange(S)
+        if positions is None:
+            pos = jnp.asarray(cache_index, jnp.int32) + jnp.arange(S)
+            positions = jnp.broadcast_to(pos[None], (B, S))
         logits, new_cache = model.apply(
-            {"params": params}, tokens,
-            positions=jnp.broadcast_to(positions[None], (B, S)),
-            cache=cache, cache_index=cache_index)
+            {"params": params}, tokens, positions=positions,
+            cache=cache, cache_index=cache_index,
+            segment_ids=segment_ids, valid_start=valid_start)
         return logits, new_cache
 
     def make_cache(batch: int, max_len: int, dtype=None):

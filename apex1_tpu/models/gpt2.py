@@ -65,7 +65,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, deterministic=True, segment_ids=None,
-                 cache=None, cache_index=None):
+                 cache=None, cache_index=None, valid_start=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         h = cfg.hidden_size
@@ -96,7 +96,8 @@ class Block(nn.Module):
             from apex1_tpu.models.generate import cached_attention
             attn, new_cache = cached_attention(
                 q, k, v, cache, cache_index,
-                sm_scale=1.0 / math.sqrt(hd))
+                sm_scale=1.0 / math.sqrt(hd),
+                segment_ids=segment_ids, valid_start=valid_start)
         elif cfg.use_flash:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids,
@@ -129,15 +130,16 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, deterministic=True, return_hidden=False,
                  segment_ids=None, positions=None, cache=None,
-                 cache_index=None):
+                 cache_index=None, valid_start=None):
         """``segment_ids``/(B, S) ``positions`` enable packed batches
         (≙ fmha cu_seqlens varlen; see `runtime.pack_documents`) — tokens
         attend within their segment, learned positions gather per row.
 
         ``cache``/``cache_index`` enable KV-cached decoding (see
         `models.generate`): the return becomes ``(logits, new_cache)``;
-        prefill (S>1) must start from an empty cache at index 0; don't
-        combine with ``segment_ids``."""
+        prefill (S>1) must start from an empty cache at index 0. With a
+        cache, ``segment_ids``/``valid_start`` carry the ragged
+        left-padded-prompt masking (``generate(prompt_lens=...)``)."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -160,7 +162,7 @@ class GPT2(nn.Module):
             out = Block(cfg, name=f"h{i}")(
                 x, deterministic=deterministic, segment_ids=segment_ids,
                 cache=None if cache is None else cache[f"layer{i}"],
-                cache_index=cache_index)
+                cache_index=cache_index, valid_start=valid_start)
             if cache is None:
                 x = out
             else:

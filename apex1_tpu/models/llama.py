@@ -107,7 +107,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 cache_index=None):
+                 cache_index=None, valid_start=None):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         E, H, Hkv, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
@@ -135,7 +135,9 @@ class LlamaBlock(nn.Module):
         if cache is not None:
             from apex1_tpu.models.generate import cached_attention
             attn, new_cache = cached_attention(q, k, v, cache,
-                                               cache_index)
+                                               cache_index,
+                                               segment_ids=segment_ids,
+                                               valid_start=valid_start)
         elif self.seq_shard_axis is not None:
             if cfg.cp_impl == "ulysses":
                 attn = ulysses_attention(q, k, v, self.seq_shard_axis,
@@ -186,7 +188,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
-                 return_hidden=False, cache=None, cache_index=None):
+                 return_hidden=False, cache=None, cache_index=None,
+                 valid_start=None):
         """``segment_ids`` (B, S) enables PACKED batches (≙ the reference
         fmha's cu_seqlens varlen): tokens attend only within their own
         segment. Pass per-segment ``positions`` (B, S) so RoPE restarts
@@ -194,8 +197,10 @@ class Llama(nn.Module):
 
         ``cache``/``cache_index`` enable KV-cached decoding (see
         `models.generate`): the return becomes ``(logits, new_cache)``;
-        prefill (S>1) must start from an empty cache at index 0; don't
-        combine with ``segment_ids`` or ``seq_shard_axis``."""
+        prefill (S>1) must start from an empty cache at index 0. With a
+        cache, ``segment_ids``/``valid_start`` carry the RAGGED
+        left-padded-prompt masking (``generate(prompt_lens=...)``) —
+        don't combine the cache with ``seq_shard_axis``."""
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -230,7 +235,7 @@ class Llama(nn.Module):
                         name=f"layer{i}")(
                 x, cos, sin, segment_ids,
                 cache=None if cache is None else cache[f"layer{i}"],
-                cache_index=cache_index)
+                cache_index=cache_index, valid_start=valid_start)
             if cache is None:
                 x = out
             else:

@@ -1,0 +1,119 @@
+"""Weight-only int8 quantized decode for `models.llama.Llama`.
+
+Beyond-reference serving capability: autoregressive decode streams every
+weight from HBM once per emitted token, so at batch sizes that don't
+saturate the MXU the step time is weight-bytes / HBM-bandwidth — int8
+storage halves it vs bf16. Weights are quantized ONCE
+(:func:`quantize_llama_params`, per-out-channel symmetric int8 via
+`ops.quantize_int8`) and every decode matmul runs through
+`ops.int8_matmul`, whose Pallas kernel dequantizes inside VMEM tiles (the
+bf16 weight matrix never exists in HBM).
+
+This is a dedicated inference forward, not the flax module: it mirrors the
+cached path of `models.llama.Llama.__call__` (same rms_norm / RoPE /
+`generate.cached_attention` calls — the norm/rope/attention ops are shared
+code, only the weight matmuls differ) and plugs into `generate` /
+`beam_search` through the same ``apply_fn(params, tokens, cache,
+cache_index)`` contract as `generate.llama_decoder`. Parity is pinned by
+``tests/test_quantized.py``: with weights constructed exactly
+representable in int8 the quantized decode must match the full-precision
+model to bf16 rounding, and with real weights to quantization tolerance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.models.generate import cached_attention, init_cache
+from apex1_tpu.ops import (apply_rotary_pos_emb, int8_matmul, quantize_int8,
+                           rms_norm, rope_tables)
+
+
+def quantize_llama_params(params, cfg):
+    """Quantize a Llama param tree for decode. Embedding stays a bf16
+    gather table; norms stay fp32; every matmul weight becomes
+    ``{"q": int8 (out, in), "s": fp32 (out,)}`` (weights stored (in, out)
+    in the flax tree are transposed into the kernel's (N, K) layout
+    once, here)."""
+    if cfg.moe_every > 0:
+        raise NotImplementedError(
+            "int8 decode covers dense Llama; MoE expert matmuls need the "
+            "a2a dispatch path quantized too")
+    dt = cfg.policy.compute_dtype
+
+    def qt(w):  # (in, out) -> kernel layout (out, in)
+        q, s = quantize_int8(jnp.asarray(w).T)
+        return {"q": q, "s": s}
+
+    out = {"tok_embeddings": params["tok_embeddings"].astype(dt),
+           "norm": params["norm"]}
+    for i in range(cfg.num_layers):
+        lp = params[f"layer{i}"]
+        out[f"layer{i}"] = {
+            "attn_norm": lp["attn_norm"],
+            "mlp_norm": lp["mlp_norm"],
+            "wq": qt(lp["wq"]), "wk": qt(lp["wk"]), "wv": qt(lp["wv"]),
+            "wo": qt(lp["wo"]),
+            "w_gate": qt(lp["w_gate"]), "w_up": qt(lp["w_up"]),
+            "w_down": qt(lp["w_down"]),
+        }
+    # head is stored (vocab, hidden) = (N, K) already
+    q, s = quantize_int8(jnp.asarray(params["output"]))
+    out["output"] = {"q": q, "s": s}
+    return out
+
+
+def llama_quant_decoder(model, params):
+    """(apply_fn, make_cache, qparams) for int8 decode of a `Llama`.
+
+    ``apply_fn(qparams, tokens, cache, cache_index)`` has the
+    `generate.llama_decoder` contract — pass it (with ``qparams`` as the
+    params) to :func:`generate.generate` / :func:`generate.beam_search`.
+    """
+    cfg = model.cfg
+    dt = cfg.policy.compute_dtype
+    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    qparams = quantize_llama_params(params, cfg)
+
+    def mm(x, qw):
+        return int8_matmul(x, qw["q"], qw["s"]).astype(dt)
+
+    def norm_g(g):
+        return g if cfg.policy.keep_norms_fp32 else g.astype(dt)
+
+    def apply_fn(qp, tokens, cache, cache_index):
+        B, S = tokens.shape
+        idx = jnp.asarray(cache_index, jnp.int32)
+        x = qp["tok_embeddings"][tokens].astype(dt)
+        pos = idx + jnp.arange(S)
+        cos, sin = rope_tables(pos, D, base=cfg.rope_base)
+        new_cache = {}
+        for i in range(cfg.num_layers):
+            lp = qp[f"layer{i}"]
+            h = rms_norm(x, norm_g(lp["attn_norm"]),
+                         eps=cfg.norm_eps).astype(dt)
+            q = mm(h, lp["wq"]).reshape(B, S, H, D)
+            k = mm(h, lp["wk"]).reshape(B, S, Hkv, D)
+            v = mm(h, lp["wv"]).reshape(B, S, Hkv, D)
+            q = apply_rotary_pos_emb(q, cos, sin)
+            k = apply_rotary_pos_emb(k, cos, sin)
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            attn, new_cache[f"layer{i}"] = cached_attention(
+                q, k, v, cache[f"layer{i}"], cache_index)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+            x = x + mm(attn, lp["wo"]).astype(x.dtype)
+            h = rms_norm(x, norm_g(lp["mlp_norm"]),
+                         eps=cfg.norm_eps).astype(dt)
+            y = mm(jax.nn.silu(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]),
+                   lp["w_down"])
+            x = x + y.astype(x.dtype)
+        x = rms_norm(x, norm_g(qp["norm"]), eps=cfg.norm_eps).astype(dt)
+        logits = int8_matmul(x, qp["output"]["q"], qp["output"]["s"])
+        return logits, new_cache
+
+    def make_cache(batch: int, max_len: int, dtype=None):
+        return init_cache(cfg.num_layers, batch, Hkv, max_len, D,
+                          dtype or dt)
+
+    return apply_fn, make_cache, qparams

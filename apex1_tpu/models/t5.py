@@ -60,6 +60,19 @@ class T5Config:
 
     def __post_init__(self):
         checkpoint_policy(self.remat_policy)  # fail fast on a typo
+        # the log-spaced bucket formula divides by
+        # log(max_distance / max_exact) with max_exact = buckets//2
+        # (//4 effective in the bidirectional encoder, which halves
+        # num_buckets first) — max_dist <= max_exact makes the
+        # denominator zero/negative and silently wraps garbage bucket
+        # indices into the bias table (ADVICE r3); fail fast instead,
+        # mirroring the remat_policy check above
+        if self.rel_pos_max_dist <= self.rel_pos_buckets // 2:
+            raise ValueError(
+                f"rel_pos_max_dist ({self.rel_pos_max_dist}) must exceed "
+                f"rel_pos_buckets // 2 ({self.rel_pos_buckets // 2}) — "
+                f"the log-spaced tail of relative_position_bucket needs "
+                f"max_distance > max_exact")
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 

@@ -18,3 +18,5 @@ from apex1_tpu.ops.linear_xent import linear_cross_entropy  # noqa: F401
 from apex1_tpu.ops.rope import (  # noqa: F401
     apply_rotary_pos_emb, rope_tables)
 from apex1_tpu.ops.attention import flash_attention, fmha  # noqa: F401
+from apex1_tpu.ops.quantized import (  # noqa: F401
+    int8_matmul, quantize_int8)

@@ -302,6 +302,175 @@ class TestBeamSearch:
         assert (row[hits[0] + 1:] == 0).all(), row
 
 
+class TestRaggedGenerate:
+    """Batched ragged decode (`generate(prompt_lens=...)`): left-aligned
+    rows with per-row positions/segment masking must emit EXACTLY the
+    tokens each row produces when generated alone at its true length."""
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_rows_match_solo_generation(self, family):
+        if family == "gpt2":
+            cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+            model = GPT2(cfg)
+            mk = gpt2_decoder
+            vocab = cfg.vocab_size
+        else:
+            cfg = LlamaConfig.tiny(policy=get_policy("O0"),
+                                   max_seq_len=64)
+            model = Llama(cfg)
+            mk = llama_decoder
+            vocab = cfg.vocab_size
+        rng = np.random.default_rng(21)
+        S0, N = 7, 5
+        lens = [7, 4, 2]
+        prompts = jnp.asarray(rng.integers(1, vocab, (3, S0)), jnp.int32)
+        # right-pad: junk beyond each row's length must not matter
+        pad_mask = jnp.arange(S0)[None, :] < jnp.asarray(lens)[:, None]
+        prompts = jnp.where(pad_mask, prompts, 0)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_fn, make_cache = mk(model)
+
+        got = generate(apply_fn, params, prompts, max_new_tokens=N,
+                       cache=make_cache(3, S0 + N),
+                       vocab_size=vocab,
+                       prompt_lens=jnp.asarray(lens, jnp.int32))
+
+        for b, ln in enumerate(lens):
+            solo = generate(apply_fn, params, prompts[b:b + 1, :ln],
+                            max_new_tokens=N,
+                            cache=make_cache(1, ln + N),
+                            vocab_size=vocab)
+            np.testing.assert_array_equal(
+                np.asarray(got[b]), np.asarray(solo[0]),
+                err_msg=f"{family} row {b} (len {ln}) diverged from its "
+                        f"solo decode")
+
+    def test_ragged_eos_per_row_stop(self):
+        cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = GPT2(cfg)
+        rng = np.random.default_rng(23)
+        S0, N = 6, 6
+        lens = jnp.asarray([6, 3], jnp.int32)
+        prompts = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, S0)),
+                              jnp.int32)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_fn, make_cache = gpt2_decoder(model)
+        first = generate(apply_fn, params, prompts, max_new_tokens=N,
+                         cache=make_cache(2, S0 + N),
+                         vocab_size=cfg.vocab_size, prompt_lens=lens)
+        eos = int(first[1, 1])  # a token row 1 actually emits
+        got = generate(apply_fn, params, prompts, max_new_tokens=N,
+                       cache=make_cache(2, S0 + N),
+                       vocab_size=cfg.vocab_size, prompt_lens=lens,
+                       eos_id=eos, pad_id=0)
+        row = np.asarray(got[1])
+        hits = np.nonzero(row == eos)[0]
+        assert hits.size > 0
+        assert (row[hits[0] + 1:] == 0).all(), row
+
+
+class TestBeamLengthPenalty:
+    """ADVICE r3: in-beam pruning must use the SAME GNMT length-normalized
+    metric as final selection. A table-driven Markov machine where the two
+    rankings provably diverge: a finished short beam out-SUMS two longer
+    live candidates at the critical step, but both out-NORM it at
+    length_penalty=3 — pure-sum pruning would evict the eventual
+    normalized winner."""
+
+    def test_norm_ranked_winner_survives_pruning(self):
+        from apex1_tpu.models.generate import beam_search
+        V, eos = 5, 4
+        P = np.full((V, V), 1e-3)
+        P[3] = [.004, .62, .37, .002, .004]   # prompt token / X's last
+        P[1] = [.132, .132, .132, .004, .6]   # -> eos .6 (finishes F1)
+        P[2] = [.5, .17, .165, .155, .01]     # -> token0 .5 (Y's step)
+        P[0] = [.003, .003, .002, .45, .497]  # -> token3/eos (F2 vs X)
+        P /= P.sum(axis=1, keepdims=True)
+        logP = jnp.asarray(np.log(P), jnp.float32)
+
+        def apply_fn(params, tokens, cache, cache_index):
+            logits = logP[tokens[:, -1]][:, None, :]
+            return logits, cache
+
+        prompt = jnp.full((1, 1), 3, jnp.int32)
+        cache = {"x": jnp.zeros((2, 1))}  # B*K lanes, shape-agnostic
+        toks, score = beam_search(apply_fn, None, prompt,
+                                  max_new_tokens=4, cache=cache,
+                                  num_beams=2, length_penalty=3.0,
+                                  eos_id=eos, pad_id=0)
+        # winner: [2,0,3,1] (len 4, sum ln.37+ln.5+ln.45+ln.62, /4^3);
+        # sum-ranking would have returned the F2 path [2,0,4,...] instead
+        np.testing.assert_array_equal(np.asarray(toks[0]), [2, 0, 3, 1])
+        want = (np.log(P[3][2]) + np.log(P[2][0]) + np.log(P[0][3])
+                + np.log(P[3][1])) / 4.0 ** 3
+        np.testing.assert_allclose(float(score[0]), want, rtol=1e-5)
+
+    def test_zero_penalty_keeps_pure_sum_ranking(self):
+        """length_penalty=0 must stay the documented pure-sum ranking:
+        the same machine then keeps and returns the best-sum finished
+        beam (F2's eos path), not the normalized winner."""
+        from apex1_tpu.models.generate import beam_search
+        V, eos = 5, 4
+        P = np.full((V, V), 1e-3)
+        P[3] = [.004, .62, .37, .002, .004]
+        P[1] = [.132, .132, .132, .004, .6]
+        P[2] = [.5, .17, .165, .155, .01]
+        P[0] = [.003, .003, .002, .45, .497]
+        P /= P.sum(axis=1, keepdims=True)
+        logP = jnp.asarray(np.log(P), jnp.float32)
+
+        def apply_fn(params, tokens, cache, cache_index):
+            return logP[tokens[:, -1]][:, None, :], cache
+
+        prompt = jnp.full((1, 1), 3, jnp.int32)
+        toks, score = beam_search(apply_fn, None, prompt,
+                                  max_new_tokens=4,
+                                  cache={"x": jnp.zeros((2, 1))},
+                                  num_beams=2, length_penalty=0.0,
+                                  eos_id=eos, pad_id=0)
+        # pure sums: F1 = [1, eos] (ln.62 + ln.6) beats every longer path
+        np.testing.assert_array_equal(np.asarray(toks[0]), [1, eos, 0, 0])
+
+
+class TestSampleTokenGuards:
+    """ADVICE r3: top_k bounds."""
+
+    def test_top_k_exceeding_vocab_clamps_to_valid_width(self):
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+        a = sample_token(logits, jax.random.key(0), temperature=0.9,
+                         top_k=999, vocab_size=10)
+        b = sample_token(logits, jax.random.key(0), temperature=0.9,
+                         top_k=10, vocab_size=10)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(jnp.max(a)) < 10  # masked tail never sampled
+
+    def test_top_k_below_one_raises(self):
+        logits = jnp.zeros((2, 8), jnp.float32)
+        with pytest.raises(ValueError, match="top_k"):
+            sample_token(logits, jax.random.key(0), temperature=1.0,
+                         top_k=0)
+
+
+class TestCachedAttentionGuards:
+    """ADVICE r3: prefill from a non-empty cache must fail fast when the
+    index is concrete."""
+
+    def test_prefill_nonzero_concrete_index_raises(self):
+        from apex1_tpu.models.generate import cached_attention, init_cache
+        cache = init_cache(1, 1, 2, 16, 8)["layer0"]
+        q = jnp.zeros((1, 2, 4, 8), jnp.bfloat16)
+        with pytest.raises(ValueError, match="empty cache"):
+            cached_attention(q, q, q, cache, 3)
+
+    def test_prefill_zero_index_ok(self):
+        from apex1_tpu.models.generate import cached_attention, init_cache
+        cache = init_cache(1, 1, 2, 16, 8)["layer0"]
+        q = jnp.ones((1, 2, 4, 8), jnp.bfloat16)
+        attn, entry = cached_attention(q, q, q, cache, 0)
+        assert attn.shape == (1, 2, 4, 8)
+
+
 class TestLlamaGenerate:
     def test_gqa_cached_matches_full_forward(self):
         cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=64)

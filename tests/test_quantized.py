@@ -1,0 +1,157 @@
+"""Weight-only int8 quantized matmul + decode (`ops.quantized`,
+`models.quant_decode`): kernel parity vs the dequant composite, bounded
+round-trip error, and decode parity vs the full-precision model — exact
+(to bf16 rounding) when weights are constructed int8-representable."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.core.policy import get_policy
+from apex1_tpu.models.generate import generate, llama_decoder
+from apex1_tpu.models.llama import Llama, LlamaConfig
+from apex1_tpu.models.quant_decode import (llama_quant_decoder,
+                                           quantize_llama_params)
+from apex1_tpu.ops import force_impl, int8_matmul, quantize_int8
+from apex1_tpu.ops.quantized import _dequant_matmul_xla
+
+
+class TestQuantizeInt8:
+    def test_roundtrip_error_bounded_by_half_step(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+        wq, s = quantize_int8(w)
+        assert wq.dtype == jnp.int8 and s.shape == (64,)
+        back = np.asarray(wq, np.float32) * np.asarray(s)[:, None]
+        step = np.asarray(s)[:, None]  # per-channel quantization step
+        assert (np.abs(back - np.asarray(w)) <= step / 2 + 1e-7).all()
+
+    def test_zero_channel_stays_zero(self):
+        w = jnp.zeros((4, 8), jnp.float32).at[1].set(1.0)
+        wq, s = quantize_int8(w)
+        assert (np.asarray(wq)[0] == 0).all()
+        back = np.asarray(wq, np.float32) * np.asarray(s)[:, None]
+        np.testing.assert_allclose(back, np.asarray(w), atol=1e-6)
+
+    def test_rejects_bad_rank(self):
+        with pytest.raises(ValueError, match="2-D"):
+            quantize_int8(jnp.zeros((2, 3, 4)))
+
+
+class TestInt8Matmul:
+    def test_pallas_matches_composite(self):
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(256, 128)) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 128)), jnp.bfloat16)
+        wq, s = quantize_int8(w)
+        with force_impl("pallas"):
+            got = jax.jit(lambda x: int8_matmul(x, wq, s))(x)
+        want = _dequant_matmul_xla(x, wq, s)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_unaligned_shapes_take_composite(self):
+        rng = np.random.default_rng(2)
+        w = jnp.asarray(rng.normal(size=(60, 72)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(3, 72)), jnp.bfloat16)
+        wq, s = quantize_int8(w)
+        with force_impl("pallas"):  # gate must fall back, not crash
+            got = int8_matmul(x, wq, s)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_dequant_matmul_xla(x, wq,
+                                                                  s)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_leading_dims_and_grad(self):
+        rng = np.random.default_rng(3)
+        w = jnp.asarray(rng.normal(size=(128, 128)), jnp.float32)
+        wq, s = quantize_int8(w)
+        x = jnp.asarray(rng.normal(size=(2, 5, 128)), jnp.float32)
+        y = int8_matmul(x, wq, s)
+        assert y.shape == (2, 5, 128) and y.dtype == jnp.float32
+        dx, dwq, ds = jax.grad(
+            lambda x, wq, s: jnp.sum(int8_matmul(x, wq, s)),
+            argnums=(0, 1, 2), allow_int=True)(x, wq, s)
+        wdq = np.asarray(wq, np.float32) * np.asarray(s)[:, None]
+        # bwd runs in bf16 (decode dtype): 128-term column sums carry
+        # ~0.4% relative rounding
+        np.testing.assert_allclose(np.asarray(dx),
+                                   np.broadcast_to(wdq.sum(0), x.shape),
+                                   rtol=5e-2, atol=0.1)
+        assert (np.asarray(ds) == 0).all()  # weights frozen
+
+
+class TestQuantDecode:
+    @staticmethod
+    def _exactly_representable(params):
+        """Replace every matmul weight by q*s with q in [-127, 127] so
+        quantization is lossless — decode parity then isolates the code
+        path, not the quantization error."""
+        rng = np.random.default_rng(7)
+
+        def fix(path, p):
+            name = path[-1].key if hasattr(path[-1], "key") else path[-1]
+            if name in ("wq", "wk", "wv", "wo", "w_gate", "w_up",
+                        "w_down", "output"):
+                q = rng.integers(-127, 128, size=p.shape)
+                return jnp.asarray(q * 2e-3, jnp.float32)
+            return p
+
+        return jax.tree_util.tree_map_with_path(fix, params)
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=32)
+        model = Llama(cfg)
+        rng = np.random.default_rng(5)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)),
+                             jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+        params = self._exactly_representable(params)
+        return cfg, model, params, prompt
+
+    def test_quant_logits_match_full_precision(self, setup):
+        cfg, model, params, prompt = setup
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        cache = make_cache(2, 16)
+        logits_q, _ = apply_q(qparams, prompt, cache, 0)
+        apply_f, make_cache_f = llama_decoder(model)
+        logits_f, _ = apply_f(params, prompt, make_cache_f(2, 16), 0)
+        # exactly-representable weights: differences are bf16 rounding
+        np.testing.assert_allclose(np.asarray(logits_q),
+                                   np.asarray(logits_f),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_quant_generate_matches_full_precision_tokens(self, setup):
+        cfg, model, params, prompt = setup
+        N = 6
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        got = generate(apply_q, qparams, prompt, max_new_tokens=N,
+                       cache=make_cache(2, 11))
+        apply_f, make_cache_f = llama_decoder(model)
+        want = generate(apply_f, params, prompt, max_new_tokens=N,
+                        cache=make_cache_f(2, 11))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_real_weights_quant_error_is_small(self, setup):
+        cfg, model, _, prompt = setup
+        rng = np.random.default_rng(11)
+        params = model.init(jax.random.key(1), prompt)["params"]
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        logits_q, _ = apply_q(qparams, prompt, make_cache(2, 16), 0)
+        apply_f, make_cache_f = llama_decoder(model)
+        logits_f, _ = apply_f(params, prompt, make_cache_f(2, 16), 0)
+        lq, lf = np.asarray(logits_q), np.asarray(logits_f)
+        denom = max(1.0, np.abs(lf).max())
+        assert np.abs(lq - lf).max() / denom < 0.15, (
+            np.abs(lq - lf).max(), denom)
+
+    def test_moe_guarded(self):
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), moe_every=1,
+                               num_experts=2, moe_top_k=1)
+        model = Llama(cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+        with pytest.raises(NotImplementedError, match="MoE"):
+            llama_quant_decoder(model, params)

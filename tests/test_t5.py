@@ -53,6 +53,16 @@ class TestRelPosBucket:
                                           num_buckets=32)
         assert int(future) >= 16 and int(past) < 16
 
+    def test_config_rejects_degenerate_log_range(self):
+        """ADVICE r3: max_dist <= buckets//2 makes the log-bucket
+        denominator zero/negative, silently wrapping garbage indices into
+        the bias table — the config must fail fast instead."""
+        with pytest.raises(ValueError, match="rel_pos_max_dist"):
+            T5Config.tiny(rel_pos_buckets=8, rel_pos_max_dist=4)
+        with pytest.raises(ValueError, match="rel_pos_max_dist"):
+            T5Config.tiny(rel_pos_buckets=8, rel_pos_max_dist=2)
+        T5Config.tiny(rel_pos_buckets=8, rel_pos_max_dist=5)  # ok
+
     def test_log_spacing_saturates(self):
         b1 = relative_position_bucket(jnp.asarray(-127),
                                       bidirectional=False,

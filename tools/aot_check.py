@@ -205,6 +205,14 @@ def main():
         check("rope llama (1,16384,32,64) fwd+bwd",
               lambda x: apply_rotary_pos_emb(x, cos, sin),
               [(1, 16384, 32, 64)], grad=True)
+        # int8 weight-only decode GEMM (dequant fused in VMEM): decode-row
+        # x against a llama-head-sized weight; weight+scale replicated
+        from apex1_tpu.ops import int8_matmul
+        check("int8 matmul decode (8,4096)x(32000,4096) fwd",
+              lambda x, wq, s: int8_matmul(x, wq, s),
+              [(8, 4096), (32000, 4096), (32000,)],
+              dtypes=[jnp.bfloat16, jnp.int8, jnp.float32],
+              in_specs=(P("dp"), P(), P()))
 
     if args.steps:
         print(f"== full bench train steps (single device, exactly what "
