@@ -343,6 +343,66 @@ def bench_t5(on_accel):
             30_000.0)
 
 
+def bench_decode(on_accel, quant=False):
+    """Serving-path decode throughput (beyond-BASELINE; the reference is
+    training-only): KV-cached autoregressive generation through
+    `models.generate` — prefill + a fixed number of single-dispatch
+    decode steps per measured "step". ``quant=True`` times the int8
+    weight-only path (`models.quant_decode`): decode is HBM-bound, so
+    int8 weights should approach 2x the bf16 tokens/sec at small batch.
+
+    Proxy comparator: ~0.8B-class bf16 decode at B=8 on an A100-class
+    chip, a LITERATURE-ORDER estimate (~4k tok/s aggregate) — decode
+    numbers vary widely with serving stack; treat vs_baseline here as
+    orientation, not a measured A100 run.
+    """
+    import functools as ft
+
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.generate import generate, llama_decoder
+    from apex1_tpu.models.llama import Llama, LlamaConfig
+    from apex1_tpu.models.quant_decode import llama_quant_decoder
+
+    if on_accel:
+        B, S0, N, iters = 8, 128, 128, 3
+        cfg = LlamaConfig(vocab_size=32000, max_seq_len=S0 + N + 8,
+                          num_layers=16, num_heads=32, num_kv_heads=4,
+                          hidden_size=2048, ffn_size=5632,
+                          policy=get_policy("O2"))
+        name = "Llama-0.8B-decode"
+    else:
+        B, S0, N, iters = 2, 8, 8, 2
+        cfg = LlamaConfig.tiny(policy=get_policy("O2"), max_seq_len=32)
+        name = "Llama(tiny smoke)-decode"
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S0)),
+                         jnp.int32)
+    params = jax.jit(model.init)(jax.random.key(0), prompt)["params"]
+    if quant:
+        apply_fn, make_cache, decode_params = llama_quant_decoder(
+            model, params)
+        name += "-int8"
+    else:
+        apply_fn, make_cache = llama_decoder(model)
+        decode_params = params
+
+    gen = ft.partial(generate, apply_fn, max_new_tokens=N,
+                     vocab_size=cfg.vocab_size)
+
+    def step(state, prompt):
+        (decode_params,) = state
+        toks = gen(decode_params, prompt,
+                   cache=make_cache(B, S0 + N + 1))
+        # a finite scalar for the harness's loss check / full-tree sync
+        metrics = {"loss": jnp.mean(toks.astype(jnp.float32))}
+        return state, metrics
+
+    return ((decode_params,), step, (prompt,), B * N, iters,
+            f"decode tokens/sec/chip {name}", "tokens/sec/chip",
+            4_000.0)
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
     "bert": bench_bert,
@@ -351,6 +411,8 @@ BENCHES = {
     "llama_longctx": bench_llama_longctx,
     "llama_block": bench_llama_block,
     "t5": bench_t5,
+    "decode": bench_decode,
+    "decode_int8": functools.partial(bench_decode, quant=True),
 }
 
 

@@ -222,6 +222,36 @@ def bench_xent_plain(T, V):
                   x, t, num_classes=V - 200)))
 
 
+def bench_int8(T, N, K):
+    """int8 weight-only decode GEMM A/B: Pallas dequant-in-VMEM kernel vs
+    the XLA dequant composite vs plain bf16 matmul. Decode is HBM-bound,
+    so the interesting number is achieved GB/s of weight traffic — the
+    int8 paths should approach 2x the bf16 tokens/step at small T."""
+    from apex1_tpu.ops import force_impl, int8_matmul, quantize_int8
+    print(f"== int8 weight-only GEMM ({T},{K})x({N},{K}) ==", flush=True)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(N, K)) * 0.02, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(T, K)), jnp.bfloat16)
+    wq, s = quantize_int8(w)
+    wb = w.astype(jnp.bfloat16)
+    cases = (
+        ("bf16 matmul", lambda x: jnp.matmul(
+            x, wb.T, preferred_element_type=jnp.float32), None),
+        ("int8 xla composite", lambda x: int8_matmul(x, wq, s), "xla"),
+        ("int8 pallas kernel", lambda x: int8_matmul(x, wq, s), "pallas"),
+    )
+    for name, fn, impl in cases:
+        if impl is None:
+            dt = timeit(fn, x)
+            wbytes = N * K * 2
+        else:
+            with force_impl(impl):
+                dt = timeit(fn, x)
+            wbytes = N * K
+        print(f"  {name:22s} {dt*1e3:8.3f} ms  weight {wbytes/2**20:6.1f} "
+              f"MiB -> {wbytes/dt/2**30:6.1f} GiB/s", flush=True)
+
+
 def bench_dense(B, In, Hid):
     """fused_dense decision check: gemm+bias+gelu(+gemm) in one jit —
     achieved TFLOP/s vs chip peak tells whether XLA's epilogue fusion
@@ -281,7 +311,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("what", nargs="?", default="all",
                     choices=["attn", "xent", "norm", "softmax", "rope",
-                             "xent_plain", "dense", "opt", "all"])
+                             "xent_plain", "dense", "int8", "opt", "all"])
     ap.add_argument("--llama", action="store_true",
                     help="long-context llama shapes instead of GPT-2")
     ap.add_argument("--tiny", action="store_true",
@@ -328,5 +358,12 @@ if __name__ == "__main__":
         bench_xent_plain(*xp_shape)
     if args.what in ("dense", "all"):
         bench_dense(*dense_shape)
+    if args.what in ("int8", "all"):
+        if args.tiny:
+            bench_int8(4, 256, 128)
+        elif args.llama:
+            bench_int8(8, 32000, 2048)   # decode rows vs the LM head
+        else:
+            bench_int8(8, 2048, 2048)    # decode rows vs a block matmul
     if args.what in ("opt", "all"):
         bench_opt(*opt_shape)

@@ -120,6 +120,8 @@ run bench_bert      1200 python bench.py --config bert --timeout 1000
 run bench_resnet    1200 python bench.py --config resnet --timeout 1000
 run bench_t5        1500 python bench.py --config t5 --timeout 1200
 run bench_gpt2_b24  1200 python bench.py --config gpt2 --batch 24 --timeout 1000
+run bench_decode    1200 python bench.py --config decode --timeout 1000
+run bench_dec_int8  1200 python bench.py --config decode_int8 --timeout 1000
 run profile_gpt2    1200 python tools/profile_step.py --config gpt2 --top 40
 run cond_elision     900 python tools/cond_elision_probe.py
 run kern_all        4800 python tools/bench_kernels.py all "${TINY[@]}"
