@@ -144,6 +144,8 @@ class TestASP:
                               "--hidden", "32", "--chunks", "2"]),
     ("examples/t5_seq2seq.py", ["--steps", "3", "--batch", "4"]),
     ("examples/rnnt_speech.py", ["--steps", "3", "--batch", "4"]),
+    ("examples/serving_llama.py", ["--tiny", "--new", "6", "--beams",
+                                   "2", "--prompt-len", "6"]),
 ])
 @pytest.mark.slow
 def test_examples_smoke(script, args):
