@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex1_tpu.ops import NEG_INF
 from apex1_tpu.ops.attention import flash_attention
@@ -185,6 +186,16 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     kw = {}
     lens = None
     if prompt_lens is not None:
+        try:  # fail fast on concrete out-of-range lengths (a traced
+            # lens skips the check); pad/position math below silently
+            # scrambles the row otherwise
+            lv = np.asarray(prompt_lens)
+        except Exception:
+            lv = None
+        if lv is not None and ((lv < 1).any() or (lv > S0).any()):
+            raise ValueError(
+                f"prompt_lens must lie in [1, {S0}] (the padded prompt "
+                f"width), got {lv.tolist()}")
         lens = jnp.asarray(prompt_lens, jnp.int32)
         pad = S0 - lens                             # left-pad widths (B,)
         # left-align: row b shifts right by pad_b (one gather); the

@@ -82,12 +82,22 @@ def llama_quant_decoder(model, params):
     def norm_g(g):
         return g if cfg.policy.keep_norms_fp32 else g.astype(dt)
 
-    def apply_fn(qp, tokens, cache, cache_index):
+    def apply_fn(qp, tokens, cache, cache_index, *, positions=None,
+                 segment_ids=None, valid_start=None):
+        # the keyword-only args carry the RAGGED (left-padded) masking,
+        # exactly as in `generate.llama_decoder` — so the int8 path
+        # composes with generate(prompt_lens=...)
         B, S = tokens.shape
         idx = jnp.asarray(cache_index, jnp.int32)
         x = qp["tok_embeddings"][tokens].astype(dt)
-        pos = idx + jnp.arange(S)
-        cos, sin = rope_tables(pos, D, base=cfg.rope_base)
+        if positions is None:
+            pos = idx + jnp.arange(S)
+            cos, sin = rope_tables(pos, D, base=cfg.rope_base)
+        else:  # (B, S) per-row positions -> per-row tables
+            cos, sin = rope_tables(
+                jnp.asarray(positions).reshape(-1), D, base=cfg.rope_base)
+            cos = cos.reshape(B, S, -1)
+            sin = sin.reshape(B, S, -1)
         new_cache = {}
         for i in range(cfg.num_layers):
             lp = qp[f"layer{i}"]
@@ -100,7 +110,8 @@ def llama_quant_decoder(model, params):
             k = apply_rotary_pos_emb(k, cos, sin)
             q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             attn, new_cache[f"layer{i}"] = cached_attention(
-                q, k, v, cache[f"layer{i}"], cache_index)
+                q, k, v, cache[f"layer{i}"], cache_index,
+                segment_ids=segment_ids, valid_start=valid_start)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * D)
             x = x + mm(attn, lp["wo"]).astype(x.dtype)
             h = rms_norm(x, norm_g(lp["mlp_norm"]),

@@ -78,12 +78,26 @@ def _int8_mm_kernel(x_ref, wq_ref, scale_ref, o_ref):
         o_ref[...] *= scale_ref[...].astype(jnp.float32)
 
 
+def _fit_block(size: int, want: int) -> int:
+    """Largest multiple-of-128 DIVISOR of ``size`` that is <= ``want``.
+    Blocks must tile the dim exactly: a pl.cdiv ragged tail block would
+    read out-of-bounds K columns and accumulate garbage into every
+    output (there is no pad_to here — weights are static, callers
+    shouldn't pay a per-call pad copy). The gate guarantees
+    ``size % 128 == 0``, so 128 always divides."""
+    units = size // 128
+    for cand in range(min(want // 128, units), 0, -1):
+        if units % cand == 0:
+            return cand * 128
+    return 128
+
+
 def _pallas_int8_matmul(x, wq, scale, block_n: int, block_k: int):
     T, K = x.shape
     N = wq.shape[0]
-    bn = min(block_n, N)
-    bk = min(block_k, K)
-    grid = (pl.cdiv(N, bn), pl.cdiv(K, bk))
+    bn = _fit_block(N, block_n)
+    bk = _fit_block(K, block_k)
+    grid = (N // bn, K // bk)
     return pl.pallas_call(
         _int8_mm_kernel,
         grid=grid,
@@ -138,14 +152,18 @@ def _int8_matmul_fwd(x, wq, scale, block_n, block_k):
                                 block_n, block_k)[:x2.shape[0]]
     else:
         y = _dequant_matmul_xla(x2, wq, scale)
-    return y.reshape(*lead, N), (x, wq, scale)
+    # residuals carry only what bwd reads: the weights and x's DTYPE (as
+    # a 0-sized proto array — saving x itself would keep the whole
+    # (..., K) activation alive just to call .astype on dx)
+    return y.reshape(*lead, N), (jnp.zeros((0,), x.dtype), wq, scale)
 
 
 def _int8_matmul_bwd(block_n, block_k, res, dy):
-    x, wq, scale = res
+    x_proto, wq, scale = res
     w = wq.astype(jnp.bfloat16) * scale[:, None].astype(jnp.bfloat16)
     dx = jnp.matmul(dy.astype(jnp.bfloat16), w,
-                    preferred_element_type=jnp.float32).astype(x.dtype)
+                    preferred_element_type=jnp.float32).astype(
+                        x_proto.dtype)
     return dx, jnp.zeros_like(wq), jnp.zeros_like(scale)
 
 

@@ -18,7 +18,9 @@ from apex1_tpu.transformer.pipeline_parallel import schedules  # noqa: E402
 
 pytestmark = pytest.mark.slow  # fuzz suite: full run via check_all.sh --all
 
-_SETTINGS = dict(max_examples=6, deadline=None,
+# 4 examples/property (was 6): every example compiles a fresh pipeline
+# scan; wall-time budget per VERDICT r3 Weak #5
+_SETTINGS = dict(max_examples=4, deadline=None,
                  suppress_health_check=list(HealthCheck))
 
 

@@ -108,7 +108,10 @@ class TestT5Generate:
         params = model.init(
             jax.random.key(0), enc,
             jnp.zeros((2, 1), jnp.int32))["params"]
-        N = 6
+        # N=4 (was 6): the gold loop recompiles per step (context grows),
+        # ~6s/step on one core; 4 steps still crosses the
+        # prefill->decode boundary and several cache writes
+        N = 4
         got = t5_generate(model, params, enc, max_new_tokens=N,
                           dec_start_id=0)
         # gold: grow the decoder context from the start token, full
@@ -344,6 +347,45 @@ class TestRaggedGenerate:
                 np.asarray(got[b]), np.asarray(solo[0]),
                 err_msg=f"{family} row {b} (len {ln}) diverged from its "
                         f"solo decode")
+
+    def test_prompt_lens_out_of_range_raises(self):
+        cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = GPT2(cfg)
+        prompts = jnp.ones((2, 6), jnp.int32)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_fn, make_cache = gpt2_decoder(model)
+        for bad in ([7, 3], [0, 3]):
+            with pytest.raises(ValueError, match="prompt_lens"):
+                generate(apply_fn, params, prompts, max_new_tokens=2,
+                         cache=make_cache(2, 10),
+                         prompt_lens=jnp.asarray(bad, jnp.int32))
+
+    def test_ragged_composes_with_int8_decode(self):
+        """The serving stack's two features must compose: ragged
+        generate through the int8 quant decoder, each row token-exact
+        vs its solo int8 decode."""
+        from apex1_tpu.models.quant_decode import llama_quant_decoder
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = Llama(cfg)
+        rng = np.random.default_rng(31)
+        S0, N = 6, 4
+        lens = [6, 3]
+        prompts = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, S0)),
+                              jnp.int32)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        got = generate(apply_q, qparams, prompts, max_new_tokens=N,
+                       cache=make_cache(2, S0 + N),
+                       vocab_size=cfg.vocab_size,
+                       prompt_lens=jnp.asarray(lens, jnp.int32))
+        for b, ln in enumerate(lens):
+            solo = generate(apply_q, qparams, prompts[b:b + 1, :ln],
+                            max_new_tokens=N,
+                            cache=make_cache(1, ln + N),
+                            vocab_size=cfg.vocab_size)
+            np.testing.assert_array_equal(
+                np.asarray(got[b]), np.asarray(solo[0]),
+                err_msg=f"int8 ragged row {b} (len {ln}) diverged")
 
     def test_ragged_eos_per_row_stop(self):
         cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)

@@ -51,6 +51,21 @@ class TestInt8Matmul:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-2, atol=2e-2)
 
+    def test_non_block_multiple_dims_stay_exact(self):
+        """K/N that are 128-aligned but NOT multiples of the default
+        block sizes (the Llama-7B ffn shape class): blocks must be
+        divisor-fitted — a cdiv ragged tail block would accumulate
+        out-of-bounds garbage into every output."""
+        rng = np.random.default_rng(7)
+        w = jnp.asarray(rng.normal(size=(384, 768)) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 768)), jnp.bfloat16)
+        wq, s = quantize_int8(w)
+        with force_impl("pallas"):  # default block_k=512 does not divide
+            got = jax.jit(lambda x: int8_matmul(x, wq, s))(x)
+        want = _dequant_matmul_xla(x, wq, s)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
     def test_unaligned_shapes_take_composite(self):
         rng = np.random.default_rng(2)
         w = jnp.asarray(rng.normal(size=(60, 72)), jnp.float32)
