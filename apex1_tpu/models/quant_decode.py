@@ -128,3 +128,102 @@ def llama_quant_decoder(model, params):
                           dtype or dt)
 
     return apply_fn, make_cache, qparams
+
+
+def quantize_gpt2_params(params, cfg):
+    """Quantize a GPT-2 param tree for decode. The tied ``wte`` is kept
+    TWICE: as the bf16 gather table (embedding lookup is not a matmul)
+    and as the int8 LM head (``(padded_vocab, hidden)`` is already the
+    kernel's (N, K) layout). Dense kernels stored (in, out) transpose
+    once, here; LayerNorm scale/bias and the dense biases stay fp32."""
+    import math
+
+    dt = cfg.policy.compute_dtype
+
+    def qt(kernel):  # (in, out) -> (out, in)
+        q, s = quantize_int8(jnp.asarray(kernel).T)
+        return {"q": q, "s": s}
+
+    out = {"wte": params["wte"].astype(dt),
+           "wpe": params["wpe"].astype(dt),
+           "lnf_scale": params["lnf_scale"],
+           "lnf_bias": params["lnf_bias"]}
+    for i in range(cfg.num_layers):
+        lp = params[f"h{i}"]
+        out[f"h{i}"] = {
+            "ln1_scale": lp["ln1_scale"], "ln1_bias": lp["ln1_bias"],
+            "ln2_scale": lp["ln2_scale"], "ln2_bias": lp["ln2_bias"],
+            "qkv": qt(lp["qkv"]["kernel"]),
+            "qkv_b": lp["qkv"]["bias"],
+            "proj": qt(lp["proj"]["kernel"]),
+            "proj_b": lp["proj"]["bias"],
+            "fc_in": qt(lp["fc_in"]["kernel"]),
+            "fc_in_b": lp["fc_in"]["bias"],
+            "fc_out": qt(lp["fc_out"]["kernel"]),
+            "fc_out_b": lp["fc_out"]["bias"],
+        }
+    q, s = quantize_int8(jnp.asarray(params["wte"]))
+    out["head"] = {"q": q, "s": s}
+    return out
+
+
+def gpt2_quant_decoder(model, params):
+    """(apply_fn, make_cache, qparams) for int8 decode of a `GPT2` —
+    mirrors the flax module's cached path (LN with bias, fused qkv,
+    causal cached attention at 1/sqrt(hd), GELU MLP, tied padded-vocab
+    head) with every matmul through `ops.int8_matmul`. Same
+    `generate.gpt2_decoder` apply_fn contract, ragged kwargs included."""
+    import math
+
+    from apex1_tpu.ops import layer_norm
+
+    cfg = model.cfg
+    dt = cfg.policy.compute_dtype
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    qparams = quantize_gpt2_params(params, cfg)
+
+    def mm(x, qw, b):
+        y = int8_matmul(x, qw["q"], qw["s"])
+        return (y + b.astype(jnp.float32)).astype(dt)
+
+    def ln(x, g, b):
+        if not cfg.policy.keep_norms_fp32:
+            g, b = g.astype(dt), b.astype(dt)
+        return layer_norm(x, g, b)
+
+    def apply_fn(qp, tokens, cache, cache_index, *, positions=None,
+                 segment_ids=None, valid_start=None):
+        B, S = tokens.shape
+        idx = jnp.asarray(cache_index, jnp.int32)
+        if positions is None:
+            positions = jnp.broadcast_to((idx + jnp.arange(S))[None],
+                                         (B, S))
+        x = (qp["wte"][tokens]
+             + jnp.take(qp["wpe"], positions, axis=0)).astype(dt)
+        new_cache = {}
+        for i in range(cfg.num_layers):
+            lp = qp[f"h{i}"]
+            h = ln(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dt)
+            qkv = mm(h, lp["qkv"], lp["qkv_b"])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q, k, v = (t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+                       for t in (q, k, v))
+            attn, new_cache[f"layer{i}"] = cached_attention(
+                q, k, v, cache[f"layer{i}"], cache_index,
+                sm_scale=1.0 / math.sqrt(hd),
+                segment_ids=segment_ids, valid_start=valid_start)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+            x = x + mm(attn, lp["proj"], lp["proj_b"])
+            y = ln(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)
+            y = jax.nn.gelu(mm(y, lp["fc_in"], lp["fc_in_b"]))
+            x = x + mm(y, lp["fc_out"], lp["fc_out_b"])
+        x = ln(x, qp["lnf_scale"], qp["lnf_bias"]).astype(dt)
+        logits = int8_matmul(x, qp["head"]["q"], qp["head"]["s"])
+        return logits, new_cache
+
+    def make_cache(batch: int, max_len: int, dtype=None):
+        return init_cache(cfg.num_layers, batch, nh, max_len, hd,
+                          dtype or dt)
+
+    return apply_fn, make_cache, qparams

@@ -162,6 +162,39 @@ class TestQuantDecode:
         assert np.abs(lq - lf).max() / denom < 0.15, (
             np.abs(lq - lf).max(), denom)
 
+    def test_gpt2_quant_generate_matches_full_precision(self):
+        """GPT-2 family on the int8 path: with int8-representable
+        weights the quantized decode emits the same tokens as the flax
+        model's cached decode."""
+        from apex1_tpu.models.generate import gpt2_decoder
+        from apex1_tpu.models.gpt2 import GPT2, GPT2Config
+        from apex1_tpu.models.quant_decode import gpt2_quant_decoder
+        cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=32)
+        model = GPT2(cfg)
+        rng = np.random.default_rng(13)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)),
+                             jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+
+        def fix(path, p):
+            name = path[-1].key if hasattr(path[-1], "key") else path[-1]
+            if name == "kernel" or name == "wte":
+                q = rng.integers(-127, 128, size=p.shape)
+                return jnp.asarray(q * 2e-3, jnp.float32)
+            return p
+
+        params = jax.tree_util.tree_map_with_path(fix, params)
+        N = 6
+        apply_q, make_cache, qparams = gpt2_quant_decoder(model, params)
+        got = generate(apply_q, qparams, prompt, max_new_tokens=N,
+                       cache=make_cache(2, 11),
+                       vocab_size=cfg.vocab_size)
+        apply_f, make_cache_f = gpt2_decoder(model)
+        want = generate(apply_f, params, prompt, max_new_tokens=N,
+                        cache=make_cache_f(2, 11),
+                        vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_moe_guarded(self):
         cfg = LlamaConfig.tiny(policy=get_policy("O0"), moe_every=1,
                                num_experts=2, moe_top_k=1)

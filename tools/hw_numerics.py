@@ -66,7 +66,7 @@ def check(name, fn, pallas_args, gold_args=None, tol=2e-2, grad_tol=5e-2,
 
     from apex1_tpu.ops import force_impl
 
-    if ONLY is not None and ONLY not in name:
+    if ONLY is not None and not any(s in name for s in ONLY):
         return
     gold_args = gold_args if gold_args is not None else pallas_args
     t0 = time.time()
@@ -138,12 +138,13 @@ def main():
                          "interpret mode — validates the script, not "
                          "Mosaic numerics)")
     ap.add_argument("--only", default=None,
-                    help="run only checks whose name contains this "
-                         "substring (e.g. 'bias' for the one check added "
-                         "after the round-3 hardware window)")
+                    help="comma-separated substrings: run only checks "
+                         "whose name contains one of them (e.g. "
+                         "'bias,int8' = the checks added after the "
+                         "round-3 hardware window)")
     args = ap.parse_args()
     global ONLY
-    ONLY = args.only
+    ONLY = args.only.split(",") if args.only else None
 
     backend = probe()
     if backend is None or (backend == "cpu" and not args.allow_cpu):
@@ -280,6 +281,15 @@ def _sweep(backend):
     check("rope_interleaved",
           lambda x: ops.apply_rotary_pos_emb(x, cos, sin, interleaved=True),
           (xr,), grad_argnums=(0,))
+
+    # --- int8 weight-only decode GEMM (added round 4; never yet run on
+    # silicon) — decode-row x vs a head-sized weight; dequant in VMEM ---
+    wq8, s8 = ops.quantize_int8(
+        jnp.asarray(rng.normal(size=(2048, 1024)) * 0.05, jnp.float32))
+    x8 = bf(8, 1024)
+    check("int8_matmul_decode",
+          lambda x: ops.int8_matmul(x, wq8, s8),
+          (x8,))
 
 
 if __name__ == "__main__":

@@ -111,7 +111,7 @@ else
 fi
 
 run bench_gpt2      1200 python bench.py --config gpt2 --timeout 1000
-run hw_num_bias      600 python tools/hw_numerics.py --only bias \
+run hw_num_new       600 python tools/hw_numerics.py --only bias,int8 \
                          --timeout 480 "${CPUQ[@]}"
 run bench_llama_blk 1800 python bench.py --config llama_block --timeout 1500
 run bench_bert_lg   1500 python bench.py --config bert_large --timeout 1200
