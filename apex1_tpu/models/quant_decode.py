@@ -136,8 +136,6 @@ def quantize_gpt2_params(params, cfg):
     and as the int8 LM head (``(padded_vocab, hidden)`` is already the
     kernel's (N, K) layout). Dense kernels stored (in, out) transpose
     once, here; LayerNorm scale/bias and the dense biases stay fp32."""
-    import math
-
     dt = cfg.policy.compute_dtype
 
     def qt(kernel):  # (in, out) -> (out, in)
@@ -199,8 +197,12 @@ def gpt2_quant_decoder(model, params):
         if positions is None:
             positions = jnp.broadcast_to((idx + jnp.arange(S))[None],
                                          (B, S))
+        # mode="fill" NaN mirrors the flax model's loud out-of-range
+        # positions (gpt2.py): a cache sized past max_seq_len must go
+        # non-finite, not clamp to the last learned position
         x = (qp["wte"][tokens]
-             + jnp.take(qp["wpe"], positions, axis=0)).astype(dt)
+             + jnp.take(qp["wpe"], positions, axis=0, mode="fill",
+                        fill_value=jnp.nan)).astype(dt)
         new_cache = {}
         for i in range(cfg.num_layers):
             lp = qp[f"h{i}"]
