@@ -17,4 +17,7 @@ from apex1_tpu.models.resnet import (  # noqa: F401
 from apex1_tpu.models.t5 import (  # noqa: F401
     T5, T5Config, t5_loss_fn)
 from apex1_tpu.models.generate import (  # noqa: F401
-    beam_search, generate, gpt2_decoder, llama_decoder, t5_generate)
+    beam_search, generate, gpt2_decoder, llama_decoder,
+    speculative_generate, t5_generate)
+from apex1_tpu.models.quant_decode import (  # noqa: F401
+    gpt2_quant_decoder, llama_quant_decoder)

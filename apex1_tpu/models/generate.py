@@ -42,7 +42,8 @@ def init_cache(num_layers: int, batch: int, num_kv_heads: int,
 
 def cached_attention(q, k_new, v_new, cache, cache_index, *,
                      sm_scale: Optional[float] = None, bias=None,
-                     segment_ids=None, valid_start=None):
+                     segment_ids=None, valid_start=None,
+                     chunk_decode: bool = False):
     """Attention through the KV cache. ``q``/``k_new``/``v_new``:
     (B, H, S, D)/(B, Hkv, S, D) for the CURRENT tokens; ``cache`` holds
     (B, Hkv, S_max, D); ``cache_index`` is the (traced) write position.
@@ -66,6 +67,12 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     cache slots ≥ each row's first real position (the left-pad K/V slots
     are garbage by construction).
 
+    ``chunk_decode=True`` is the third mode (speculative-decoding
+    verify): S > 1 NEW tokens against a NON-empty cache — query j
+    attends cache positions ≤ cache_index + j (history + causal within
+    the chunk), via the composite path with a per-query mask. S == 1
+    decode is the chunk_decode special case.
+
     Returns (attn (B, H, S, D), new_cache_entry).
     """
     B, Hq, S, D = q.shape
@@ -76,7 +83,7 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     v_all = jax.lax.dynamic_update_slice(
         cache["v"], v_new.astype(cache["v"].dtype), (0, 0, idx, 0))
     new_entry = {"k": k_all, "v": v_all}
-    if S > 1:
+    if S > 1 and not chunk_decode:
         # prefill attends only over the CURRENT tokens — valid only from
         # an empty cache. Fail fast on a concrete nonzero index (the
         # common prefill call passes a Python 0); a traced nonzero index
@@ -109,7 +116,10 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
             bias.shape[0], Hkv, group, S, -1)
     S_max = k_all.shape[2]
     pos = jnp.arange(S_max)
-    keep = pos[None, None, None, None, :] <= idx
+    # per-query horizon: query j sees cache slots <= idx + j (S == 1
+    # decode reduces to pos <= idx)
+    keep = (pos[None, None, None, None, :]
+            <= idx + jnp.arange(S)[None, None, None, :, None])
     if valid_start is not None:
         keep = keep & (pos[None, None, None, None, :]
                        >= valid_start.reshape(B, 1, 1, 1, 1))
@@ -238,9 +248,137 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     return jnp.concatenate([nxt[:, None], rest.T], axis=1)
 
 
-# ---------------------------------------------------------------------------
-# model adapters
-# ---------------------------------------------------------------------------
+def speculative_generate(target_fn, target_params, draft_fn, draft_params,
+                         prompt_tokens, *, max_new_tokens: int,
+                         target_cache, draft_cache, num_draft: int = 4,
+                         eos_id: Optional[int] = None, pad_id: int = 0,
+                         vocab_size: Optional[int] = None):
+    """Greedy speculative decoding: a cheap DRAFT model proposes
+    ``num_draft`` tokens autoregressively; the TARGET model scores all of
+    them in ONE chunk-verify forward (``chunk_decode=True`` — K+1 new
+    tokens against its cache, causal within the chunk); the longest
+    prefix agreeing with the target's own greedy choices is accepted,
+    plus the target's correction token. Output is TOKEN-IDENTICAL to
+    plain greedy decoding of the target alone — the draft only changes
+    how many target forwards it takes (1 per ~(accepted+1) tokens
+    instead of 1 per token; decode is HBM-bound, so fewer target weight
+    streams ≈ proportional speedup when the draft is much smaller).
+
+    TPU-first shape discipline: every round is fixed-size (K draft
+    steps + one (K+1)-token verify); per-row acceptance raggedness lives
+    in a ``lax.while_loop`` carried per row under ``jax.vmap`` (the
+    batching rule runs until every row finishes, masking finished rows)
+    — one dispatch, no host round-trips, static shapes throughout.
+
+    ``target_fn``/``draft_fn`` take the `llama_decoder`/`gpt2_decoder`
+    apply contract (incl. the ``chunk_decode`` kwarg). Caches must be
+    sized >= prompt_len + max_new_tokens + num_draft + 1 (rejected
+    speculative entries briefly occupy the tail before being
+    overwritten). Uniform prompt lengths only (no ``prompt_lens``).
+    Returns (tokens (B, max_new_tokens), target_forwards (B,)) — the
+    second output counts verify rounds per row (+1 prefill is implied),
+    the observable the speedup comes from.
+    """
+    B, S0 = prompt_tokens.shape
+    K = int(num_draft)
+    if K < 1:
+        raise ValueError(f"num_draft must be >= 1, got {K}")
+    for nm, c in (("target_cache", target_cache),
+                  ("draft_cache", draft_cache)):
+        s_max = jax.tree_util.tree_leaves(c)[0].shape[2]
+        if s_max < S0 + max_new_tokens + K + 1:
+            # dynamic_update_slice CLAMPS out-of-range writes — an
+            # undersized cache would silently overwrite earlier K/V and
+            # diverge from target-only greedy; fail at trace time
+            raise ValueError(
+                f"{nm} holds {s_max} positions but speculative decoding "
+                f"needs >= prompt + max_new_tokens + num_draft + 1 = "
+                f"{S0 + max_new_tokens + K + 1} (rejected speculative "
+                f"entries briefly occupy the tail)")
+
+    def greedy(logits):
+        # sample_token's temperature-0 path: fp32 + padded-vocab mask +
+        # argmax (rng unused)
+        return sample_token(logits, None, vocab_size=vocab_size)
+
+    # prefill both models at batch B (ordinary flash prefill)
+    logits_t, target_cache = target_fn(target_params, prompt_tokens,
+                                       target_cache, 0)
+    _, draft_cache = draft_fn(draft_params, prompt_tokens, draft_cache, 0)
+    t0 = greedy(logits_t[:, -1])                     # first emitted token
+
+    def row_loop(t0_row, cache_t_row, cache_d_row):
+        buf0 = jnp.full((max_new_tokens,), pad_id, jnp.int32)
+        buf0 = buf0.at[0].set(t0_row)
+        done0 = (jnp.asarray(False) if eos_id is None
+                 else (t0_row == eos_id))
+
+        def cond(carry):
+            _, count, _, _, done, _, _, _ = carry
+            return (count < max_new_tokens) & ~done
+
+        def body(carry):
+            buf, count, last, idx, done, cache_t, cache_d, rounds = carry
+
+            def dstep(c, _):
+                tok, dc, di = c
+                lg, dc = draft_fn(draft_params, tok.reshape(1, 1),
+                                  jax.tree_util.tree_map(
+                                      lambda x: x[None], dc), di)
+                dc = jax.tree_util.tree_map(lambda x: x[0], dc)
+                nxt = greedy(lg[0, -1])
+                return (nxt, dc, di + 1), nxt
+
+            # K+1 steps, not K: the last step feeds drafts[K-1] so its
+            # K/V lands in the draft cache (slot idx+K). Without it an
+            # all-accept round left that slot permanently zero yet
+            # attended, silently collapsing later acceptance rates (the
+            # extra draft forward is the cheap model — the premise of
+            # speculation)
+            (_, cache_d, _), drafts_ext = jax.lax.scan(
+                dstep, (last, cache_d, idx), None, length=K + 1)
+            drafts = drafts_ext[:K]
+
+            verify = jnp.concatenate([last[None], drafts])   # (K+1,)
+            lg_t, cache_t = target_fn(
+                target_params, verify[None],
+                jax.tree_util.tree_map(lambda x: x[None], cache_t), idx,
+                chunk_decode=True)
+            cache_t = jax.tree_util.tree_map(lambda x: x[0], cache_t)
+            tgt_next = greedy(lg_t[0])                       # (K+1,)
+
+            matches = (tgt_next[:K] == drafts).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(matches))   # leading-agreement count
+            j = jnp.arange(K + 1)
+            toks = jnp.where(j < a, jnp.concatenate([drafts, drafts[-1:]]),
+                             tgt_next)
+            keep = (j <= a) & (count + j < max_new_tokens)
+            if eos_id is not None:
+                prior_eos = jnp.cumsum(
+                    (toks == eos_id).astype(jnp.int32)) - (
+                        toks == eos_id).astype(jnp.int32)
+                keep = keep & (prior_eos == 0)
+            # one scatter: invalid lanes are routed out of range and
+            # dropped (kept indices are distinct, so no overlap)
+            buf = buf.at[jnp.where(keep, count + j, max_new_tokens)].set(
+                toks, mode="drop")
+            n_emit = jnp.sum(keep.astype(jnp.int32))
+            count = count + n_emit
+            if eos_id is not None:
+                done = done | jnp.any((toks == eos_id) & keep)
+            last = toks[a]
+            idx = idx + a + 1
+            return (buf, count, last, idx, done, cache_t, cache_d,
+                    rounds + 1)
+
+        init = (buf0, jnp.asarray(1, jnp.int32), t0_row,
+                jnp.asarray(S0, jnp.int32), done0, cache_t_row,
+                cache_d_row, jnp.asarray(0, jnp.int32))
+        buf, _, _, _, _, _, _, rounds = jax.lax.while_loop(cond, body,
+                                                           init)
+        return buf, rounds
+
+    return jax.vmap(row_loop)(t0, target_cache, draft_cache)
 
 def beam_search(apply_fn: Callable, params, prompt_tokens, *,
                 max_new_tokens: int, cache, num_beams: int = 4,
@@ -353,7 +491,7 @@ def _decoder(model, num_kv_heads: int, head_dim: int):
     cfg = model.cfg
 
     def apply_fn(params, tokens, cache, cache_index, *, positions=None,
-                 segment_ids=None, valid_start=None):
+                 segment_ids=None, valid_start=None, chunk_decode=False):
         B, S = tokens.shape
         if positions is None:
             pos = jnp.asarray(cache_index, jnp.int32) + jnp.arange(S)
@@ -361,7 +499,8 @@ def _decoder(model, num_kv_heads: int, head_dim: int):
         logits, new_cache = model.apply(
             {"params": params}, tokens, positions=positions,
             cache=cache, cache_index=cache_index,
-            segment_ids=segment_ids, valid_start=valid_start)
+            segment_ids=segment_ids, valid_start=valid_start,
+            chunk_decode=chunk_decode)
         return logits, new_cache
 
     def make_cache(batch: int, max_len: int, dtype=None):

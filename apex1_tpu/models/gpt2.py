@@ -65,7 +65,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, deterministic=True, segment_ids=None,
-                 cache=None, cache_index=None, valid_start=None):
+                 cache=None, cache_index=None, valid_start=None,
+                 chunk_decode=False):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         h = cfg.hidden_size
@@ -97,7 +98,8 @@ class Block(nn.Module):
             attn, new_cache = cached_attention(
                 q, k, v, cache, cache_index,
                 sm_scale=1.0 / math.sqrt(hd),
-                segment_ids=segment_ids, valid_start=valid_start)
+                segment_ids=segment_ids, valid_start=valid_start,
+                chunk_decode=chunk_decode)
         elif cfg.use_flash:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids,
@@ -130,7 +132,8 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, deterministic=True, return_hidden=False,
                  segment_ids=None, positions=None, cache=None,
-                 cache_index=None, valid_start=None):
+                 cache_index=None, valid_start=None,
+                 chunk_decode=False):
         """``segment_ids``/(B, S) ``positions`` enable packed batches
         (≙ fmha cu_seqlens varlen; see `runtime.pack_documents`) — tokens
         attend within their segment, learned positions gather per row.
@@ -162,7 +165,8 @@ class GPT2(nn.Module):
             out = Block(cfg, name=f"h{i}")(
                 x, deterministic=deterministic, segment_ids=segment_ids,
                 cache=None if cache is None else cache[f"layer{i}"],
-                cache_index=cache_index, valid_start=valid_start)
+                cache_index=cache_index, valid_start=valid_start,
+                chunk_decode=chunk_decode)
             if cache is None:
                 x = out
             else:

@@ -107,7 +107,8 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 cache_index=None, valid_start=None):
+                 cache_index=None, valid_start=None,
+                 chunk_decode=False):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         E, H, Hkv, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
@@ -137,7 +138,8 @@ class LlamaBlock(nn.Module):
             attn, new_cache = cached_attention(q, k, v, cache,
                                                cache_index,
                                                segment_ids=segment_ids,
-                                               valid_start=valid_start)
+                                               valid_start=valid_start,
+                                               chunk_decode=chunk_decode)
         elif self.seq_shard_axis is not None:
             if cfg.cp_impl == "ulysses":
                 attn = ulysses_attention(q, k, v, self.seq_shard_axis,
@@ -189,7 +191,7 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
                  return_hidden=False, cache=None, cache_index=None,
-                 valid_start=None):
+                 valid_start=None, chunk_decode=False):
         """``segment_ids`` (B, S) enables PACKED batches (≙ the reference
         fmha's cu_seqlens varlen): tokens attend only within their own
         segment. Pass per-segment ``positions`` (B, S) so RoPE restarts
@@ -235,7 +237,8 @@ class Llama(nn.Module):
                         name=f"layer{i}")(
                 x, cos, sin, segment_ids,
                 cache=None if cache is None else cache[f"layer{i}"],
-                cache_index=cache_index, valid_start=valid_start)
+                cache_index=cache_index, valid_start=valid_start,
+                chunk_decode=chunk_decode)
             if cache is None:
                 x = out
             else:

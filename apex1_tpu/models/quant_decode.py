@@ -83,7 +83,7 @@ def llama_quant_decoder(model, params):
         return g if cfg.policy.keep_norms_fp32 else g.astype(dt)
 
     def apply_fn(qp, tokens, cache, cache_index, *, positions=None,
-                 segment_ids=None, valid_start=None):
+                 segment_ids=None, valid_start=None, chunk_decode=False):
         # the keyword-only args carry the RAGGED (left-padded) masking,
         # exactly as in `generate.llama_decoder` — so the int8 path
         # composes with generate(prompt_lens=...)
@@ -111,7 +111,8 @@ def llama_quant_decoder(model, params):
             q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             attn, new_cache[f"layer{i}"] = cached_attention(
                 q, k, v, cache[f"layer{i}"], cache_index,
-                segment_ids=segment_ids, valid_start=valid_start)
+                segment_ids=segment_ids, valid_start=valid_start,
+                chunk_decode=chunk_decode)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, H * D)
             x = x + mm(attn, lp["wo"]).astype(x.dtype)
             h = rms_norm(x, norm_g(lp["mlp_norm"]),
@@ -191,7 +192,7 @@ def gpt2_quant_decoder(model, params):
         return layer_norm(x, g, b)
 
     def apply_fn(qp, tokens, cache, cache_index, *, positions=None,
-                 segment_ids=None, valid_start=None):
+                 segment_ids=None, valid_start=None, chunk_decode=False):
         B, S = tokens.shape
         idx = jnp.asarray(cache_index, jnp.int32)
         if positions is None:
@@ -214,7 +215,8 @@ def gpt2_quant_decoder(model, params):
             attn, new_cache[f"layer{i}"] = cached_attention(
                 q, k, v, cache[f"layer{i}"], cache_index,
                 sm_scale=1.0 / math.sqrt(hd),
-                segment_ids=segment_ids, valid_start=valid_start)
+                segment_ids=segment_ids, valid_start=valid_start,
+                chunk_decode=chunk_decode)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
             x = x + mm(attn, lp["proj"], lp["proj_b"])
             y = ln(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dt)

@@ -1,0 +1,168 @@
+"""Speculative decoding (`models.generate.speculative_generate`): the
+output must be TOKEN-IDENTICAL to plain greedy decoding of the target
+model alone — for any draft model (the draft changes only how many target
+forwards run). Also pins the chunk-verify attention mode it rides on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.core.policy import get_policy
+from apex1_tpu.models.generate import (generate, gpt2_decoder,
+                                       llama_decoder, speculative_generate)
+from apex1_tpu.models.gpt2 import GPT2, GPT2Config
+from apex1_tpu.models.llama import Llama, LlamaConfig
+
+
+class TestChunkVerifyAttention:
+    def test_chunk_decode_matches_token_by_token(self):
+        """Feeding K tokens with chunk_decode=True must give the same
+        last-position logits trajectory as K single-token decode steps."""
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=32)
+        model = Llama(cfg)
+        rng = np.random.default_rng(3)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)),
+                             jnp.int32)
+        extra = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 3)),
+                            jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+        apply_fn, make_cache = llama_decoder(model)
+
+        # path A: prefill + 3 single-token decodes
+        cache = make_cache(2, 16)
+        la, cache = apply_fn(params, prompt, cache, 0)
+        logits_steps = [la[:, -1]]
+        for t in range(3):
+            lt, cache = apply_fn(params, extra[:, t:t + 1], cache, 5 + t)
+            logits_steps.append(lt[:, -1])
+
+        # path B: prefill + ONE 3-token chunk-verify
+        cache2 = make_cache(2, 16)
+        lb, cache2 = apply_fn(params, prompt, cache2, 0)
+        lc, cache2 = apply_fn(params, extra, cache2, 5, chunk_decode=True)
+        np.testing.assert_allclose(np.asarray(lb[:, -1]),
+                                   np.asarray(logits_steps[0]),
+                                   rtol=2e-4, atol=2e-4)
+        for t in range(3):
+            np.testing.assert_allclose(
+                np.asarray(lc[:, t]), np.asarray(logits_steps[t + 1]),
+                rtol=2e-4, atol=2e-4,
+                err_msg=f"chunk position {t} diverged from step decode")
+
+
+class TestSpeculativeGenerate:
+    def _models(self, family):
+        rng = np.random.default_rng(17)
+        if family == "llama":
+            cfg_t = LlamaConfig.tiny(policy=get_policy("O0"),
+                                     max_seq_len=64)
+            cfg_d = LlamaConfig.tiny(policy=get_policy("O0"),
+                                     max_seq_len=64, num_layers=1,
+                                     hidden_size=32, ffn_size=64)
+            tgt, drf = Llama(cfg_t), Llama(cfg_d)
+            mk = llama_decoder
+        else:
+            cfg_t = GPT2Config.tiny(policy=get_policy("O0"),
+                                    max_seq_len=64)
+            cfg_d = GPT2Config.tiny(policy=get_policy("O0"),
+                                    max_seq_len=64, num_layers=1,
+                                    hidden_size=64)
+            tgt, drf = GPT2(cfg_t), GPT2(cfg_d)
+            mk = gpt2_decoder
+        prompt = jnp.asarray(rng.integers(1, cfg_t.vocab_size, (2, 5)),
+                             jnp.int32)
+        pt = tgt.init(jax.random.key(0), prompt)["params"]
+        pd = drf.init(jax.random.key(1), prompt)["params"]
+        t_fn, t_cache = mk(tgt)
+        d_fn, d_cache = mk(drf)
+        return (cfg_t, prompt, t_fn, pt, t_cache, d_fn, pd, d_cache)
+
+    @pytest.mark.parametrize("family", ["llama", "gpt2"])
+    @pytest.mark.parametrize("K", [1, 3, 4])
+    def test_matches_target_greedy(self, family, K):
+        (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
+            self._models(family)
+        N = 10
+        S0 = prompt.shape[1]
+        got, rounds = speculative_generate(
+            t_fn, pt, d_fn, pd, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_d(2, S0 + N + K + 1),
+            num_draft=K, vocab_size=cfg.vocab_size)
+        want = generate(t_fn, pt, prompt, max_new_tokens=N,
+                        cache=mk_t(2, S0 + N),
+                        vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert (np.asarray(rounds) >= 1).all()
+
+    @pytest.mark.parametrize("N", [9, 17, 25])
+    def test_self_draft_accepts_everything(self, N):
+        """Draft == target: every proposal matches, so each round emits
+        num_draft+1 tokens and rounds == ceil((N-1)/(K+1)) EXACTLY.
+        The longer N cases are the regression for the draft-cache hole
+        (review r4): the draft scan must also write drafts[K-1]'s K/V —
+        a skipped slot stayed zero yet attended, and acceptance silently
+        collapsed after the first all-accept round (observed 6 rounds vs
+        the ideal 4 at N=17 before the fix)."""
+        (cfg, prompt, t_fn, pt, mk_t, _, _, _) = self._models("llama")
+        K = 3
+        S0 = prompt.shape[1]
+        got, rounds = speculative_generate(
+            t_fn, pt, t_fn, pt, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_t(2, S0 + N + K + 1),
+            num_draft=K, vocab_size=cfg.vocab_size)
+        want = generate(t_fn, pt, prompt, max_new_tokens=N,
+                        cache=mk_t(2, S0 + N),
+                        vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # all-accept: ceil((N-1) / (K+1)) rounds after the prefill token
+        assert (np.asarray(rounds) == -(-(N - 1) // (K + 1))).all(), (
+            np.asarray(rounds))
+
+    def test_undersized_cache_raises(self):
+        (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
+            self._models("llama")
+        N, K = 8, 3
+        S0 = prompt.shape[1]
+        with pytest.raises(ValueError, match="positions"):
+            speculative_generate(
+                t_fn, pt, d_fn, pd, prompt, max_new_tokens=N,
+                target_cache=mk_t(2, S0 + N),  # generate() sizing: too small
+                draft_cache=mk_d(2, S0 + N + K + 1),
+                num_draft=K, vocab_size=cfg.vocab_size)
+
+    def test_eos_stops_and_pads(self):
+        (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
+            self._models("gpt2")
+        N, K = 10, 3
+        S0 = prompt.shape[1]
+        first, _ = speculative_generate(
+            t_fn, pt, d_fn, pd, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_d(2, S0 + N + K + 1),
+            num_draft=K, vocab_size=cfg.vocab_size)
+        eos = int(first[0, 2])  # a token row 0 actually emits mid-stream
+        got, _ = speculative_generate(
+            t_fn, pt, d_fn, pd, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_d(2, S0 + N + K + 1),
+            num_draft=K, vocab_size=cfg.vocab_size,
+            eos_id=eos, pad_id=0)
+        want = generate(t_fn, pt, prompt, max_new_tokens=N,
+                        cache=mk_t(2, S0 + N),
+                        vocab_size=cfg.vocab_size, eos_id=eos, pad_id=0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        row = np.asarray(got[0])
+        hits = np.nonzero(row == eos)[0]
+        assert hits.size > 0 and (row[hits[0] + 1:] == 0).all()
+
+    def test_bad_num_draft_raises(self):
+        (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
+            self._models("llama")
+        with pytest.raises(ValueError, match="num_draft"):
+            speculative_generate(
+                t_fn, pt, d_fn, pd, prompt, max_new_tokens=4,
+                target_cache=mk_t(2, 16), draft_cache=mk_d(2, 16),
+                num_draft=0)
