@@ -163,7 +163,8 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
              max_new_tokens: int, cache,
              temperature: float = 0.0, top_k: Optional[int] = None,
              rng=None, eos_id: Optional[int] = None, pad_id: int = 0,
-             vocab_size: Optional[int] = None, prompt_lens=None):
+             vocab_size: Optional[int] = None, prompt_lens=None,
+             cache_start: int = 0, return_cache: bool = False):
     """Prefill + single-dispatch decode loop.
 
     ``apply_fn(params, tokens, cache, cache_index) -> (logits, cache)``
@@ -186,6 +187,20 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     ``positions``/``segment_ids``/``valid_start`` kwargs
     (`gpt2_decoder`/`llama_decoder` provide them).
 
+    PREFIX CACHING: ``cache_start > 0`` continues from a cache already
+    holding that many positions — a shared system-prompt prefix
+    prefilled ONCE via ``apply_fn(params, prefix, cache, 0)``, or the
+    cache a previous ``generate(..., return_cache=True)`` handed back.
+    ``prompt_tokens`` are the NEW tokens appended after it. The
+    continuation prefill rides the chunk-decode attention mode (new
+    tokens attend the cached prefix + their own causal prefix), so the
+    shared prefix is never re-computed. Not combinable with
+    ``prompt_lens``.
+
+    ``return_cache=True`` returns ``(tokens, cache)`` — the cache after
+    the final decode step, positioned for a further
+    ``cache_start=cache_start + S0 + max_new_tokens`` continuation.
+
     The decode loop is a ``lax.scan`` — jit the whole call (e.g.
     ``jax.jit(functools.partial(generate, apply_fn, max_new_tokens=...,
     ...))``) for one-dispatch generation.
@@ -193,9 +208,25 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     B, S0 = prompt_tokens.shape
     if rng is None:
         rng = jax.random.key(0)
+    s_max = jax.tree_util.tree_leaves(cache)[0].shape[2]
+    if s_max < cache_start + S0 + max_new_tokens:
+        # dynamic_update_slice CLAMPS out-of-range writes: an undersized
+        # cache would repeatedly overwrite its last slot and silently
+        # diverge — the exact hazard speculative_generate also guards
+        raise ValueError(
+            f"cache holds {s_max} positions but this call needs "
+            f"cache_start + prompt + max_new_tokens = "
+            f"{cache_start + S0 + max_new_tokens}")
     kw = {}
     lens = None
-    if prompt_lens is not None:
+    if cache_start:
+        if prompt_lens is not None:
+            raise ValueError(
+                "cache_start (prefix caching) and prompt_lens (ragged "
+                "batches) cannot be combined — left-aligned rows would "
+                "shear against the shared cached prefix")
+        kw = dict(chunk_decode=True)
+    elif prompt_lens is not None:
         try:  # fail fast on concrete out-of-range lengths (a traced
             # lens skips the check); pad/position math below silently
             # scrambles the row otherwise
@@ -218,7 +249,8 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
             segment_ids=(jnp.arange(S0)[None, :]
                          >= pad[:, None]).astype(jnp.int32),
             valid_start=pad)
-    logits, cache = apply_fn(params, prompt_tokens, cache, 0, **kw)
+    logits, cache = apply_fn(params, prompt_tokens, cache, cache_start,
+                             **kw)
     rng, sub = jax.random.split(rng)
     nxt = sample_token(logits[:, -1], sub, temperature=temperature,
                        top_k=top_k, vocab_size=vocab_size)
@@ -242,10 +274,12 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
             done = done | (new == eos_id)
         return (new, idx + 1, cache, rng, done), new
 
-    (_, _, _, _, _), rest = jax.lax.scan(
-        body, (nxt, jnp.asarray(S0, jnp.int32), cache, rng, done),
+    (_, _, cache, _, _), rest = jax.lax.scan(
+        body, (nxt, jnp.asarray(cache_start + S0, jnp.int32), cache, rng,
+               done),
         None, length=max_new_tokens - 1)
-    return jnp.concatenate([nxt[:, None], rest.T], axis=1)
+    toks = jnp.concatenate([nxt[:, None], rest.T], axis=1)
+    return (toks, cache) if return_cache else toks
 
 
 def speculative_generate(target_fn, target_params, draft_fn, draft_params,

@@ -411,6 +411,99 @@ class TestRaggedGenerate:
         assert (row[hits[0] + 1:] == 0).all(), row
 
 
+class TestPrefixCaching:
+    """`generate(cache_start=L)`: prefill a shared prefix once, continue
+    many generations from it — tokens must equal the flat (prefix +
+    prompt in one go) decode exactly."""
+
+    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    def test_continuation_matches_flat_prompt(self, family):
+        if family == "gpt2":
+            cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+            model, mk = GPT2(cfg), gpt2_decoder
+        else:
+            cfg = LlamaConfig.tiny(policy=get_policy("O0"),
+                                   max_seq_len=64)
+            model, mk = Llama(cfg), llama_decoder
+        rng = np.random.default_rng(41)
+        B, Lp, Ls, N = 2, 6, 4, 5
+        prefix = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, Lp)),
+                             jnp.int32)
+        suffixes = jnp.asarray(rng.integers(1, cfg.vocab_size,
+                                            (2, B, Ls)), jnp.int32)
+        params = model.init(jax.random.key(0), prefix)["params"]
+        apply_fn, make_cache = mk(model)
+
+        # the shared prefix is prefilled ONCE
+        cache0 = make_cache(B, Lp + Ls + N)
+        _, cache0 = apply_fn(params, prefix, cache0, 0)
+
+        for s in range(2):  # two different continuations off one prefix
+            got = generate(apply_fn, params, suffixes[s],
+                           max_new_tokens=N,
+                           cache=jax.tree_util.tree_map(
+                               lambda c: c, cache0),
+                           cache_start=Lp, vocab_size=cfg.vocab_size)
+            flat = jnp.concatenate([prefix, suffixes[s]], axis=1)
+            want = generate(apply_fn, params, flat, max_new_tokens=N,
+                            cache=make_cache(B, Lp + Ls + N),
+                            vocab_size=cfg.vocab_size)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want),
+                err_msg=f"{family} continuation {s} diverged from flat")
+
+    def test_chained_generate_via_return_cache(self):
+        """generate(return_cache=True) hands back a cache positioned for
+        a further continuation: two chained calls must reproduce one
+        longer call exactly (greedy)."""
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = Llama(cfg)
+        rng = np.random.default_rng(43)
+        B, S0, N1, N2 = 2, 5, 4, 4
+        prompt = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, S0)),
+                             jnp.int32)
+        params = model.init(jax.random.key(0), prompt)["params"]
+        apply_fn, make_cache = llama_decoder(model)
+
+        t1, cache = generate(apply_fn, params, prompt,
+                             max_new_tokens=N1,
+                             cache=make_cache(B, S0 + N1 + 1 + N2),
+                             vocab_size=cfg.vocab_size,
+                             return_cache=True)
+        # the final emitted token was never FED (its K/V is not cached):
+        # it is the continuation's one-token prompt
+        t2 = generate(apply_fn, params, t1[:, -1:], max_new_tokens=N2,
+                      cache=cache, cache_start=S0 + N1 - 1,
+                      vocab_size=cfg.vocab_size)
+        want = generate(apply_fn, params, prompt,
+                        max_new_tokens=N1 + N2,
+                        cache=make_cache(B, S0 + N1 + N2),
+                        vocab_size=cfg.vocab_size)
+        got = jnp.concatenate([t1, t2], axis=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_undersized_cache_raises(self):
+        cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = GPT2(cfg)
+        prompts = jnp.ones((2, 4), jnp.int32)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_fn, make_cache = gpt2_decoder(model)
+        with pytest.raises(ValueError, match="cache holds"):
+            generate(apply_fn, params, prompts, max_new_tokens=8,
+                     cache=make_cache(2, 6))  # 4 + 8 > 6
+
+    def test_incompatible_with_ragged(self):
+        cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+        model = GPT2(cfg)
+        prompts = jnp.ones((2, 4), jnp.int32)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        apply_fn, make_cache = gpt2_decoder(model)
+        with pytest.raises(ValueError, match="cache_start"):
+            generate(apply_fn, params, prompts, max_new_tokens=2,
+                     cache=make_cache(2, 12), cache_start=3,
+                     prompt_lens=jnp.asarray([4, 2]))
+
+
 class TestBeamLengthPenalty:
     """ADVICE r3: in-beam pruning must use the SAME GNMT length-normalized
     metric as final selection. A table-driven Markov machine where the two

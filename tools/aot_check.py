@@ -235,6 +235,46 @@ def main():
 
             report(f"bench step [{cfg_name}]", run)
 
+        # speculative decoding: the vmap-of-while + chunk-verify program
+        # is the one control-flow construct no bench config exercises —
+        # prove it lowers for the real target (small model, real K)
+        def run_spec():
+            import functools
+
+            from apex1_tpu.core.policy import get_policy
+            from apex1_tpu.models.generate import (llama_decoder,
+                                                   speculative_generate)
+            from apex1_tpu.models.llama import Llama, LlamaConfig
+
+            cfg_t = LlamaConfig.tiny(policy=get_policy("O2"),
+                                     max_seq_len=128, num_layers=4,
+                                     hidden_size=256, ffn_size=512,
+                                     vocab_size=1024)
+            cfg_d = LlamaConfig.tiny(policy=get_policy("O2"),
+                                     max_seq_len=128, num_layers=1,
+                                     hidden_size=128, ffn_size=256,
+                                     vocab_size=1024)
+            tgt, drf = Llama(cfg_t), Llama(cfg_d)
+            prompt = jnp.zeros((4, 16), jnp.int32)
+            # init must be jitted: EAGER pallas on the CPU host under
+            # the Mosaic patches fails ("only interpret mode on CPU") —
+            # same rule the bench builders follow
+            pt = jax.jit(tgt.init)(jax.random.key(0), prompt)["params"]
+            pd = jax.jit(drf.init)(jax.random.key(1), prompt)["params"]
+            t_fn, mk_t = llama_decoder(tgt)
+            d_fn, mk_d = llama_decoder(drf)
+            N, K = 32, 4
+            spec = functools.partial(
+                speculative_generate, t_fn, pt, d_fn, pd,
+                max_new_tokens=N, num_draft=K, vocab_size=1024)
+            return jax.jit(spec).lower(
+                to_shape(prompt),
+                target_cache=to_shape(mk_t(4, 16 + N + K + 1)),
+                draft_cache=to_shape(mk_d(4, 16 + N + K + 1)))
+
+        report("speculative decode [vmap-of-while, chunk-verify]",
+               run_spec)
+
     if args.collectives:
         print(f"== distributed shard_map programs (ICI collectives + "
               f"Mosaic), {args.topology} ==", flush=True)
