@@ -142,6 +142,13 @@ def sample_token(logits, rng, *, temperature: float = 0.0,
         logits = jnp.where(mask, logits, NEG_INF)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _temperature_top_k(logits, temperature, top_k, vocab_size)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _temperature_top_k(logits, temperature, top_k, vocab_size):
+    """Shared temperature + top-k masking over (..., V) fp32 logits
+    (the padded-vocab tail must already be NEG_INF-masked)."""
     logits = logits / temperature
     if top_k is not None:
         if top_k < 1:
@@ -154,9 +161,9 @@ def sample_token(logits, rng, *, temperature: float = 0.0,
         if vocab_size is not None and vocab_size < eff_v:
             eff_v = vocab_size
         k = min(int(top_k), eff_v)
-        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
         logits = jnp.where(logits >= kth, logits, NEG_INF)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
 
 
 def generate(apply_fn: Callable, params, prompt_tokens, *,
@@ -282,21 +289,79 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     return (toks, cache) if return_cache else toks
 
 
+def _masked_probs(logits, *, temperature: float, top_k: Optional[int],
+                  vocab_size: Optional[int]):
+    """The probability distribution `sample_token` samples from: fp32,
+    padded-vocab tail masked, then the SHARED `_temperature_top_k`
+    pipeline (one implementation — a fix to the masking reaches both
+    the sampler and the speculative accept rule). (..., V) logits."""
+    lg = logits.astype(jnp.float32)
+    V = lg.shape[-1]
+    if vocab_size is not None and vocab_size < V:
+        lg = jnp.where(jnp.arange(V) < vocab_size, lg, NEG_INF)
+    return jax.nn.softmax(
+        _temperature_top_k(lg, temperature, top_k, vocab_size), axis=-1)
+
+
+def _speculative_accept(p, q, drafts, key):
+    """One round of the speculative-sampling accept/resample rule
+    (Leviathan et al. 2023; Chen et al. 2023): accept draft ``x_j`` with
+    probability ``min(1, p_j(x_j) / q_j(x_j))``; at the first rejection
+    emit a sample of the residual ``norm(max(p_j − q_j, 0))``; if all K
+    accepted emit a bonus sample of ``p_K``. The emitted sequence is
+    distributed EXACTLY as ancestral sampling from ``p``.
+
+    ``p``: (K+1, V) target probs, ``q``: (K, V) draft probs, ``drafts``:
+    (K,) proposed tokens. Returns ``(a, correction)`` — the accepted
+    count and the token to emit at position ``a``.
+    """
+    K = drafts.shape[0]
+    key_u, key_c = jax.random.split(key)
+    j = jnp.arange(K)
+    p_at = p[j, drafts]                               # p_j(x_j)
+    q_at = jnp.maximum(q[j, drafts], 1e-30)           # x_j ~ q_j => > 0
+    accept = jax.random.uniform(key_u, (K,)) < jnp.minimum(
+        1.0, p_at / q_at)
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    p_row = p[a]                                      # (V,) row a<=K
+    q_row = jnp.where(a == K, 0.0, q[jnp.minimum(a, K - 1)])
+    r = jnp.maximum(p_row - q_row, 0.0)               # residual (bonus:
+    s = jnp.sum(r)                                    #  q_row=0 => p_K)
+    r = jnp.where(s > 0, r / jnp.maximum(s, 1e-30), p_row)
+    corr = jax.random.categorical(
+        key_c, jnp.where(r > 0, jnp.log(jnp.maximum(r, 1e-30)),
+                         NEG_INF)).astype(jnp.int32)
+    return a, corr
+
+
 def speculative_generate(target_fn, target_params, draft_fn, draft_params,
                          prompt_tokens, *, max_new_tokens: int,
                          target_cache, draft_cache, num_draft: int = 4,
+                         temperature: float = 0.0,
+                         top_k: Optional[int] = None, rng=None,
                          eos_id: Optional[int] = None, pad_id: int = 0,
                          vocab_size: Optional[int] = None):
-    """Greedy speculative decoding: a cheap DRAFT model proposes
-    ``num_draft`` tokens autoregressively; the TARGET model scores all of
-    them in ONE chunk-verify forward (``chunk_decode=True`` — K+1 new
-    tokens against its cache, causal within the chunk); the longest
-    prefix agreeing with the target's own greedy choices is accepted,
-    plus the target's correction token. Output is TOKEN-IDENTICAL to
-    plain greedy decoding of the target alone — the draft only changes
-    how many target forwards it takes (1 per ~(accepted+1) tokens
-    instead of 1 per token; decode is HBM-bound, so fewer target weight
-    streams ≈ proportional speedup when the draft is much smaller).
+    """Speculative decoding: a cheap DRAFT model proposes ``num_draft``
+    tokens autoregressively; the TARGET model scores all of them in ONE
+    chunk-verify forward (``chunk_decode=True`` — K+1 new tokens against
+    its cache, causal within the chunk); the longest accepted prefix
+    plus one correction token are emitted per round. The draft only
+    changes how many target forwards it takes (1 per ~(accepted+1)
+    tokens instead of 1 per token; decode is HBM-bound, so fewer target
+    weight streams ≈ proportional speedup when the draft is much
+    smaller).
+
+    - ``temperature == 0`` (default): GREEDY — accept while the draft
+      matches the target's argmax; output is TOKEN-IDENTICAL to plain
+      greedy decoding of the target alone.
+    - ``temperature > 0``: SPECULATIVE SAMPLING — drafts are sampled
+      from the draft's (temperature/top-k) distribution and accepted by
+      the `_speculative_accept` rejection rule, so the emitted sequence
+      is distributed EXACTLY as ancestral sampling from the target's
+      (temperature/top-k) distribution; with draft == target the
+      acceptance ratio is 1 up to chunk-verify-vs-step-decode numerics
+      (~1e-4 rel on logits), so essentially every proposal is
+      accepted.
 
     TPU-first shape discipline: every round is fixed-size (K draft
     steps + one (K+1)-token verify); per-row acceptance raggedness lives
@@ -330,38 +395,61 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
                 f"{S0 + max_new_tokens + K + 1} (rejected speculative "
                 f"entries briefly occupy the tail)")
 
+    sampled = temperature != 0.0
+    if rng is None:
+        rng = jax.random.key(0)
+
     def greedy(logits):
         # sample_token's temperature-0 path: fp32 + padded-vocab mask +
         # argmax (rng unused)
         return sample_token(logits, None, vocab_size=vocab_size)
 
+    def probs(logits):
+        return _masked_probs(logits, temperature=temperature,
+                             top_k=top_k, vocab_size=vocab_size)
+
     # prefill both models at batch B (ordinary flash prefill)
     logits_t, target_cache = target_fn(target_params, prompt_tokens,
                                        target_cache, 0)
     _, draft_cache = draft_fn(draft_params, prompt_tokens, draft_cache, 0)
-    t0 = greedy(logits_t[:, -1])                     # first emitted token
+    rng, sub = jax.random.split(rng)
+    t0 = sample_token(logits_t[:, -1], sub, temperature=temperature,
+                      top_k=top_k, vocab_size=vocab_size)
+    row_keys = jax.random.split(rng, B)
 
-    def row_loop(t0_row, cache_t_row, cache_d_row):
+    def row_loop(t0_row, cache_t_row, cache_d_row, row_key):
         buf0 = jnp.full((max_new_tokens,), pad_id, jnp.int32)
         buf0 = buf0.at[0].set(t0_row)
         done0 = (jnp.asarray(False) if eos_id is None
                  else (t0_row == eos_id))
 
         def cond(carry):
-            _, count, _, _, done, _, _, _ = carry
+            _, count, _, _, done, _, _, _, _ = carry
             return (count < max_new_tokens) & ~done
 
         def body(carry):
-            buf, count, last, idx, done, cache_t, cache_d, rounds = carry
+            (buf, count, last, idx, done, cache_t, cache_d, rounds,
+             key) = carry
+            key, key_d, key_a = jax.random.split(key, 3)
 
-            def dstep(c, _):
+            def dstep(c, step_key):
                 tok, dc, di = c
                 lg, dc = draft_fn(draft_params, tok.reshape(1, 1),
                                   jax.tree_util.tree_map(
                                       lambda x: x[None], dc), di)
                 dc = jax.tree_util.tree_map(lambda x: x[0], dc)
-                nxt = greedy(lg[0, -1])
-                return (nxt, dc, di + 1), nxt
+                if sampled:
+                    q_row = probs(lg[0, -1])
+                    nxt = jax.random.categorical(
+                        step_key, jnp.where(
+                            q_row > 0, jnp.log(jnp.maximum(q_row, 1e-30)),
+                            NEG_INF)).astype(jnp.int32)
+                else:
+                    # greedy never divides by temperature=0 and carries
+                    # no (V,)-sized scan output
+                    q_row = jnp.zeros((lg.shape[-1],), jnp.float32)
+                    nxt = greedy(lg[0, -1])
+                return (nxt, dc, di + 1), (nxt, q_row)
 
             # K+1 steps, not K: the last step feeds drafts[K-1] so its
             # K/V lands in the draft cache (slot idx+K). Without it an
@@ -369,8 +457,9 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
             # attended, silently collapsing later acceptance rates (the
             # extra draft forward is the cheap model — the premise of
             # speculation)
-            (_, cache_d, _), drafts_ext = jax.lax.scan(
-                dstep, (last, cache_d, idx), None, length=K + 1)
+            (_, cache_d, _), (drafts_ext, q_ext) = jax.lax.scan(
+                dstep, (last, cache_d, idx),
+                jax.random.split(key_d, K + 1))
             drafts = drafts_ext[:K]
 
             verify = jnp.concatenate([last[None], drafts])   # (K+1,)
@@ -379,13 +468,21 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
                 jax.tree_util.tree_map(lambda x: x[None], cache_t), idx,
                 chunk_decode=True)
             cache_t = jax.tree_util.tree_map(lambda x: x[0], cache_t)
-            tgt_next = greedy(lg_t[0])                       # (K+1,)
 
-            matches = (tgt_next[:K] == drafts).astype(jnp.int32)
-            a = jnp.sum(jnp.cumprod(matches))   # leading-agreement count
             j = jnp.arange(K + 1)
-            toks = jnp.where(j < a, jnp.concatenate([drafts, drafts[-1:]]),
-                             tgt_next)
+            if sampled:
+                a, corr = _speculative_accept(probs(lg_t[0]), q_ext[:K],
+                                              drafts, key_a)
+                toks = jnp.where(
+                    j < a, jnp.concatenate([drafts, drafts[-1:]]),
+                    corr)
+            else:
+                tgt_next = greedy(lg_t[0])                   # (K+1,)
+                matches = (tgt_next[:K] == drafts).astype(jnp.int32)
+                a = jnp.sum(jnp.cumprod(matches))  # leading agreements
+                toks = jnp.where(
+                    j < a, jnp.concatenate([drafts, drafts[-1:]]),
+                    tgt_next)
             keep = (j <= a) & (count + j < max_new_tokens)
             if eos_id is not None:
                 prior_eos = jnp.cumsum(
@@ -403,16 +500,16 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
             last = toks[a]
             idx = idx + a + 1
             return (buf, count, last, idx, done, cache_t, cache_d,
-                    rounds + 1)
+                    rounds + 1, key)
 
         init = (buf0, jnp.asarray(1, jnp.int32), t0_row,
                 jnp.asarray(S0, jnp.int32), done0, cache_t_row,
-                cache_d_row, jnp.asarray(0, jnp.int32))
-        buf, _, _, _, _, _, _, rounds = jax.lax.while_loop(cond, body,
-                                                           init)
+                cache_d_row, jnp.asarray(0, jnp.int32), row_key)
+        buf, _, _, _, _, _, _, rounds, _ = jax.lax.while_loop(cond, body,
+                                                              init)
         return buf, rounds
 
-    return jax.vmap(row_loop)(t0, target_cache, draft_cache)
+    return jax.vmap(row_loop)(t0, target_cache, draft_cache, row_keys)
 
 def beam_search(apply_fn: Callable, params, prompt_tokens, *,
                 max_new_tokens: int, cache, num_beams: int = 4,

@@ -158,6 +158,81 @@ class TestSpeculativeGenerate:
         hits = np.nonzero(row == eos)[0]
         assert hits.size > 0 and (row[hits[0] + 1:] == 0).all()
 
+    def test_sampling_accept_rule_preserves_target_distribution(self):
+        """The round-level rejection rule is the mathematical heart of
+        speculative SAMPLING: for ANY draft distribution q, the law of
+        the first emitted token must be exactly p (Leviathan et al.).
+        Checked empirically on a tiny vocab against a deliberately
+        mismatched q, many independent rounds, fixed seed."""
+        from apex1_tpu.models.generate import _speculative_accept
+        V, K, TRIALS = 8, 3, 30000
+        rng = np.random.default_rng(0)
+        p_rows = rng.dirichlet(np.ones(V), size=K + 1)
+        q_rows = rng.dirichlet(np.ones(V) * 0.3, size=K)  # mismatched
+        p = jnp.asarray(p_rows, jnp.float32)
+        q = jnp.asarray(q_rows, jnp.float32)
+
+        def one(key):
+            kd, ka = jax.random.split(key)
+            drafts = jax.vmap(
+                lambda k, lq: jax.random.categorical(k, jnp.log(lq)))(
+                    jax.random.split(kd, K), q).astype(jnp.int32)
+            a, corr = _speculative_accept(p, q, drafts, ka)
+            # first emitted token: drafts[0] if a >= 1 else corr
+            return jnp.where(a >= 1, drafts[0], corr)
+
+        toks = jax.jit(jax.vmap(one))(
+            jax.random.split(jax.random.key(42), TRIALS))
+        emp = np.bincount(np.asarray(toks), minlength=V) / TRIALS
+        # ~3.5 sigma at 30k trials per bin
+        tol = 3.5 * np.sqrt(p_rows[0] * (1 - p_rows[0]) / TRIALS)
+        assert (np.abs(emp - p_rows[0]) < tol + 1e-3).all(), (
+            emp, p_rows[0], tol)
+
+    def test_sampled_self_draft_accepts_everything(self):
+        """temperature > 0 with draft == target: acceptance ratio
+        min(1, p/q) == 1 up to chunk-verify-vs-step-decode numerics
+        (~1e-4 rel), so rounds sit at the all-accept bound — allow one
+        extra round for a borderline uniform draw landing inside that
+        numeric window (review r4)."""
+        (cfg, prompt, t_fn, pt, mk_t, _, _, _) = self._models("llama")
+        N, K = 13, 3
+        S0 = prompt.shape[1]
+
+        def run():
+            return speculative_generate(
+                t_fn, pt, t_fn, pt, prompt, max_new_tokens=N,
+                target_cache=mk_t(2, S0 + N + K + 1),
+                draft_cache=mk_t(2, S0 + N + K + 1),
+                num_draft=K, temperature=0.8,
+                rng=jax.random.key(3), vocab_size=cfg.vocab_size)
+
+        toks, rounds = run()
+        bound = -(-(N - 1) // (K + 1))
+        assert (np.asarray(rounds) <= bound + 1).all(), (
+            np.asarray(rounds), bound)
+        toks2, _ = run()
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.asarray(toks2))
+        assert (np.asarray(toks) < cfg.vocab_size).all()
+
+    def test_sampled_runs_with_distinct_draft(self):
+        """Sampled spec decode with a real (different) draft: emits
+        valid tokens, respects eos padding, reproducible per seed."""
+        (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
+            self._models("gpt2")
+        N, K = 8, 2
+        S0 = prompt.shape[1]
+        toks, rounds = speculative_generate(
+            t_fn, pt, d_fn, pd, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_d(2, S0 + N + K + 1),
+            num_draft=K, temperature=0.7, top_k=20,
+            rng=jax.random.key(5), vocab_size=cfg.vocab_size)
+        assert toks.shape == (2, N)
+        assert (np.asarray(toks) < cfg.vocab_size).all()
+        assert (np.asarray(rounds) >= 1).all()
+
     def test_bad_num_draft_raises(self):
         (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
             self._models("llama")
