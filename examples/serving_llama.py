@@ -14,7 +14,10 @@ just fine-tuned). Demonstrates, on one model, the whole decode stack:
 5. speculative decoding — a small draft proposes, the target verifies a
    whole chunk per forward; output token-identical to the target's own
    greedy decode, with the per-row verify-round counts printed (the
-   speedup observable).
+   speedup observable);
+6. prefix caching — a shared system prompt prefilled once, two user
+   turns continued off it (`cache_start`), each token-exact vs the flat
+   prompt.
 
 ``python examples/serving_llama.py [--tiny] [--batch 2] [--prompt-len 8]
                                    [--new 16] [--beams 4]``
@@ -125,6 +128,33 @@ def main():
           f"{N - 1} greedy target forwards (untrained draft -> little "
           f"agreement; a distilled draft shrinks rounds toward "
           f"{(N - 1 + K) // (K + 1)})")
+
+    # prefix caching: prefill the "system prompt" once, continue turns
+    Ls = max(2, S0 // 2)
+    cache_pre = make_cache(B, S0 + Ls + N)
+    _, cache_pre = jax.jit(apply_fn)(params, prompt, cache_pre, 0)
+    agrees = []
+    for turn in range(2):
+        user = jnp.asarray(
+            np.random.default_rng(100 + turn).integers(
+                1, cfg.vocab_size, (B, Ls)), jnp.int32)
+        cont = timed(f"prefix-cached turn {turn}", lambda: generate(
+            apply_fn, params, user, max_new_tokens=N, cache=cache_pre,
+            cache_start=S0, vocab_size=cfg.vocab_size))
+        flat = generate(apply_fn, params,
+                        jnp.concatenate([prompt, user], 1),
+                        max_new_tokens=N, cache=make_cache(B, S0 + Ls + N),
+                        vocab_size=cfg.vocab_size)
+        agrees.append(float(
+            (np.asarray(cont) == np.asarray(flat)).mean()))
+    # this walkthrough runs the O2 (bf16) policy: the chunk-decode
+    # continuation prefill and the flat flash prefill round differently
+    # in bf16, so a near-tie argmax can flip — exactness holds at fp32
+    # (pinned in test_generate::TestPrefixCaching); report agreement
+    # like the int8 section rather than asserting it
+    print(f"    2 turns off one cached prefix; token agreement vs flat "
+          f"{[round(a, 2) for a in agrees]} (exact under fp32; bf16 "
+          f"rounds near-ties differently across the two prefill paths)")
     print("serving walkthrough done")
 
 

@@ -50,6 +50,38 @@ def test_roundtrip_amp_state(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_quantized_decode_params_round_trip(tmp_path):
+    """int8 serving trees (models.quant_decode) checkpoint bit-exactly —
+    int8 weights, fp32 scales, bf16 embedding table all survive orbax,
+    and a restored tree generates identical tokens."""
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.generate import generate
+    from apex1_tpu.models.llama import Llama, LlamaConfig
+    from apex1_tpu.models.quant_decode import llama_quant_decoder
+
+    # O2 so the embedding table really is bf16 (O0 would make every
+    # non-int8 leaf fp32 and silently drop the mixed-dtype coverage)
+    cfg = LlamaConfig.tiny(policy=get_policy("O2"), max_seq_len=32)
+    model = Llama(cfg)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)),
+                         jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+    assert any(l.dtype == jnp.bfloat16
+               for l in jax.tree.leaves(qparams))  # coverage is real
+    save_checkpoint(tmp_path / "q", qparams)
+    restored = restore_checkpoint(tmp_path / "q", template=qparams)
+    for a, b in zip(jax.tree.leaves(qparams), jax.tree.leaves(restored)):
+        assert a.dtype == b.dtype  # int8 stays int8
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t1 = generate(apply_q, qparams, prompt, max_new_tokens=4,
+                  cache=make_cache(2, 8), vocab_size=cfg.vocab_size)
+    t2 = generate(apply_q, restored, prompt, max_new_tokens=4,
+                  cache=make_cache(2, 8), vocab_size=cfg.vocab_size)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+
 def test_loss_scale_state_round_trips(tmp_path):
     amp, state, step = _state_and_step()
     state, _ = step(state, jnp.float32(1e30))   # overflow: scale halves
