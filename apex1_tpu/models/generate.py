@@ -205,8 +205,16 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     ``prompt_lens``.
 
     ``return_cache=True`` returns ``(tokens, cache)`` — the cache after
-    the final decode step, positioned for a further
-    ``cache_start=cache_start + S0 + max_new_tokens`` continuation.
+    the final decode step. The FINAL sampled token is never fed back
+    through the model, so its K/V is absent: the cache holds
+    ``cache_start + S0 + max_new_tokens - 1`` positions, and a
+    continuation must pass ``cache_start=cache_start + S0 +
+    max_new_tokens - 1`` with the final emitted token as the FIRST
+    token of its continuation prompt (see
+    ``test_chained_generate_via_return_cache``). Continuing at
+    ``+ max_new_tokens`` instead would leave a zero-K/V slot that
+    chunk-decode attention still attends and silently drop the last
+    token from context.
 
     The decode loop is a ``lax.scan`` — jit the whole call (e.g.
     ``jax.jit(functools.partial(generate, apply_fn, max_new_tokens=...,

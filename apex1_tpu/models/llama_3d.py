@@ -28,9 +28,13 @@ With ``moe=True`` the dense FFN becomes an expert-routed FFN on every
 layer: each (dp, ep, tp) rank dispatches its sequence-shard tokens over
 the ``ep`` axis (double ``all_to_all`` in
 `transformer.moe.moe_shard_map_apply`), expert weights ep-sharded —
-the full 4-axis dp × pp × ep × tp composition. (The router's aux
-balance loss is not threaded through the pipeline boundary; use the
-GSPMD `models.llama` ``moe_every`` path when the aux term matters.)
+the full 4-axis dp × pp × ep × tp composition. The router's aux
+balance loss IS threaded through the pipeline boundary: each stage's
+aux accumulates in a ``with_aux`` side channel carried alongside the
+boundary activation, summed into the last-stage loss (see the
+``stage`` closure and the ``with_aux=cfg.moe`` schedule call below;
+dryrun phase 4 asserts flat-vs-pipelined parity including the aux
+term).
 
 With ``cp > 1`` the sequence is additionally sharded over the cp axis
 (outer to the tp/SP split): attention becomes `parallel.ring_attention`

@@ -13,6 +13,8 @@
 # nproc=1, so parallel sharding cannot help here); pytest.ini's
 # `-n auto --maxprocesses=4` shards it on multi-core machines, where
 # 4 workers put the full suite well under the 20-minute target.
+# pytest-xdist is required by those addopts; on a box without it run
+# `pytest -o addopts='' tests/` (see pytest.ini).
 set -e
 cd "$(dirname "$0")/.."
 if [ "${1:-}" = "--all" ]; then
