@@ -242,28 +242,8 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
                 "shear against the shared cached prefix")
         kw = dict(chunk_decode=True)
     elif prompt_lens is not None:
-        try:  # fail fast on concrete out-of-range lengths (a traced
-            # lens skips the check); pad/position math below silently
-            # scrambles the row otherwise
-            lv = np.asarray(prompt_lens)
-        except Exception:
-            lv = None
-        if lv is not None and ((lv < 1).any() or (lv > S0).any()):
-            raise ValueError(
-                f"prompt_lens must lie in [1, {S0}] (the padded prompt "
-                f"width), got {lv.tolist()}")
-        lens = jnp.asarray(prompt_lens, jnp.int32)
-        pad = S0 - lens                             # left-pad widths (B,)
-        # left-align: row b shifts right by pad_b (one gather); the
-        # wrapped-in entries land in the pad region and are masked
-        gidx = (jnp.arange(S0)[None, :] - pad[:, None]) % S0
-        prompt_tokens = jnp.take_along_axis(prompt_tokens, gidx, axis=1)
-        kw = dict(
-            positions=jnp.maximum(
-                jnp.arange(S0)[None, :] - pad[:, None], 0),
-            segment_ids=(jnp.arange(S0)[None, :]
-                         >= pad[:, None]).astype(jnp.int32),
-            valid_start=pad)
+        prompt_tokens, kw, pad = _ragged_align(prompt_tokens, prompt_lens)
+        lens = S0 - pad
     logits, cache = apply_fn(params, prompt_tokens, cache, cache_start,
                              **kw)
     rng, sub = jax.random.split(rng)
@@ -295,6 +275,46 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
         None, length=max_new_tokens - 1)
     toks = jnp.concatenate([nxt[:, None], rest.T], axis=1)
     return (toks, cache) if return_cache else toks
+
+
+def _ragged_align(prompt_tokens, prompt_lens):
+    """LEFT-align a right-padded ragged batch and build the prefill
+    masking kwargs — the shared mechanics behind ``prompt_lens`` in
+    :func:`generate` AND :func:`speculative_generate` (contract
+    documented on `generate`). Returns ``(aligned_tokens, prefill_kw,
+    pad)`` where ``pad`` (B,) is each row's left-pad width (== its
+    decode-time ``valid_start``)."""
+    B, S0 = prompt_tokens.shape
+    try:  # fail fast on concrete out-of-range lengths (a traced
+        # lens skips the check); pad/position math below silently
+        # scrambles the row otherwise
+        lv = np.asarray(prompt_lens)
+    except Exception:
+        lv = None
+    if lv is not None and ((lv < 1).any() or (lv > S0).any()):
+        raise ValueError(
+            f"prompt_lens must lie in [1, {S0}] (the padded prompt "
+            f"width), got {lv.tolist()}")
+    lens = jnp.asarray(prompt_lens, jnp.int32)
+    pad = S0 - lens                             # left-pad widths (B,)
+    # left-align: row b shifts right by pad_b (one gather); the
+    # wrapped-in entries land in the pad region and are masked
+    gidx = (jnp.arange(S0)[None, :] - pad[:, None]) % S0
+    aligned = jnp.take_along_axis(prompt_tokens, gidx, axis=1)
+    # pad slots get segment -1, the repo-wide padding convention
+    # (`pack_documents`, xentropy's `label >= 0`): the flash kernel's
+    # equality mask only needs "different from the real segment", but
+    # MoE routing masks tokens with `segment_ids >= 0` — a 0-valued pad
+    # would be ROUTED and claim expert capacity, silently perturbing
+    # other rows' tokens (review r5)
+    kw = dict(
+        positions=jnp.maximum(
+            jnp.arange(S0)[None, :] - pad[:, None], 0),
+        segment_ids=jnp.where(
+            jnp.arange(S0)[None, :] >= pad[:, None], 1, -1
+        ).astype(jnp.int32),
+        valid_start=pad)
+    return aligned, kw, pad
 
 
 def _masked_probs(logits, *, temperature: float, top_k: Optional[int],
@@ -348,7 +368,8 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
                          temperature: float = 0.0,
                          top_k: Optional[int] = None, rng=None,
                          eos_id: Optional[int] = None, pad_id: int = 0,
-                         vocab_size: Optional[int] = None):
+                         vocab_size: Optional[int] = None,
+                         prompt_lens=None):
     """Speculative decoding: a cheap DRAFT model proposes ``num_draft``
     tokens autoregressively; the TARGET model scores all of them in ONE
     chunk-verify forward (``chunk_decode=True`` — K+1 new tokens against
@@ -381,7 +402,22 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
     apply contract (incl. the ``chunk_decode`` kwarg). Caches must be
     sized >= prompt_len + max_new_tokens + num_draft + 1 (rejected
     speculative entries briefly occupy the tail before being
-    overwritten). Uniform prompt lengths only (no ``prompt_lens``).
+    overwritten).
+
+    RAGGED batches: pass ``prompt_lens`` (B,) with ``prompt_tokens``
+    right-padded to a common S0 — the same left-align contract as
+    :func:`generate` (rows realigned once; per-row positions and
+    ``valid_start`` thread through BOTH models' draft steps and the
+    chunk-verify, so each row speculates exactly as if it were alone).
+    The draft and target see identical alignment, so acceptance
+    statistics are unaffected by padding.
+
+    The draft is ANY apply_fn with the decoder contract — including the
+    int8 `models.quant_decode` decoders (an int8 draft under a bf16
+    target changes only acceptance rates at temperature > 0; at
+    temperature 0 the output stays token-identical to the target's own
+    greedy decode, whatever the draft).
+
     Returns (tokens (B, max_new_tokens), target_forwards (B,)) — the
     second output counts verify rounds per row (+1 prefill is implied),
     the observable the speedup comes from.
@@ -416,16 +452,24 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
         return _masked_probs(logits, temperature=temperature,
                              top_k=top_k, vocab_size=vocab_size)
 
-    # prefill both models at batch B (ordinary flash prefill)
+    # prefill both models at batch B (ordinary flash prefill); ragged
+    # rows are left-aligned ONCE and both models see the same alignment
+    pad = None
+    pre_kw = {}
+    if prompt_lens is not None:
+        prompt_tokens, pre_kw, pad = _ragged_align(prompt_tokens,
+                                                   prompt_lens)
     logits_t, target_cache = target_fn(target_params, prompt_tokens,
-                                       target_cache, 0)
-    _, draft_cache = draft_fn(draft_params, prompt_tokens, draft_cache, 0)
+                                       target_cache, 0, **pre_kw)
+    _, draft_cache = draft_fn(draft_params, prompt_tokens, draft_cache, 0,
+                              **pre_kw)
     rng, sub = jax.random.split(rng)
     t0 = sample_token(logits_t[:, -1], sub, temperature=temperature,
                       top_k=top_k, vocab_size=vocab_size)
     row_keys = jax.random.split(rng, B)
 
-    def row_loop(t0_row, cache_t_row, cache_d_row, row_key):
+    def row_loop(t0_row, cache_t_row, cache_d_row, row_key,
+                 pad_row=None):
         buf0 = jnp.full((max_new_tokens,), pad_id, jnp.int32)
         buf0 = buf0.at[0].set(t0_row)
         done0 = (jnp.asarray(False) if eos_id is None
@@ -442,9 +486,14 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
 
             def dstep(c, step_key):
                 tok, dc, di = c
+                # ragged rows: the token at cache slot di is the row's
+                # (di - pad_row)-th token; left-pad K/V slots stay masked
+                dkw = ({} if pad_row is None else dict(
+                    positions=(di - pad_row).reshape(1, 1),
+                    valid_start=pad_row.reshape(1)))
                 lg, dc = draft_fn(draft_params, tok.reshape(1, 1),
                                   jax.tree_util.tree_map(
-                                      lambda x: x[None], dc), di)
+                                      lambda x: x[None], dc), di, **dkw)
                 dc = jax.tree_util.tree_map(lambda x: x[0], dc)
                 if sampled:
                     q_row = probs(lg[0, -1])
@@ -471,10 +520,14 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
             drafts = drafts_ext[:K]
 
             verify = jnp.concatenate([last[None], drafts])   # (K+1,)
+            vkw = ({} if pad_row is None else dict(
+                positions=(idx - pad_row
+                           + jnp.arange(K + 1)).reshape(1, K + 1),
+                valid_start=pad_row.reshape(1)))
             lg_t, cache_t = target_fn(
                 target_params, verify[None],
                 jax.tree_util.tree_map(lambda x: x[None], cache_t), idx,
-                chunk_decode=True)
+                chunk_decode=True, **vkw)
             cache_t = jax.tree_util.tree_map(lambda x: x[0], cache_t)
 
             j = jnp.arange(K + 1)
@@ -517,7 +570,10 @@ def speculative_generate(target_fn, target_params, draft_fn, draft_params,
                                                               init)
         return buf, rounds
 
-    return jax.vmap(row_loop)(t0, target_cache, draft_cache, row_keys)
+    if pad is None:
+        return jax.vmap(row_loop)(t0, target_cache, draft_cache, row_keys)
+    return jax.vmap(row_loop)(t0, target_cache, draft_cache, row_keys,
+                              pad)
 
 def beam_search(apply_fn: Callable, params, prompt_tokens, *,
                 max_new_tokens: int, cache, num_beams: int = 4,

@@ -28,6 +28,11 @@ import jax.numpy as jnp
 from apex1_tpu.models.generate import cached_attention, init_cache
 from apex1_tpu.ops import (apply_rotary_pos_emb, int8_matmul, quantize_int8,
                            rms_norm, rope_tables)
+from apex1_tpu.transformer.moe import MoEConfig, router
+
+
+def _is_moe_layer(cfg, i: int) -> bool:
+    return cfg.moe_every > 0 and i % cfg.moe_every == cfg.moe_every - 1
 
 
 def quantize_llama_params(params, cfg):
@@ -35,29 +40,47 @@ def quantize_llama_params(params, cfg):
     gather table; norms stay fp32; every matmul weight becomes
     ``{"q": int8 (out, in), "s": fp32 (out,)}`` (weights stored (in, out)
     in the flax tree are transposed into the kernel's (N, K) layout
-    once, here)."""
-    if cfg.moe_every > 0:
-        raise NotImplementedError(
-            "int8 decode covers dense Llama; MoE expert matmuls need the "
-            "a2a dispatch path quantized too")
+    once, here).
+
+    MoE layers (``cfg.moe_every > 0``): the stacked expert FFNs
+    ``w1 (E, H, F)`` / ``w2 (E, F, H)`` quantize PER EXPERT per out
+    channel — ``{"q": (E, out, in) int8, "s": (E, out) fp32}`` — since
+    expert weights are the bulk of an MoE checkpoint's bytes, exactly
+    the HBM-bound traffic int8 decode exists to halve. The router gate
+    stays fp32 (tiny, and routing decisions feed top-k: quantizing it
+    would flip near-tied expert choices for ~zero byte savings)."""
     dt = cfg.policy.compute_dtype
 
     def qt(w):  # (in, out) -> kernel layout (out, in)
         q, s = quantize_int8(jnp.asarray(w).T)
         return {"q": q, "s": s}
 
+    def qt_experts(w):  # (E, in, out) -> (E, out, in) + (E, out)
+        qs = [quantize_int8(jnp.asarray(w[e]).T)
+              for e in range(w.shape[0])]
+        return {"q": jnp.stack([q for q, _ in qs]),
+                "s": jnp.stack([s for _, s in qs])}
+
     out = {"tok_embeddings": params["tok_embeddings"].astype(dt),
            "norm": params["norm"]}
     for i in range(cfg.num_layers):
         lp = params[f"layer{i}"]
-        out[f"layer{i}"] = {
+        qlp = {
             "attn_norm": lp["attn_norm"],
             "mlp_norm": lp["mlp_norm"],
             "wq": qt(lp["wq"]), "wk": qt(lp["wk"]), "wv": qt(lp["wv"]),
             "wo": qt(lp["wo"]),
-            "w_gate": qt(lp["w_gate"]), "w_up": qt(lp["w_up"]),
-            "w_down": qt(lp["w_down"]),
         }
+        if _is_moe_layer(cfg, i):
+            qlp["moe"] = {
+                "router": jnp.asarray(lp["moe"]["router"], jnp.float32),
+                "w1": qt_experts(lp["moe"]["w1"]),
+                "w2": qt_experts(lp["moe"]["w2"]),
+            }
+        else:
+            qlp.update(w_gate=qt(lp["w_gate"]), w_up=qt(lp["w_up"]),
+                       w_down=qt(lp["w_down"]))
+        out[f"layer{i}"] = qlp
     # head is stored (vocab, hidden) = (N, K) already
     q, s = quantize_int8(jnp.asarray(params["output"]))
     out["output"] = {"q": q, "s": s}
@@ -81,6 +104,35 @@ def llama_quant_decoder(model, params):
 
     def norm_g(g):
         return g if cfg.policy.keep_norms_fp32 else g.astype(dt)
+
+    moecfg = (None if cfg.moe_every <= 0 else MoEConfig(
+        num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        aux_loss_weight=cfg.moe_aux_loss_weight,
+        hidden_size=cfg.hidden_size, ffn_size=cfg.ffn_size))
+
+    def moe_ffn(h, qm, segment_ids):
+        """Dense-dispatch MoE FFN (the `transformer.moe.MoEMLP` decode
+        math — same router, same capacity/drop semantics) with the
+        expert matmuls through `ops.int8_matmul` per expert. Aux loss is
+        computed-and-dropped: decode has no optimizer to feed it."""
+        lead, H = h.shape[:-1], h.shape[-1]
+        x2 = h.reshape(-1, H)
+        mask = (None if segment_ids is None
+                else (segment_ids >= 0).reshape(-1))
+        dispatch, combine, _aux = router(x2, qm["router"], moecfg, mask)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(dt),
+                        x2.astype(dt))                    # (E, C, H)
+        q1, s1 = qm["w1"]["q"], qm["w1"]["s"]             # (E, F, H)
+        q2, s2 = qm["w2"]["q"], qm["w2"]["s"]             # (E, H, F)
+        ye = jnp.stack([
+            int8_matmul(jax.nn.silu(
+                int8_matmul(xe[e], q1[e], s1[e]).astype(dt)),
+                q2[e], s2[e])
+            for e in range(moecfg.num_experts)])          # (E, C, H)
+        y = jnp.einsum("tec,ech->th", combine.astype(dt),
+                       ye.astype(dt))
+        return y.reshape(*lead, H)
 
     def apply_fn(qp, tokens, cache, cache_index, *, positions=None,
                  segment_ids=None, valid_start=None, chunk_decode=False):
@@ -117,8 +169,11 @@ def llama_quant_decoder(model, params):
             x = x + mm(attn, lp["wo"]).astype(x.dtype)
             h = rms_norm(x, norm_g(lp["mlp_norm"]),
                          eps=cfg.norm_eps).astype(dt)
-            y = mm(jax.nn.silu(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]),
-                   lp["w_down"])
+            if _is_moe_layer(cfg, i):
+                y = moe_ffn(h, lp["moe"], segment_ids)
+            else:
+                y = mm(jax.nn.silu(mm(h, lp["w_gate"]))
+                       * mm(h, lp["w_up"]), lp["w_down"])
             x = x + y.astype(x.dtype)
         x = rms_norm(x, norm_g(qp["norm"]), eps=cfg.norm_eps).astype(dt)
         logits = int8_matmul(x, qp["output"]["q"], qp["output"]["s"])

@@ -56,9 +56,15 @@ def quantize_int8(w, *, axis: int = -1):
 def _dequant_matmul_xla(x, wq, scale):
     """Gold composite: explicit dequant then matmul (XLA fuses the dequant
     into the dot's operand stream, but still reads int8 + writes bf16
-    unless it fuses — the kernel guarantees the fusion)."""
-    w = wq.astype(jnp.bfloat16) * scale[:, None].astype(jnp.bfloat16)
-    return jnp.matmul(x, w.T, preferred_element_type=jnp.float32)
+    unless it fuses — the kernel guarantees the fusion). The per-channel
+    scale stays fp32 and multiplies the fp32 accumulator output, exactly
+    as the Pallas kernel does — both paths share one numerics contract
+    (a bf16-cast scale here would make the gold ~0.4% noisier than the
+    kernel it golds, and shape-dependent, since this composite is also
+    the unaligned-shape fallback)."""
+    y = jnp.matmul(x, wq.astype(jnp.bfloat16).T,
+                   preferred_element_type=jnp.float32)
+    return y * scale.astype(jnp.float32)
 
 
 def _int8_mm_kernel(x_ref, wq_ref, scale_ref, o_ref):

@@ -18,9 +18,12 @@ Other BASELINE configs are measurable with ``--config``:
                  per-token attention/kernel work is the benchmarked path.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md); the
-comparator is a literature-proxy A100 throughput for the same config class
-with torch+apex-style mixed precision. >1.0 = beating the A100-class
-number per chip.
+denominator is the PINNED A100 comparator from BASELINE.md "Pinned A100
+comparator" — stated-assumption arithmetic (40%-MFU A100 for training,
+0.6x HBM roofline for decode, NGC-class figure for ResNet). >= 1.0 is
+the north-star "match A100" inequality; on the v5e bench chip, 0.63
+(training) / 0.40 (decode) is already per-spec parity (see BASELINE.md
+chip-context note).
 
 Timing methodology: the measured run is ONE dispatch — iters steps ride a
 ``lax.fori_loop`` on device, so host→device dispatch latency (large and
@@ -170,7 +173,7 @@ def bench_gpt2(on_accel, batch=None, seq=None):
     name = "GPT-2-125M" if on_accel else "GPT-2(tiny smoke)"
     return (state, step, (tokens,), B * S, iters,
             f"tokens/sec/chip {name} amp-O2 fused_adam", "tokens/sec/chip",
-            150_000.0)
+            145_000.0)   # BASELINE.md pinned A100 row: gpt2
 
 
 def bench_bert(on_accel, large=False):
@@ -197,7 +200,8 @@ def bench_bert(on_accel, large=False):
     state, step = _amp_state_step(bert_pretrain_loss_fn(model), params)
     name = (("BERT-large-pretrain" if large else "BERT-base-pretrain")
             if on_accel else "BERT(tiny smoke)")
-    proxy = 20_000.0 if large else 60_000.0
+    # BASELINE.md pinned A100 rows: bert_large / bert
+    proxy = 57_500.0 if large else 173_000.0
     return (state, step, (batch,), B * S, iters,
             f"tokens/sec/chip {name} amp-O2 fused_adam", "tokens/sec/chip",
             proxy)
@@ -245,7 +249,7 @@ def bench_resnet(on_accel):
     name = "ResNet-50" if on_accel else "ResNet(tiny smoke)"
     return ((state, bn0), step, (images, labels), B, iters,
             f"images/sec/chip {name} amp-O2 fused_sgd", "images/sec/chip",
-            1_400.0)
+            2_900.0)   # BASELINE.md pinned A100 row: resnet (NGC-class)
 
 
 def _bench_llama(on_accel, *, accel_cfg, accel_bsi, tiny_seq, name, proxy):
@@ -288,7 +292,8 @@ def bench_llama_longctx(on_accel):
             num_heads=32, num_kv_heads=4, hidden_size=2048,
             ffn_size=5632, remat=True, policy=pol),
         accel_bsi=(1, 16384, 4), tiny_seq=512,
-        name="Llama-0.8B-16k-flash", proxy=12_000.0)
+        name="Llama-0.8B-16k-flash",
+        proxy=11_100.0)   # BASELINE.md pinned A100 row: llama_longctx
 
 
 def bench_llama_block(on_accel):
@@ -309,7 +314,8 @@ def bench_llama_block(on_accel):
             num_heads=32, num_kv_heads=8, hidden_size=4096,
             ffn_size=14336, remat=True, policy=pol),
         accel_bsi=(2, 4096, 6), tiny_seq=256,
-        name="Llama-8B-width-3L", proxy=9_000.0)
+        name="Llama-8B-width-3L",
+        proxy=20_800.0)   # BASELINE.md pinned A100 row: llama_block
 
 
 def bench_t5(on_accel):
@@ -340,7 +346,7 @@ def bench_t5(on_accel):
     name = "T5-0.4B-encdec" if on_accel else "T5(tiny smoke)"
     return (state, step, (enc, dec), B * (S_enc + S_dec), iters,
             f"tokens/sec/chip {name} amp-O2 fused_adam", "tokens/sec/chip",
-            30_000.0)
+            48_000.0)   # BASELINE.md pinned A100 row: t5
 
 
 def bench_decode(on_accel, quant=False):
@@ -351,10 +357,11 @@ def bench_decode(on_accel, quant=False):
     weight-only path (`models.quant_decode`): decode is HBM-bound, so
     int8 weights should approach 2x the bf16 tokens/sec at small batch.
 
-    Proxy comparator: ~0.8B-class bf16 decode at B=8 on an A100-class
-    chip, a LITERATURE-ORDER estimate (~4k tok/s aggregate) — decode
-    numbers vary widely with serving stack; treat vs_baseline here as
-    orientation, not a measured A100 run.
+    Comparator: BASELINE.md pinned A100 decode rows — the 0.8B model's
+    weight-streaming HBM roofline at B=8 x 0.6 achieved bandwidth
+    (bf16 6.1k tok/s, int8 12.2k). Not a measured A100 run; the
+    assumptions are stated in BASELINE.md and the int8 row credits the
+    comparator with its own int8 path.
     """
     import functools as ft
 
@@ -398,9 +405,11 @@ def bench_decode(on_accel, quant=False):
         metrics = {"loss": jnp.mean(toks.astype(jnp.float32))}
         return state, metrics
 
+    # BASELINE.md pinned A100 rows: decode / decode_int8
+    proxy = 12_200.0 if quant else 6_100.0
     return ((decode_params,), step, (prompt,), B * N, iters,
             f"decode tokens/sec/chip {name}", "tokens/sec/chip",
-            4_000.0)
+            proxy)
 
 
 BENCHES = {

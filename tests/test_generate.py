@@ -348,6 +348,105 @@ class TestRaggedGenerate:
                 err_msg=f"{family} row {b} (len {ln}) diverged from its "
                         f"solo decode")
 
+    def _moe_ragged(self, capacity_factor):
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=64,
+                               moe_every=1, num_experts=2, moe_top_k=1,
+                               moe_capacity_factor=capacity_factor)
+        model = Llama(cfg)
+        rng = np.random.default_rng(37)
+        S0, lens = 6, [6, 3, 5]
+        prompts = np.asarray(rng.integers(1, cfg.vocab_size, (3, S0)),
+                             np.int32)
+        pad_mask = np.arange(S0)[None, :] < np.asarray(lens)[:, None]
+        params = model.init(jax.random.key(0),
+                            jnp.asarray(prompts))["params"]
+        return cfg, model, params, prompts, pad_mask, lens
+
+    def test_ragged_moe_pad_content_invariance(self):
+        """MoE x ragged (review r5): pad tokens must claim NO expert
+        capacity — with a tight capacity factor, a routed pad would
+        displace another row's valid token from its expert, so the
+        output would depend on pad-slot CONTENT. Two different pad
+        garbage fills must decode identically."""
+        cfg, model, params, prompts, pad_mask, lens = self._moe_ragged(
+            capacity_factor=0.75)
+        apply_fn, make_cache = llama_decoder(model)
+        N = 5
+        outs = []
+        for fill in (0, 7):
+            p = jnp.asarray(np.where(pad_mask, prompts, fill), jnp.int32)
+            outs.append(np.asarray(generate(
+                apply_fn, params, p, max_new_tokens=N,
+                cache=make_cache(3, prompts.shape[1] + N),
+                vocab_size=cfg.vocab_size,
+                prompt_lens=jnp.asarray(lens, jnp.int32))))
+        np.testing.assert_array_equal(
+            outs[0], outs[1],
+            err_msg="pad-slot content leaked into MoE ragged decode "
+                    "(pads claiming expert capacity?)")
+
+    def test_ragged_moe_rows_match_solo_decode(self):
+        """MoE x ragged with AMPLE capacity (no expert ever overflows,
+        so batched-vs-solo capacity coupling vanishes): each row must
+        match its solo decode exactly, like the dense-model contract."""
+        cfg, model, params, prompts, pad_mask, lens = self._moe_ragged(
+            capacity_factor=4.0)
+        apply_fn, make_cache = llama_decoder(model)
+        N = 5
+        p = jnp.asarray(np.where(pad_mask, prompts, 0), jnp.int32)
+        got = generate(apply_fn, params, p, max_new_tokens=N,
+                       cache=make_cache(3, p.shape[1] + N),
+                       vocab_size=cfg.vocab_size,
+                       prompt_lens=jnp.asarray(lens, jnp.int32))
+        for b, ln in enumerate(lens):
+            solo = generate(apply_fn, params, p[b:b + 1, :ln],
+                            max_new_tokens=N, cache=make_cache(1, ln + N),
+                            vocab_size=cfg.vocab_size)
+            np.testing.assert_array_equal(
+                np.asarray(got[b]), np.asarray(solo[0]),
+                err_msg=f"MoE row {b} (len {ln}) diverged from solo")
+
+    def test_moe_prefix_cache_continuation_matches_flat(self):
+        """docs/serving.md matrix: MoE x prefix caching — a prefix
+        prefilled once through the MoE decoder, continued via
+        cache_start, equals the flat decode (ample capacity so the
+        prefill-vs-chunk token-count split cannot change drop
+        behavior)."""
+        cfg, model, params, prompts, pad_mask, lens = self._moe_ragged(
+            capacity_factor=4.0)
+        apply_fn, make_cache = llama_decoder(model)
+        B, Lp, Ls, N = 3, 4, 2, 4
+        full = jnp.asarray(np.where(pad_mask, prompts, 1), jnp.int32)
+        prefix, suffix = full[:, :Lp], full[:, Lp:Lp + Ls]
+        cache0 = make_cache(B, Lp + Ls + N)
+        _, cache0 = apply_fn(params, prefix, cache0, 0)
+        got = generate(apply_fn, params, suffix, max_new_tokens=N,
+                       cache=cache0, cache_start=Lp,
+                       vocab_size=cfg.vocab_size)
+        want = generate(apply_fn, params, full[:, :Lp + Ls],
+                        max_new_tokens=N,
+                        cache=make_cache(B, Lp + Ls + N),
+                        vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_moe_beam1_equals_greedy(self):
+        """docs/serving.md matrix: MoE x beam — num_beams=1 beam search
+        over the MoE decoder reduces to its greedy decode."""
+        from apex1_tpu.models.generate import beam_search
+        cfg, model, params, prompts, pad_mask, lens = self._moe_ragged(
+            capacity_factor=4.0)
+        apply_fn, make_cache = llama_decoder(model)
+        p = jnp.asarray(np.where(pad_mask, prompts, 1), jnp.int32)
+        N = 4
+        beam, _ = beam_search(apply_fn, params, p, max_new_tokens=N,
+                              cache=make_cache(3, p.shape[1] + N),
+                              num_beams=1, vocab_size=cfg.vocab_size)
+        greedy = generate(apply_fn, params, p, max_new_tokens=N,
+                          cache=make_cache(3, p.shape[1] + N),
+                          vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(beam),
+                                      np.asarray(greedy))
+
     def test_prompt_lens_out_of_range_raises(self):
         cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
         model = GPT2(cfg)

@@ -1,0 +1,184 @@
+"""Cross-PROCESS tensor/pipeline parallelism — VERDICT r4 Missing #4.
+
+Every tp/pp/ep/cp collective elsewhere in the suite runs single-process
+on 8 virtual devices; only pure-dp psum ever crossed a real process
+boundary (`test_fault_recovery`). Real multi-controller JAX (one
+process per host, per-process device subsets) exercises different
+runtime paths — global-array assembly from per-process shards,
+cross-host ppermute, collective orbax barriers over SHARDED state — the
+paths a TPU pod hits (SURVEY.md §4.2.4's distributed-tests tier).
+
+Harness: `parallel.multiproc.launch` spawns 2 CPU processes x 1 device
+joined by `jax.distributed.initialize`; each child runs the distributed
+program AND the single-device gold math (same seed), asserting parity
+shard-by-shard in-process, so the host test only checks exit codes.
+"""
+
+import os
+import pathlib
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow  # full run via check_all.sh --all
+
+_REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def _launch(tmp_path, body, args=(), *, port):
+    from apex1_tpu.parallel import multiproc
+
+    script = tmp_path / "child.py"
+    script.write_text(_PRELUDE + textwrap.dedent(body))
+    return multiproc.launch(
+        str(script), [str(a) for a in args], num_processes=2,
+        cpu_devices_per_process=1, coordinator_port=port,
+        env={"PYTHONPATH": _REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+
+
+_PRELUDE = textwrap.dedent("""
+    import sys
+    import jax
+    from apex1_tpu.parallel import multiproc
+    multiproc.init_from_env()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    assert len(jax.local_devices()) == 1  # the multi-controller property
+
+    def mk(mesh, full, spec):
+        # global array assembled from PER-PROCESS shards — the exact
+        # multi-controller path single-process tests cannot reach
+        return jax.make_array_from_callback(
+            full.shape, NamedSharding(mesh, spec), lambda idx: full[idx])
+
+    def check_shards(got, full_gold, name, tol=2e-5):
+        for s in got.addressable_shards:
+            np.testing.assert_allclose(
+                np.asarray(s.data), full_gold[s.index],
+                rtol=tol, atol=tol, err_msg=name)
+""")
+
+
+_TP_CHILD = """
+from apex1_tpu.transformer.tensor_parallel import layers as tpl
+from apex1_tpu.checkpoint import CheckpointManager
+
+ckdir = sys.argv[1]
+mesh = Mesh(np.array(jax.devices()), ("tp",))
+rng = np.random.default_rng(0)
+B, H, F = 4, 8, 16
+xf = rng.normal(size=(B, H)).astype(np.float32)
+w1f = (rng.normal(size=(H, F)) * 0.1).astype(np.float32)
+b1f = (rng.normal(size=(F,)) * 0.1).astype(np.float32)
+w2f = (rng.normal(size=(F, H)) * 0.1).astype(np.float32)
+
+x = mk(mesh, xf, P())
+w1 = mk(mesh, w1f, P(None, "tp"))
+b1 = mk(mesh, b1f, P("tp"))
+w2 = mk(mesh, w2f, P("tp", None))
+
+def local(x, w1, b1, w2):
+    def loss_fn(w1, b1, w2):
+        h = tpl.column_parallel_linear(x, w1, b1)
+        h = jax.nn.gelu(h)
+        y = tpl.row_parallel_linear(h, w2)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+    return jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(w1, b1, w2)
+
+step = jax.jit(jax.shard_map(
+    local, mesh=mesh,
+    in_specs=(P(), P(None, "tp"), P("tp"), P("tp", None)),
+    out_specs=(P(), (P(None, "tp"), P("tp"), P("tp", None))),
+    check_vma=False))
+loss, (gw1, gb1, gw2) = step(x, w1, b1, w2)
+
+def gold_loss(w1, b1, w2):
+    h = jax.nn.gelu(xf @ w1 + b1)
+    return jnp.sum((h @ w2).astype(jnp.float32) ** 2)
+
+gl, (ggw1, ggb1, ggw2) = jax.value_and_grad(
+    gold_loss, argnums=(0, 1, 2))(jnp.asarray(w1f), jnp.asarray(b1f),
+                                  jnp.asarray(w2f))
+np.testing.assert_allclose(float(loss), float(gl), rtol=2e-5)
+check_shards(gw1, np.asarray(ggw1), "gw1")
+check_shards(gb1, np.asarray(ggb1), "gb1")
+check_shards(gw2, np.asarray(ggw2), "gw2")
+
+# cross-process checkpoint of TP-SHARDED state: orbax's collective save
+# barriers + shard reassembly on restore (the dp fault test only ever
+# checkpointed replicated state)
+state = {"w1": gw1, "b1": gb1, "w2": gw2}
+specs = {"w1": P(None, "tp"), "b1": P("tp"), "w2": P("tp", None)}
+with CheckpointManager(ckdir) as mgr:
+    mgr.save(0, state, force=True)
+    mgr.wait_until_finished()
+    back = mgr.restore(state, mesh=mesh, spec_tree=specs)
+for k in sorted(state):
+    # BIT-exact vs the saved shards (gold comparison above already
+    # anchored the values; restore must not perturb them at all)
+    for sa, sb in zip(back[k].addressable_shards,
+                      state[k].addressable_shards):
+        assert sa.index == sb.index
+        np.testing.assert_array_equal(np.asarray(sa.data),
+                                      np.asarray(sb.data), err_msg=k)
+print(f"rank {jax.process_index()} tp=2 parity + sharded ckpt OK",
+      flush=True)
+"""
+
+
+_PP_CHILD = """
+from apex1_tpu.transformer.pipeline_parallel.schedules import pipeline_apply
+
+mesh = Mesh(np.array(jax.devices()), ("pp",))
+rng = np.random.default_rng(1)
+V, S, hid, M, mb = 1, 2, 8, 4, 2
+pf = (rng.normal(size=(V, S, hid, hid)) * 0.5).astype(np.float32)
+mbf = rng.normal(size=(M, mb, hid)).astype(np.float32)
+
+params = mk(mesh, pf, P(None, "pp"))
+mbs = mk(mesh, mbf, P())
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p)
+
+def fwd(chunk_params, mbs):
+    def local(chunk_params, mbs):
+        local_p = chunk_params[:, 0]          # (V=1, hid, hid)
+        outs = pipeline_apply(stage_fn, local_p, mbs, num_chunks=1)
+        return jnp.sum(outs.astype(jnp.float32))
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(P(None, "pp"), P()), out_specs=P(),
+                         check_vma=False)(chunk_params, mbs)
+
+# value + grad: the backward scan's ppermute transpose (residual ring)
+# crosses the real process boundary here
+loss, grad = jax.jit(jax.value_and_grad(fwd))(params, mbs)
+
+def gold(pfull, mbs_full):
+    y = mbs_full
+    for s in range(S):
+        y = jnp.tanh(y @ pfull[0, s])
+    return jnp.sum(y.astype(jnp.float32))
+
+gl, gg = jax.value_and_grad(gold)(jnp.asarray(pf), jnp.asarray(mbf))
+np.testing.assert_allclose(float(loss), float(gl), rtol=2e-5, atol=2e-5)
+check_shards(grad, np.asarray(gg), "pipeline param grad")
+print(f"rank {jax.process_index()} pp=2 parity OK", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_cross_process_tp2_parity_and_sharded_checkpoint(tmp_path):
+    rc = _launch(tmp_path, _TP_CHILD, [tmp_path / "ckpts"], port=12393)
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_cross_process_pp2_pipeline_parity(tmp_path):
+    rc = _launch(tmp_path, _PP_CHILD, port=12394)
+    assert rc == 0

@@ -108,7 +108,7 @@ class TestQuantDecode:
         def fix(path, p):
             name = path[-1].key if hasattr(path[-1], "key") else path[-1]
             if name in ("wq", "wk", "wv", "wo", "w_gate", "w_up",
-                        "w_down", "output"):
+                        "w_down", "output", "w1", "w2"):
                 q = rng.integers(-127, 128, size=p.shape)
                 return jnp.asarray(q * 2e-3, jnp.float32)
             return p
@@ -195,11 +195,125 @@ class TestQuantDecode:
                         vocab_size=cfg.vocab_size)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
-    def test_moe_guarded(self):
-        cfg = LlamaConfig.tiny(policy=get_policy("O0"), moe_every=1,
-                               num_experts=2, moe_top_k=1)
+    def test_int8_prefix_cache_continuation_matches_flat(self, setup):
+        """docs/serving.md matrix cell: int8 x prefix caching. A prefix
+        prefilled once through the int8 decoder, continued via
+        cache_start, equals the flat int8 decode token-for-token."""
+        cfg, model, params, _ = setup
+        rng = np.random.default_rng(17)
+        B, Lp, Ls, N = 2, 6, 4, 5
+        prefix = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, Lp)),
+                             jnp.int32)
+        suffix = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, Ls)),
+                             jnp.int32)
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        cache0 = make_cache(B, Lp + Ls + N)
+        _, cache0 = apply_q(qparams, prefix, cache0, 0)
+        got = generate(apply_q, qparams, suffix, max_new_tokens=N,
+                       cache=cache0, cache_start=Lp,
+                       vocab_size=cfg.vocab_size)
+        flat = jnp.concatenate([prefix, suffix], axis=1)
+        want = generate(apply_q, qparams, flat, max_new_tokens=N,
+                        cache=make_cache(B, Lp + Ls + N),
+                        vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_int8_beam1_equals_int8_greedy(self, setup):
+        """docs/serving.md matrix cell: int8 x beam search. num_beams=1
+        beam search over the int8 decoder reduces to its greedy decode."""
+        from apex1_tpu.models.generate import beam_search
+        cfg, model, params, prompt = setup
+        N = 5
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        beam, _ = beam_search(apply_q, qparams, prompt, max_new_tokens=N,
+                              cache=make_cache(2, 16), num_beams=1,
+                              vocab_size=cfg.vocab_size)
+        greedy = generate(apply_q, qparams, prompt, max_new_tokens=N,
+                          cache=make_cache(2, 16),
+                          vocab_size=cfg.vocab_size)
+        np.testing.assert_array_equal(np.asarray(beam),
+                                      np.asarray(greedy))
+
+    @pytest.fixture(scope="class")
+    def moe_setup(self):
+        """Tiny MoE Llama (every layer expert-routed) — the int8 expert
+        path (VERDICT r4 item 4: expert weights are the bulk of MoE
+        checkpoint bytes, the HBM-bound case int8 decode exists for)."""
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=32,
+                               moe_every=1, num_experts=2, moe_top_k=1)
         model = Llama(cfg)
-        prompt = jnp.zeros((1, 4), jnp.int32)
+        rng = np.random.default_rng(23)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)),
+                             jnp.int32)
         params = model.init(jax.random.key(0), prompt)["params"]
-        with pytest.raises(NotImplementedError, match="MoE"):
-            llama_quant_decoder(model, params)
+        params = self._exactly_representable(params)
+        return cfg, model, params, prompt
+
+    def test_moe_quant_logits_match_full_precision(self, moe_setup):
+        cfg, model, params, prompt = moe_setup
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        logits_q, _ = apply_q(qparams, prompt, make_cache(2, 16), 0)
+        apply_f, make_cache_f = llama_decoder(model)
+        logits_f, _ = apply_f(params, prompt, make_cache_f(2, 16), 0)
+        np.testing.assert_allclose(np.asarray(logits_q),
+                                   np.asarray(logits_f),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_moe_quant_generate_matches_full_precision_tokens(
+            self, moe_setup):
+        """Greedy decode through int8 experts is token-identical to the
+        flax MoE model's cached decode — routing decisions (fp32 router
+        in both paths) and capacity/drop semantics must line up exactly,
+        not just the matmul numerics."""
+        cfg, model, params, prompt = moe_setup
+        N = 6
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        got = generate(apply_q, qparams, prompt, max_new_tokens=N,
+                       cache=make_cache(2, 11))
+        apply_f, make_cache_f = llama_decoder(model)
+        want = generate(apply_f, params, prompt, max_new_tokens=N,
+                        cache=make_cache_f(2, 11))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_moe_int8_ragged_rows_match_solo(self):
+        """docs/serving.md matrix: MoE x int8 x ragged. Ample expert
+        capacity (no overflow -> no batched-vs-solo capacity coupling):
+        each ragged row through the int8 MoE decoder equals its solo
+        int8 decode; pad slots claim no capacity (segment -1)."""
+        cfg = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=32,
+                               moe_every=1, num_experts=2, moe_top_k=1,
+                               moe_capacity_factor=4.0)
+        model = Llama(cfg)
+        rng = np.random.default_rng(41)
+        S0, lens, N = 6, [6, 3, 5], 4
+        prompts = np.asarray(rng.integers(1, cfg.vocab_size, (3, S0)),
+                             np.int32)
+        prompts[~(np.arange(S0)[None, :]
+                  < np.asarray(lens)[:, None])] = 0
+        prompts = jnp.asarray(prompts)
+        params = model.init(jax.random.key(0), prompts)["params"]
+        params = self._exactly_representable(params)
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        got = generate(apply_q, qparams, prompts, max_new_tokens=N,
+                       cache=make_cache(3, S0 + N),
+                       vocab_size=cfg.vocab_size,
+                       prompt_lens=jnp.asarray(lens, jnp.int32))
+        for b, ln in enumerate(lens):
+            solo = generate(apply_q, qparams, prompts[b:b + 1, :ln],
+                            max_new_tokens=N, cache=make_cache(1, ln + N),
+                            vocab_size=cfg.vocab_size)
+            np.testing.assert_array_equal(
+                np.asarray(got[b]), np.asarray(solo[0]),
+                err_msg=f"int8 MoE row {b} (len {ln}) diverged")
+
+    def test_moe_real_weights_quant_error_is_small(self, moe_setup):
+        cfg, model, _, prompt = moe_setup
+        params = model.init(jax.random.key(2), prompt)["params"]
+        apply_q, make_cache, qparams = llama_quant_decoder(model, params)
+        logits_q, _ = apply_q(qparams, prompt, make_cache(2, 16), 0)
+        apply_f, make_cache_f = llama_decoder(model)
+        logits_f, _ = apply_f(params, prompt, make_cache_f(2, 16), 0)
+        lq, lf = np.asarray(logits_q), np.asarray(logits_f)
+        denom = max(1.0, np.abs(lf).max())
+        assert np.abs(lq - lf).max() / denom < 0.15, (
+            np.abs(lq - lf).max(), denom)

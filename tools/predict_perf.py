@@ -1,0 +1,350 @@
+"""Offline roofline PREDICTION for every bench config + Pallas kernel —
+the falsifiable perf table VERDICT r4 Missing #2 asked for.
+
+Four rounds of kernel/step tuning are AOT- and numerics-verified but have
+never been timed (the axon tunnel has been down since round 1's single
+42k tok/s GPT-2 reading). This tool makes that work scoreable offline:
+it AOT-compiles the EXACT bench.py train steps and the individual Pallas
+kernels through libtpu's compile-only topology client (same machinery as
+tools/aot_check.py), reads post-optimization FLOPs and bytes-accessed
+from XLA's cost model, and tables the roofline prediction
+
+    t_pred = max(flops / peak_bf16_flops, hbm_bytes / peak_hbm_bw)
+
+per config against the v5e (bench chip) and v5p capability rows
+(core/capability.py spec-sheet numbers). The first real hardware window
+then CONFIRMS or EMBARRASSES this table (bench.py prints measured
+step_ms + MFU in the same units).
+
+How to read the numbers honestly:
+- The prediction is an UPPER BOUND on throughput: XLA's "bytes accessed"
+  is the post-fusion HLO cost model's count of operand+output bytes per
+  op, which approximates HBM traffic but ignores achieved-bandwidth
+  derating, DMA/compute overlap gaps, scalar-core stalls, and ICI time.
+  Measured tokens/sec at or above ~60% of predicted = the program is
+  roofline-shaped; below ~50% = a schedule or kernel is leaving real
+  performance on the floor and the per-kernel table localizes where.
+- THE PALLAS BLIND SPOT (the reason each step compiles TWICE): the HLO
+  cost model cannot see inside `tpu_custom_call`, so a Pallas-lowered
+  program under-reports flops by exactly the kernels' share (flash
+  attention + fused LM-head CE are ~40% of a GPT-2 step). Logical
+  FLOPs therefore come from a second compile with `force_impl("xla")`
+  (same math through composite ops); HBM bytes come from the Pallas
+  compile (the composite would overcount bytes by the S^2 score
+  materializations flash exists to avoid — while the Pallas compile's
+  custom-call operand bytes are the right first-order traffic).
+  `flops_xla / flops_pallas_visible` is tabled per config as the MFU
+  CORRECTION FACTOR: bench.py's on-hardware `mfu` divides measured
+  time into cost_analysis flops of the Pallas program, so multiply
+  bench.py's mfu by this factor for true model-flops utilization.
+- XLA counts a fused multiply-add as 2 flops, matching bench.py.
+- The per-kernel table is ANALYTIC (formulas in `_KERNEL_CASES`):
+  cost-model numbers are meaningless for custom calls, so kernel
+  rooflines use counted matmul flops and operand/result bytes.
+- v5p columns reuse the v5e-lowered program's flops/bytes with v5p
+  peaks (identical HLO math; Pallas block shapes differ on v5p but
+  block shape changes traffic only at the margin).
+
+Usage:
+    python tools/predict_perf.py [--out perf_results/predicted_r5.md]
+        [--json perf_results/predicted_r5.json] [--configs gpt2,bert,...]
+        [--skip-kernels]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from apex1_tpu.testing import (  # noqa: E402
+    enable_persistent_compilation_cache)
+
+enable_persistent_compilation_cache()
+
+TOPOLOGY = "v5e:2x2"   # lowering target; single-device programs
+
+
+def _cost(compiled):
+    """(flops, bytes_accessed) from the optimized executable's cost model."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    # total operand+output traffic: XLA reports the aggregate under
+    # "bytes accessed"; per-operand keys ("bytes accessed0{}", ...)
+    # are subsets of it, so the aggregate alone is the roofline input
+    nbytes = float(cost.get("bytes accessed", 0.0))
+    return flops, nbytes
+
+
+def _roofline(flops, nbytes, cap):
+    """Predicted seconds + binding side for one program on one chip."""
+    t_mxu = flops / (cap.bf16_tflops * 1e12)
+    t_hbm = nbytes / (cap.hbm_gbps * 1e9)
+    t = max(t_mxu, t_hbm)
+    bound = "MXU" if t_mxu >= t_hbm else "HBM"
+    mfu = flops / (t * cap.bf16_tflops * 1e12) if t > 0 else 0.0
+    return t, bound, mfu
+
+
+def predict_steps(topo, configs):
+    """AOT-compile each bench step single-device; return prediction rows."""
+    import bench as bench_mod
+    from jax.sharding import SingleDeviceSharding
+
+    s1 = SingleDeviceSharding(topo.devices[0])
+
+    def to_shape(tree):
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                           jnp.asarray(x).dtype,
+                                           sharding=s1), tree)
+
+    from apex1_tpu.ops import force_impl
+
+    rows = []
+    for name in configs:
+        try:
+            (state, step, batch, units_per_step, _iters, metric, unit,
+             proxy) = bench_mod.BENCHES[name](True)
+            sh_state, sh_batch = to_shape(state), to_shape(batch)
+            del state, batch
+            # Pallas compile: bytes are first-order honest, flops are
+            # blind to custom-call interiors
+            compiled_p = jax.jit(step, donate_argnums=0).lower(
+                sh_state, *sh_batch).compile()
+            flops_vis, nbytes = _cost(compiled_p)
+            mem = compiled_p.memory_analysis()
+            # forced-composite compile: the LOGICAL flop count (same
+            # math, every matmul visible to the cost model)
+            with force_impl("xla"):
+                compiled_x = jax.jit(step, donate_argnums=0).lower(
+                    sh_state, *sh_batch).compile()
+            flops, _bytes_x = _cost(compiled_x)
+            rows.append(dict(
+                name=name, metric=metric, unit=unit, proxy=proxy,
+                units_per_step=units_per_step, flops=flops, bytes=nbytes,
+                flops_pallas_visible=flops_vis,
+                mfu_correction=(flops / flops_vis if flops_vis else None),
+                temp_gib=mem.temp_size_in_bytes / 2**30,
+                args_gib=mem.argument_size_in_bytes / 2**30))
+            print(f"  OK   {name:14s} flops {flops:.3e} "
+                  f"(visible {flops_vis:.3e})  bytes {nbytes:.3e}",
+                  flush=True)
+        except Exception as e:
+            print(f"  FAIL {name}: {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+            rows.append(dict(name=name, error=f"{type(e).__name__}: {e}"))
+    return rows
+
+
+def _kernel_cases():
+    """ANALYTIC (flops, min HBM bytes) per Pallas kernel at its bench
+    shape — shapes mirror tools/aot_check.py's kernel gate, so each row
+    lines up with what tools/bench_kernels.py measures on silicon.
+
+    Formulas (all counts: multiply-add = 2 flops; bytes = each operand
+    and result crossing HBM once — the kernels are designed to touch
+    operands once, so this IS the target):
+    - flash attention fwd: 4*B*H*S^2*D matmul flops (QK^T + PV), x0.5
+      causal skip; bwd = 2.5x fwd (dV/dP/dS/dQ/dK matmuls + the
+      recomputed P the memory-efficient backward pays for). GQA K/V
+      bytes scale by Hkv/Hq.
+    - linear_xent f+b: 6*T*Hd*V (fwd logits + dX + dW); bytes 3 reads
+      of W (fwd + recompute-bwd + dW stream) + x/dx/dw.
+    - LN / RMS / softmax / rope / xentropy: bandwidth-bound, flops ~
+      a few per element (counted as 5/elem fwd, 8/elem f+b — they
+      never bind the roofline); bytes = per-pass element traffic
+      (softmax f+b: x in, y out, then y + dy in, dx out; LN f+b: 2
+      reads + 2 writes of x-sized arrays + stats).
+    - int8 GEMM: 2*M*N*K flops; bytes dominated by the int8 weight
+      (N*K) + scales + activations.
+    """
+    def flash(B, Hq, Hkv, S, D, causal=True, grad=False):
+        f = 4 * B * Hq * S * S * D * (0.5 if causal else 1.0)
+        if grad:
+            f *= 3.5          # fwd + 2.5x bwd
+        qb = B * Hq * S * D * 2
+        kvb = 2 * B * Hkv * S * D * 2
+        byt = qb + kvb + qb   # q, k, v in; o out
+        if grad:
+            byt += 2 * qb + kvb + qb   # dq out, dk/dv out, do in
+        return f, byt
+
+    T, Hd, V = 16 * 1023, 768, 50432
+    lx_f = 6 * T * Hd * V
+    lx_b = 2 * (3 * V * Hd + 2 * T * Hd + V * Hd)  # W x3, x/dx, dW
+
+    def elemwise(n_elem, passes, itemsize, fpe):
+        return fpe * n_elem, passes * n_elem * itemsize
+
+    return [
+        ("flash gpt2 (16,12,1024,64) fwd", *flash(16, 12, 12, 1024, 64)),
+        ("flash gpt2 (16,12,1024,64) f+b",
+         *flash(16, 12, 12, 1024, 64, grad=True)),
+        ("flash longctx (1,32,16384,64) f+b",
+         *flash(1, 32, 32, 16384, 64, grad=True)),
+        ("flash GQA (Hq32/Hkv4,16k,64) f+b",
+         *flash(1, 32, 4, 16384, 64, grad=True)),
+        ("linear_xent gpt2 (16k,768,50k) f+b", lx_f, lx_b),
+        ("layer_norm (16384,768) f+b",
+         *elemwise(16384 * 768, 4, 2, 8)),
+        ("rms_norm (16384,2048) f+b",
+         *elemwise(16384 * 2048, 4, 2, 8)),
+        ("causal softmax (16,12,1024,1024) f+b",
+         *elemwise(16 * 12 * 1024 * 1024 // 2, 4, 4, 8)),
+        ("xentropy (16368,50432) f+b",
+         *elemwise(16368 * 50432, 3, 4, 8)),   # recompute-bwd: x, x, dx
+        ("rope llama (1,16384,32,64) f+b",
+         *elemwise(16384 * 32 * 64, 4, 2, 6)),
+        ("int8 GEMM decode (8,4096)x(32000,4096)",
+         2 * 8 * 32000 * 4096,
+         32000 * 4096 * 1 + 32000 * 4 + 2 * 8 * (4096 + 32000) * 2),
+    ]
+
+
+def predict_kernels(_topo):
+    """Analytic roofline rows for the Pallas kernels (the HLO cost model
+    is blind inside tpu_custom_call — see module docstring)."""
+    rows = []
+    for name, flops, nbytes in _kernel_cases():
+        rows.append(dict(name=name, flops=float(flops),
+                         bytes=float(nbytes), source="analytic"))
+        print(f"  OK   {name:40s} flops {flops:.3e}  "
+              f"bytes {nbytes:.3e}  [analytic]", flush=True)
+    return rows
+
+
+def render(step_rows, kernel_rows, caps):
+    from apex1_tpu.core.capability import get_capability
+    v5e, v5p = get_capability("v5e"), get_capability("v5p")
+    lines = []
+    w = lines.append
+    w("# Predicted performance — round 5 (offline roofline, NOT measured)")
+    w("")
+    w("Source: `python tools/predict_perf.py` — XLA cost model (flops, "
+      "bytes accessed) of the post-optimization v5e executables for the "
+      "exact `bench.py` steps and Pallas kernels, against the "
+      "`core/capability.py` spec rows "
+      f"(v5e {v5e.bf16_tflops:.0f} TF bf16 / {v5e.hbm_gbps:.0f} GB/s; "
+      f"v5p {v5p.bf16_tflops:.0f} TF / {v5p.hbm_gbps:.0f} GB/s).")
+    w("")
+    w("`t_pred = max(flops/peak_flops, bytes/peak_bw)` — an UPPER bound "
+      "on throughput (no overlap gaps, no bandwidth derating, no ICI). "
+      "Measured ≥ ~60% of predicted tok/s = roofline-shaped program; "
+      "< ~50% = localize the loss with the per-kernel table + "
+      "`tools/profile_step.py`. See module docstring for the full "
+      "honesty contract.")
+    w("")
+    w("## Bench configs (per train step, single chip)")
+    w("")
+    w("| config | units/step | GFLOPs | HBM GiB | AI (fl/B) | bound "
+      "| v5e pred ms | v5e pred rate | v5e pred MFU | v5p pred ms "
+      "| proxy | pred/proxy | mfu corr |")
+    w("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+    for r in step_rows:
+        if "error" in r:
+            w(f"| {r['name']} | — | — | — | — | — | — | — | — | — | — "
+              f"| — | ERROR: {r['error'][:80]} |")
+            continue
+        te, be, me = _roofline(r["flops"], r["bytes"], v5e)
+        tp, _, _ = _roofline(r["flops"], r["bytes"], v5p)
+        rate = r["units_per_step"] / te
+        ai = r["flops"] / r["bytes"] if r["bytes"] else float("inf")
+        corr = r.get("mfu_correction")
+        corr_s = f"{corr:.2f}x" if corr else "n/a"
+        w(f"| {r['name']} | {r['units_per_step']} "
+          f"| {r['flops'] / 1e9:,.1f} | {r['bytes'] / 2**30:.2f} "
+          f"| {ai:.0f} | {be} | {te * 1e3:.1f} | {rate:,.0f} {r['unit']} "
+          f"| {me:.2f} | {tp * 1e3:.1f} | {r['proxy']:,.0f} "
+          f"| {rate / r['proxy']:.2f} | {corr_s} |")
+    w("")
+    w("`mfu corr` = logical flops / Pallas-visible flops: multiply "
+      "bench.py's measured on-chip `mfu` by this factor for true model-"
+      "flops utilization (bench.py's cost_analysis cannot see inside "
+      "tpu_custom_call).")
+    w("")
+    w("The `pred/proxy` column is the prediction of `bench.py`'s "
+      "`vs_baseline` against the PINNED A100 comparator rows "
+      "(BASELINE.md \"Pinned A100 comparator\"); the headline claim on "
+      "the table is GPT-2, whose only measurement (round 1, pre-tuning) "
+      "was 42,027 tok/s.")
+    w("")
+    w("## Pallas kernels (per invocation at bench shapes)")
+    w("")
+    w("| kernel | GFLOPs | HBM MiB | AI | bound | v5e pred ms "
+      "| v5e pred TF/s |")
+    w("|---|---|---|---|---|---|---|")
+    for r in kernel_rows:
+        if "error" in r:
+            w(f"| {r['name']} | — | — | — | — | — | ERROR: "
+              f"{r['error'][:80]} |")
+            continue
+        te, be, _ = _roofline(r["flops"], r["bytes"], v5e)
+        ai = r["flops"] / r["bytes"] if r["bytes"] else float("inf")
+        tf = r["flops"] / te / 1e12 if te else 0.0
+        w(f"| {r['name']} | {r['flops'] / 1e9:,.2f} "
+          f"| {r['bytes'] / 2**20:,.1f} | {ai:.0f} | {be} "
+          f"| {te * 1e3:.3f} | {tf:.1f} |")
+    w("")
+    w("Validation protocol for the first hardware window: "
+      "`tools/tpu_watch.sh`'s queue writes measured step_ms/MFU for "
+      "every config above; divide measured by predicted and record the "
+      "ratio per row in BASELINE.md. Ratios cluster tight (±15%) for "
+      "roofline-shaped programs; an outlier row is the tuning target.")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="perf_results/predicted_r5.md")
+    ap.add_argument("--json", default="perf_results/predicted_r5.json")
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated subset of bench configs")
+    ap.add_argument("--skip-kernels", action="store_true")
+    args = ap.parse_args()
+
+    # identical dispatch patching to aot_check.py: real Mosaic lowering,
+    # v5e block planning — the numbers must price the REAL kernels
+    os.environ["PALLAS_AXON_TPU_GEN"] = "v5e"
+    import apex1_tpu.ops._common as _common
+    _common.on_tpu = lambda: True
+    _common.interpret_mode = lambda: False
+
+    from jax.experimental import topologies
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=TOPOLOGY)
+
+    import bench as bench_mod
+    configs = (args.configs.split(",") if args.configs
+               else sorted(bench_mod.BENCHES))
+
+    print(f"== step cost models ({TOPOLOGY}) ==", flush=True)
+    step_rows = predict_steps(topo, configs)
+    kernel_rows = []
+    if not args.skip_kernels:
+        print(f"== kernel cost models ({TOPOLOGY}) ==", flush=True)
+        kernel_rows = predict_kernels(topo)
+
+    md = render(step_rows, kernel_rows, None)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(md)
+    with open(args.json, "w") as f:
+        json.dump({"topology": TOPOLOGY, "steps": step_rows,
+                   "kernels": kernel_rows}, f, indent=1)
+    print(f"wrote {args.out} + {args.json}", flush=True)
+    failures = sum("error" in r for r in step_rows + kernel_rows)
+    print(f"{failures} failures" if failures else "ALL OK", flush=True)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
