@@ -99,6 +99,15 @@ class LlamaConfig:
         return self.hidden_size // self.num_heads
 
 
+def is_moe_layer(cfg: "LlamaConfig", i: int) -> bool:
+    """THE MoE-layer placement rule (`moe_every > 0` => every
+    ``moe_every``-th block, counting from the ``moe_every - 1``-th, is
+    expert-routed). Single source of truth: `Llama.__call__` and the
+    int8 decode path (`models.quant_decode`) must agree layer-by-layer
+    or quantization would pick the wrong weight structure."""
+    return cfg.moe_every > 0 and i % cfg.moe_every == cfg.moe_every - 1
+
+
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
     # mesh axis carrying the sequence shard (ring/context parallel), or None
@@ -231,8 +240,7 @@ class Llama(nn.Module):
                              policy=checkpoint_policy(cfg.remat_policy))
         new_cache = {}
         for i in range(cfg.num_layers):
-            use_moe = (cfg.moe_every > 0
-                       and i % cfg.moe_every == cfg.moe_every - 1)
+            use_moe = is_moe_layer(cfg, i)
             out = block(cfg, self.seq_shard_axis, use_moe,
                         name=f"layer{i}")(
                 x, cos, sin, segment_ids,

@@ -28,11 +28,8 @@ import jax.numpy as jnp
 from apex1_tpu.models.generate import cached_attention, init_cache
 from apex1_tpu.ops import (apply_rotary_pos_emb, int8_matmul, quantize_int8,
                            rms_norm, rope_tables)
+from apex1_tpu.models.llama import is_moe_layer
 from apex1_tpu.transformer.moe import MoEConfig, router
-
-
-def _is_moe_layer(cfg, i: int) -> bool:
-    return cfg.moe_every > 0 and i % cfg.moe_every == cfg.moe_every - 1
 
 
 def quantize_llama_params(params, cfg):
@@ -71,7 +68,7 @@ def quantize_llama_params(params, cfg):
             "wq": qt(lp["wq"]), "wk": qt(lp["wk"]), "wv": qt(lp["wv"]),
             "wo": qt(lp["wo"]),
         }
-        if _is_moe_layer(cfg, i):
+        if is_moe_layer(cfg, i):
             qlp["moe"] = {
                 "router": jnp.asarray(lp["moe"]["router"], jnp.float32),
                 "w1": qt_experts(lp["moe"]["w1"]),
@@ -169,7 +166,7 @@ def llama_quant_decoder(model, params):
             x = x + mm(attn, lp["wo"]).astype(x.dtype)
             h = rms_norm(x, norm_g(lp["mlp_norm"]),
                          eps=cfg.norm_eps).astype(dt)
-            if _is_moe_layer(cfg, i):
+            if is_moe_layer(cfg, i):
                 y = moe_ffn(h, lp["moe"], segment_ids)
             else:
                 y = mm(jax.nn.silu(mm(h, lp["w_gate"]))

@@ -16,6 +16,7 @@ shard-by-shard in-process, so the host test only checks exit codes.
 
 import os
 import pathlib
+import socket
 import textwrap
 
 import pytest
@@ -25,14 +26,24 @@ pytestmark = pytest.mark.slow  # full run via check_all.sh --all
 _REPO = str(pathlib.Path(__file__).resolve().parents[1])
 
 
-def _launch(tmp_path, body, args=(), *, port):
+def _free_port() -> int:
+    """OS-assigned free port for the jax.distributed coordinator — a
+    hardcoded port collides with concurrent suite runs / TIME_WAIT
+    leftovers from a crashed child (review r5). The tiny bind-release
+    race is acceptable for a test harness."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(tmp_path, body, args=()):
     from apex1_tpu.parallel import multiproc
 
     script = tmp_path / "child.py"
     script.write_text(_PRELUDE + textwrap.dedent(body))
     return multiproc.launch(
         str(script), [str(a) for a in args], num_processes=2,
-        cpu_devices_per_process=1, coordinator_port=port,
+        cpu_devices_per_process=1, coordinator_port=_free_port(),
         env={"PYTHONPATH": _REPO + os.pathsep
              + os.environ.get("PYTHONPATH", "")})
 
@@ -174,11 +185,11 @@ print(f"rank {jax.process_index()} pp=2 parity OK", flush=True)
 
 @pytest.mark.slow
 def test_cross_process_tp2_parity_and_sharded_checkpoint(tmp_path):
-    rc = _launch(tmp_path, _TP_CHILD, [tmp_path / "ckpts"], port=12393)
+    rc = _launch(tmp_path, _TP_CHILD, [tmp_path / "ckpts"])
     assert rc == 0
 
 
 @pytest.mark.slow
 def test_cross_process_pp2_pipeline_parity(tmp_path):
-    rc = _launch(tmp_path, _PP_CHILD, port=12394)
+    rc = _launch(tmp_path, _PP_CHILD)
     assert rc == 0

@@ -109,24 +109,46 @@ def predict_steps(topo, configs):
 
     from apex1_tpu.ops import force_impl
 
+    def to_shape_cpu(tree):
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                           jnp.asarray(x).dtype), tree)
+
     rows = []
     for name in configs:
         try:
             (state, step, batch, units_per_step, _iters, metric, unit,
              proxy) = bench_mod.BENCHES[name](True)
             sh_state, sh_batch = to_shape(state), to_shape(batch)
+            cpu_state, cpu_batch = to_shape_cpu(state), to_shape_cpu(batch)
             del state, batch
+
+            # impl pinned INSIDE a fresh closure per mode: jax's trace
+            # cache is keyed on the function object, so two lowerings of
+            # the SAME `step` would alias one jaxpr and force_impl at
+            # lower()-time would silently no-op (the r3 hw_numerics
+            # vacuous-comparison bug class, re-observed here in r5)
+            def mode_step(mode):
+                def run(st, *b):
+                    with force_impl(mode):
+                        return step(st, *b)
+                return run
+
             # Pallas compile: bytes are first-order honest, flops are
             # blind to custom-call interiors
-            compiled_p = jax.jit(step, donate_argnums=0).lower(
+            compiled_p = jax.jit(mode_step("auto"), donate_argnums=0).lower(
                 sh_state, *sh_batch).compile()
             flops_vis, nbytes = _cost(compiled_p)
             mem = compiled_p.memory_analysis()
             # forced-composite compile: the LOGICAL flop count (same
-            # math, every matmul visible to the cost model)
-            with force_impl("xla"):
-                compiled_x = jax.jit(step, donate_argnums=0).lower(
-                    sh_state, *sh_batch).compile()
+            # math, every matmul visible to the cost model). Compiled
+            # for CPU, unsharded: the composite materializes the S^2
+            # score tensors flash exists to avoid, so it cannot FIT the
+            # v5e HBM budget — it only needs to COUNT (flop counting on
+            # optimized HLO is backend-invariant for these programs)
+            compiled_x = jax.jit(mode_step("xla"), donate_argnums=0).lower(
+                cpu_state, *cpu_batch).compile()
             flops, _bytes_x = _cost(compiled_x)
             rows.append(dict(
                 name=name, metric=metric, unit=unit, proxy=proxy,
@@ -222,7 +244,7 @@ def predict_kernels(_topo):
     return rows
 
 
-def render(step_rows, kernel_rows, caps):
+def render(step_rows, kernel_rows):
     from apex1_tpu.core.capability import get_capability
     v5e, v5p = get_capability("v5e"), get_capability("v5p")
     lines = []
@@ -269,7 +291,9 @@ def render(step_rows, kernel_rows, caps):
     w("`mfu corr` = logical flops / Pallas-visible flops: multiply "
       "bench.py's measured on-chip `mfu` by this factor for true model-"
       "flops utilization (bench.py's cost_analysis cannot see inside "
-      "tpu_custom_call).")
+      "tpu_custom_call). decode_int8's huge factor is expected: "
+      "essentially every matmul of that program runs inside the int8 "
+      "Pallas GEMM, so the visible count is near zero.")
     w("")
     w("The `pred/proxy` column is the prediction of `bench.py`'s "
       "`vs_baseline` against the PINNED A100 comparator rows "
@@ -278,6 +302,12 @@ def render(step_rows, kernel_rows, caps):
       "was 42,027 tok/s.")
     w("")
     w("## Pallas kernels (per invocation at bench shapes)")
+    w("")
+    w("Flops/bytes here are ANALYTIC (formulas in "
+      "`tools/predict_perf.py::_kernel_cases` — the HLO cost model "
+      "cannot see inside `tpu_custom_call`, so compiled numbers would "
+      "be zeros). `tools/bench_kernels.py` measures the same shapes on "
+      "silicon.")
     w("")
     w("| kernel | GFLOPs | HBM MiB | AI | bound | v5e pred ms "
       "| v5e pred TF/s |")
@@ -333,7 +363,7 @@ def main():
         print(f"== kernel cost models ({TOPOLOGY}) ==", flush=True)
         kernel_rows = predict_kernels(topo)
 
-    md = render(step_rows, kernel_rows, None)
+    md = render(step_rows, kernel_rows)
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         f.write(md)
