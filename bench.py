@@ -465,7 +465,8 @@ def _last_banked(config):
                         cand = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    if not cand.get("value"):
+                    if not isinstance(cand.get("value"), (int, float)) \
+                            or not cand["value"]:
                         continue
                     if "[tpu]" not in cand.get("metric", ""):
                         continue
@@ -501,11 +502,15 @@ def main():
             f"backend init unreachable after {args.probe_retries} probes "
             f"x {args.probe_timeout:.0f}s"
             + (f"; last stderr: {probe_stderr}" if probe_stderr else ""))
-        # an unreachable tunnel does not erase history: point at the most
-        # recent ON-SILICON number banked in perf_results/ for this config
+        # an unreachable tunnel does not erase history: point at the best
+        # ON-SILICON number banked in perf_results/ for this config
         # (value stays 0.0 — this run measured nothing; the pointer is
-        # metadata so the record isn't mistaken for "never measured")
-        prior = _last_banked(args.config)
+        # metadata so the record isn't mistaken for "never measured").
+        # Never let the pointer lookup break the always-emit contract.
+        try:
+            prior = _last_banked(args.config)
+        except Exception:
+            prior = None
         if prior is not None:
             fallback["last_measured"] = prior
         _emit(fallback)

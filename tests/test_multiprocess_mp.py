@@ -183,6 +183,77 @@ print(f"rank {jax.process_index()} pp=2 parity OK", flush=True)
 """
 
 
+_EP_CHILD = """
+from apex1_tpu.transformer import moe as moe_lib
+
+mesh = Mesh(np.array(jax.devices()), ("ep",))
+cfg = moe_lib.MoEConfig(num_experts=2, top_k=1, capacity_factor=32.0,
+                        hidden_size=8, ffn_size=16)
+rng = np.random.default_rng(2)
+T, H, F = 8, 8, 16
+xf = rng.normal(size=(T, H)).astype(np.float32)
+wgf = rng.normal(size=(H, 2)).astype(np.float32)
+w1f = (rng.normal(size=(2, H, F)) * 0.1).astype(np.float32)
+w2f = (rng.normal(size=(2, F, H)) * 0.1).astype(np.float32)
+
+x = mk(mesh, xf, P("ep"))
+wg = mk(mesh, wgf, P())
+w1 = mk(mesh, w1f, P("ep"))
+w2 = mk(mesh, w2f, P("ep"))
+
+def local(x, wg, w1, w2):
+    ep = jax.lax.axis_size("ep")
+    def loss_fn(wg, w1, w2):
+        # both all_to_alls (dispatch + return) cross the REAL process
+        # boundary here; so do their transposes in the backward pass.
+        # stats_axes="ep" psums the router stats, making aux exactly
+        # the global-router aux on every shard.
+        y, aux = moe_lib.moe_shard_map_apply(x, wg, w1, w2, cfg,
+                                             stats_axes="ep")
+        # LOCAL partial loss — the docs/parallel.md "inside-grad"
+        # convention: differentiating a psum'd loss inside shard_map
+        # scales every grad by the axis size (psum transposes to psum;
+        # observed here as an exactly-2x gwg before the fix). aux is
+        # replicated, so aux/ep makes the psum-of-partials below equal
+        # the global loss with aux counted once.
+        return jnp.sum(y.astype(jnp.float32) ** 2) + aux / ep
+    lval, (gwg, gw1, gw2) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2))(wg, w1, w2)
+    loss = jax.lax.psum(lval, "ep")
+    # replicated-in wg: each shard's backward holds only the paths
+    # through ITS router invocation (its local tokens). Sharded w1/w2
+    # grads are already complete — remote tokens' contributions arrive
+    # through the all_to_all transpose.
+    gwg = jax.lax.psum(gwg, "ep")
+    return loss, (gwg, gw1, gw2)
+
+step = jax.jit(jax.shard_map(
+    local, mesh=mesh,
+    in_specs=(P("ep"), P(), P("ep"), P("ep")),
+    out_specs=(P(), (P(), P("ep"), P("ep"))),
+    check_vma=False))
+loss, (gwg, gw1, gw2) = step(x, wg, w1, w2)
+# (ample capacity => no drops can differ between local and global routing)
+
+def gold_loss(wg, w1, w2):
+    dispatch, combine, aux = moe_lib.router(jnp.asarray(xf), wg, cfg)
+    xe = jnp.einsum("tec,th->ech", dispatch, jnp.asarray(xf))
+    h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", xe, w1))
+    ye = jnp.einsum("ecf,efh->ech", h, w2)
+    y = jnp.einsum("tec,ech->th", combine, ye)
+    return jnp.sum(y.astype(jnp.float32) ** 2) + aux
+
+gl, (ggwg, ggw1, ggw2) = jax.value_and_grad(
+    gold_loss, argnums=(0, 1, 2))(jnp.asarray(wgf), jnp.asarray(w1f),
+                                  jnp.asarray(w2f))
+np.testing.assert_allclose(float(loss), float(gl), rtol=2e-4, atol=2e-5)
+check_shards(gwg, np.asarray(ggwg), "gwg", tol=2e-4)
+check_shards(gw1, np.asarray(ggw1), "gw1", tol=2e-4)
+check_shards(gw2, np.asarray(ggw2), "gw2", tol=2e-4)
+print(f"rank {jax.process_index()} ep=2 a2a parity OK", flush=True)
+"""
+
+
 @pytest.mark.slow
 def test_cross_process_tp2_parity_and_sharded_checkpoint(tmp_path):
     rc = _launch(tmp_path, _TP_CHILD, [tmp_path / "ckpts"])
@@ -192,4 +263,14 @@ def test_cross_process_tp2_parity_and_sharded_checkpoint(tmp_path):
 @pytest.mark.slow
 def test_cross_process_pp2_pipeline_parity(tmp_path):
     rc = _launch(tmp_path, _PP_CHILD)
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_cross_process_ep2_all_to_all_parity(tmp_path):
+    """Expert-parallel all_to_all (+ its backward transpose) across two
+    REAL processes — the last collective family whose only prior
+    coverage was single-process virtual devices (VERDICT r4 Missing #4
+    named tp/pp; this closes ep the same way)."""
+    rc = _launch(tmp_path, _EP_CHILD)
     assert rc == 0
