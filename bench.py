@@ -445,16 +445,18 @@ _BANKED_LOGS = {
 }
 
 
-def _last_banked(config):
+def _last_banked(config, results_dir=None):
     """Best on-silicon JSON record for ``config`` across the tee'd
     queue logs in perf_results/, or None. Only records that carry a
     real measurement (nonzero value from a tpu backend) qualify; among
     qualifying records the highest value wins (the headline contract —
     the queue logs carry no timestamps to order by)."""
+    if results_dir is None:
+        results_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "perf_results")
     best = None
     for name in _BANKED_LOGS.get(config, ()):
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "perf_results", name)
+        path = os.path.join(results_dir, name)
         try:
             with open(path) as f:
                 for line in f:
@@ -465,8 +467,10 @@ def _last_banked(config):
                         cand = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    if not isinstance(cand.get("value"), (int, float)) \
-                            or not cand["value"]:
+                    val = cand.get("value")
+                    if isinstance(val, bool) \
+                            or not isinstance(val, (int, float)) \
+                            or not math.isfinite(val) or not val:
                         continue
                     if "[tpu]" not in cand.get("metric", ""):
                         continue

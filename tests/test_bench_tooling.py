@@ -1,0 +1,87 @@
+"""Unit tests for bench.py's driver-facing fallback machinery.
+
+The unreachable-backend JSON line must always emit and, when banked
+on-silicon records exist in perf_results/, carry a `last_measured`
+pointer (bench.py::_last_banked). These tests pin the lookup's
+contract against synthetic queue logs — including the malformed lines
+a tunnel death can leave behind.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    spec = importlib.util.spec_from_file_location("_bench_for_test",
+                                                  _REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _results(tmp_path, logs):
+    """Write a synthetic perf_results dir."""
+    res = tmp_path / "perf_results"
+    res.mkdir()
+    for name, lines in logs.items():
+        (res / name).write_text("\n".join(
+            json.dumps(x) if isinstance(x, dict) else x for x in lines)
+            + "\n")
+    return str(res)
+
+
+class TestLastBanked:
+    def test_picks_best_across_logs(self, bench_mod, tmp_path):
+        res = _results(tmp_path, {
+            "bench_gpt2.log": [
+                {"metric": "m [tpu]", "value": 100.0, "unit": "u"}],
+            "bench_gpt2_b24.log": [
+                {"metric": "m [tpu]", "value": 200.0, "unit": "u"}],
+        })
+        rec = bench_mod._last_banked("gpt2", res)
+        assert rec["value"] == 200.0
+        assert rec["source_log"].endswith("bench_gpt2_b24.log")
+
+    def test_requires_tpu_backend_tag(self, bench_mod, tmp_path):
+        res = _results(tmp_path, {
+            "bench_bert.log": [
+                {"metric": "m [cpu]", "value": 5.0, "unit": "u"},
+                {"metric": "m [unreachable]", "value": 0.0, "unit": "u"}],
+        })
+        assert bench_mod._last_banked("bert", res) is None
+
+    def test_skips_zero_nonnumeric_and_garbage(self, bench_mod, tmp_path):
+        res = _results(tmp_path, {
+            "bench_t5.log": [
+                "WARNING: some init noise",
+                {"metric": "m [tpu]", "value": 0.0, "unit": "u"},
+                {"metric": "m [tpu]", "value": "999999", "unit": "u"},
+                '{"bad": }',
+                '{"metric": "m [tpu]", "value": NaN, "unit": "u"}',
+                '{"metric": "m [tpu]", "value": true, "unit": "u"}',
+                {"metric": "m [tpu]", "value": 42.0, "unit": "u"}],
+        })
+        rec = bench_mod._last_banked("t5", res)
+        assert rec["value"] == 42.0
+
+    def test_missing_files_and_unknown_config(self, bench_mod, tmp_path):
+        res = _results(tmp_path, {})
+        assert bench_mod._last_banked("gpt2", res) is None
+        assert bench_mod._last_banked("no_such_config", res) is None
+
+    def test_real_repo_logs_if_present(self, bench_mod):
+        """The shipping perf_results/ must resolve without error (value
+        may be None on a fresh clone with no banked logs)."""
+        rec = bench_mod._last_banked("gpt2")
+        if rec is not None:
+            assert rec["value"] > 0
+            assert "[tpu]" in rec["metric"]
+
+    def test_every_bench_config_has_log_mapping(self, bench_mod):
+        assert set(bench_mod._BANKED_LOGS) == set(bench_mod.BENCHES)
