@@ -61,6 +61,25 @@ def enable_persistent_compilation_cache(default_dir: str | None = None
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def child_cache_env(default_dir: str | None = None) -> dict:
+    """Env-var form of :func:`enable_persistent_compilation_cache` for
+    CHILD processes a test harness spawns (example smokes, multiproc
+    clusters): same ``APEX1_JAX_CACHE_DIR`` resolution — empty disables —
+    and an already-exported ``JAX_COMPILATION_CACHE_DIR`` wins, so an
+    operator pointing everything at a shared cache is not silently
+    split. Merge the returned dict into the child env."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return {}  # inherited via dict(os.environ) in the launcher
+    if default_dir is None:
+        default_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    cache = os.environ.get("APEX1_JAX_CACHE_DIR", default_dir)
+    if not cache:
+        return {}
+    return {"JAX_COMPILATION_CACHE_DIR": os.path.abspath(cache),
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5"}
+
+
 def honor_jax_platforms_env() -> None:
     """Re-assert ``JAX_PLATFORMS`` through ``jax.config``: the container's
     sitecustomize pins ``jax_platforms=axon,cpu`` via jax.config, which

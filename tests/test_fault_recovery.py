@@ -112,8 +112,12 @@ def test_checkpoint_restart_after_fault(tmp_path):
     script.write_text(_CHILD)
     ckdir = tmp_path / "ckpts"
     repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    from apex1_tpu.testing import child_cache_env
     env_base = {"PYTHONPATH": repo_root + os.pathsep
-                + os.environ.get("PYTHONPATH", "")}
+                + os.environ.get("PYTHONPATH", ""),
+                # fresh child processes: share the suite's persistent
+                # compile cache or every run recompiles cold
+                **child_cache_env()}
 
     # run 1: both processes die at step 3 (simulated preemption)
     rc1 = multiproc.launch(

@@ -161,6 +161,13 @@ def test_examples_smoke(script, args):
     # an inherited 'axon' would override the jax.config cpu preamble
     # and hang on a dead tunnel
     env["JAX_PLATFORMS"] = "cpu"
+    # warm-cache economics for the suite (VERDICT r4 Weak #5): the
+    # example children are fresh processes, so without the persistent
+    # cache every suite run pays their full compile cost (~6 min of
+    # the single-core wall time). Env-var form because the examples
+    # themselves stay plain user scripts.
+    from apex1_tpu.testing import child_cache_env
+    env.update(child_cache_env())
     r = subprocess.run(
         [sys.executable, "-c",
          "import jax; jax.config.update('jax_platforms', 'cpu');"

@@ -41,11 +41,16 @@ def _launch(tmp_path, body, args=()):
 
     script = tmp_path / "child.py"
     script.write_text(_PRELUDE + textwrap.dedent(body))
+    from apex1_tpu.testing import child_cache_env
+
     return multiproc.launch(
         str(script), [str(a) for a in args], num_processes=2,
         cpu_devices_per_process=1, coordinator_port=_free_port(),
         env={"PYTHONPATH": _REPO + os.pathsep
-             + os.environ.get("PYTHONPATH", "")})
+             + os.environ.get("PYTHONPATH", ""),
+             # children are fresh processes each test: share the suite's
+             # persistent compile cache or every run recompiles cold
+             **child_cache_env()})
 
 
 _PRELUDE = textwrap.dedent("""
