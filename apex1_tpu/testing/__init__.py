@@ -42,6 +42,16 @@ def force_virtual_cpu_devices(n: int = 8) -> None:
     jax.config.update("jax_threefry_partitionable", True)
 
 
+def _resolve_cache_dir(default_dir: str | None) -> str:
+    """The one copy of the cache-dir policy: ``APEX1_JAX_CACHE_DIR``
+    overrides (empty disables), else ``default_dir``, else
+    ``<repo>/.jax_cache``. Returns "" when disabled."""
+    if default_dir is None:
+        default_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    return os.environ.get("APEX1_JAX_CACHE_DIR", default_dir)
+
+
 def enable_persistent_compilation_cache(default_dir: str | None = None
                                         ) -> None:
     """Point JAX's persistent compilation cache at ``APEX1_JAX_CACHE_DIR``
@@ -49,10 +59,7 @@ def enable_persistent_compilation_cache(default_dir: str | None = None
     a single-core box are compile-dominated; a warm cache is what makes
     re-running them cheap. Set ``APEX1_JAX_CACHE_DIR=`` (empty) to
     disable."""
-    if default_dir is None:
-        default_dir = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
-    cache = os.environ.get("APEX1_JAX_CACHE_DIR", default_dir)
+    cache = _resolve_cache_dir(default_dir)
     if not cache:
         return
     import jax
@@ -68,16 +75,19 @@ def child_cache_env(default_dir: str | None = None) -> dict:
     and an already-exported ``JAX_COMPILATION_CACHE_DIR`` wins, so an
     operator pointing everything at a shared cache is not silently
     split. Merge the returned dict into the child env."""
+    # always lower the min-compile-time to catch the sub-second tiny-model
+    # compiles these harnesses are made of (JAX's default 1.0s skips them),
+    # unless the operator pinned their own threshold
+    out = {}
+    if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+        out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return {}  # inherited via dict(os.environ) in the launcher
-    if default_dir is None:
-        default_dir = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
-    cache = os.environ.get("APEX1_JAX_CACHE_DIR", default_dir)
+        return out  # dir inherited via dict(os.environ) in the launcher
+    cache = _resolve_cache_dir(default_dir)
     if not cache:
         return {}
-    return {"JAX_COMPILATION_CACHE_DIR": os.path.abspath(cache),
-            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5"}
+    out["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(cache)
+    return out
 
 
 def honor_jax_platforms_env() -> None:
