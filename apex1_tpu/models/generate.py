@@ -214,7 +214,9 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
     ``test_chained_generate_via_return_cache``). Continuing at
     ``+ max_new_tokens`` instead would leave a zero-K/V slot that
     chunk-decode attention still attends and silently drop the last
-    token from context.
+    token from context. Not combinable with ``prompt_lens``: a
+    ragged-produced cache carries garbage left-pad K/V the
+    continuation would attend (loud ValueError).
 
     The decode loop is a ``lax.scan`` — jit the whole call (e.g.
     ``jax.jit(functools.partial(generate, apply_fn, max_new_tokens=...,
@@ -234,6 +236,19 @@ def generate(apply_fn: Callable, params, prompt_tokens, *,
             f"{cache_start + S0 + max_new_tokens}")
     kw = {}
     lens = None
+    if return_cache and prompt_lens is not None:
+        # the continuation API (cache_start, scalar positions) has no
+        # channel for per-row valid_start/lens, so a ragged-produced
+        # cache would be continued attending its garbage left-pad K/V
+        # slots with uniformly-shifted RoPE positions — silently wrong
+        # tokens for every short row. Refuse loudly (docs/serving.md
+        # composition matrix: ragged x prefix-cache-production is an
+        # unsupported cell).
+        raise ValueError(
+            "return_cache and prompt_lens cannot be combined — the "
+            "returned cache's left-pad slots hold garbage K/V that a "
+            "cache_start continuation would attend; produce "
+            "continuation caches from dense (non-ragged) prompts")
     if cache_start:
         if prompt_lens is not None:
             raise ValueError(

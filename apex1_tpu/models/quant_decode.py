@@ -122,11 +122,14 @@ def llama_quant_decoder(model, params):
                         x2.astype(dt))                    # (E, C, H)
         q1, s1 = qm["w1"]["q"], qm["w1"]["s"]             # (E, F, H)
         q2, s2 = qm["w2"]["q"], qm["w2"]["s"]             # (E, H, F)
-        ye = jnp.stack([
-            int8_matmul(jax.nn.silu(
-                int8_matmul(xe[e], q1[e], s1[e]).astype(dt)),
-                q2[e], s2[e])
-            for e in range(moecfg.num_experts)])          # (E, C, H)
+        # vmap over the stacked expert axis (the layout qt_experts
+        # already produces) — one batched Pallas GEMM per projection
+        # instead of 2E unrolled dispatches (review r5: the unroll
+        # bloated the HLO and serialized independent expert matmuls;
+        # MoEMLP's bf16 form is one stacked einsum for the same reason)
+        ye = jax.vmap(lambda xe_e, q1_e, s1_e, q2_e, s2_e: int8_matmul(
+            jax.nn.silu(int8_matmul(xe_e, q1_e, s1_e).astype(dt)),
+            q2_e, s2_e))(xe, q1, s1, q2, s2)              # (E, C, H)
         y = jnp.einsum("tec,ech->th", combine.astype(dt),
                        ye.astype(dt))
         return y.reshape(*lead, H)

@@ -601,6 +601,12 @@ class TestPrefixCaching:
             generate(apply_fn, params, prompts, max_new_tokens=2,
                      cache=make_cache(2, 12), cache_start=3,
                      prompt_lens=jnp.asarray([4, 2]))
+        # the PRODUCTION side of the same hole: a ragged-produced cache
+        # carries garbage left-pad K/V a continuation would attend
+        with pytest.raises(ValueError, match="return_cache"):
+            generate(apply_fn, params, prompts, max_new_tokens=2,
+                     cache=make_cache(2, 12),
+                     prompt_lens=jnp.asarray([4, 2]), return_cache=True)
 
 
 class TestBeamLengthPenalty:

@@ -373,6 +373,38 @@ class TestSpeculativeRaggedAndQuant:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         assert (np.asarray(rounds) >= 1).all()
 
+    def test_int8_target_matches_its_own_greedy(self):
+        """The OTHER half of the int8 x speculative matrix cell: an
+        int8-quantized TARGET under a bf16 draft. This drives the
+        chunk-verify (chunk_decode) attention path through the quant
+        decoder's cached attention — previously asserted by apply-
+        contract reasoning only (review r5). Greedy speculative output
+        must be token-identical to the int8 target's own greedy decode."""
+        from apex1_tpu.models.quant_decode import llama_quant_decoder
+
+        rng = np.random.default_rng(33)
+        cfg_t = LlamaConfig.tiny(policy=get_policy("O2"), max_seq_len=64)
+        cfg_d = LlamaConfig.tiny(policy=get_policy("O0"), max_seq_len=64,
+                                 num_layers=1)
+        tgt, drf = Llama(cfg_t), Llama(cfg_d)
+        prompt = jnp.asarray(rng.integers(1, cfg_t.vocab_size, (2, 5)),
+                             jnp.int32)
+        pt = tgt.init(jax.random.key(4), prompt)["params"]
+        pd = drf.init(jax.random.key(5), prompt)["params"]
+        t_fn, mk_t, qpt = llama_quant_decoder(tgt, pt)
+        d_fn, mk_d = llama_decoder(drf)
+        N, K = 8, 3
+        S0 = prompt.shape[1]
+        got, rounds = speculative_generate(
+            t_fn, qpt, d_fn, pd, prompt, max_new_tokens=N,
+            target_cache=mk_t(2, S0 + N + K + 1),
+            draft_cache=mk_d(2, S0 + N + K + 1),
+            num_draft=K, vocab_size=cfg_t.vocab_size)
+        want = generate(t_fn, qpt, prompt, max_new_tokens=N,
+                        cache=mk_t(2, S0 + N), vocab_size=cfg_t.vocab_size)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert (np.asarray(rounds) >= 1).all()
+
     def test_int8_draft_ragged(self):
         """The full composition: int8 draft + bf16 target + ragged batch,
         greedy — per-row token identity with solo decode."""
