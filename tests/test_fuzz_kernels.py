@@ -3,6 +3,8 @@ vs their XLA-composite golds — catches ragged-edge/padding bugs the
 fixed-shape parity tests can't (odd seqlens, non-128 head dims, GQA
 ratios, Sq != Sk). Bounded example counts keep the suite fast."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,9 +21,12 @@ pytestmark = pytest.mark.slow  # composed-step / fuzz suite: full run via check_
 
 # 5 examples/property (was 8): each example is a fresh-shape interpret
 # compile (~8s on one core); wall-time budget per VERDICT r3 Weak #5 —
-# the shape-space coverage is random anyway, the property doesn't weaken
-_SETTINGS = dict(max_examples=5, deadline=None,
-                 suppress_health_check=list(HealthCheck))
+# the shape-space coverage is random anyway, the property doesn't weaken.
+# APEX1_FUZZ_EXAMPLES overrides for deep one-off hunts
+# (e.g. APEX1_FUZZ_EXAMPLES=40 pytest tests/test_fuzz_kernels.py).
+_SETTINGS = dict(
+    max_examples=int(os.environ.get("APEX1_FUZZ_EXAMPLES") or "5"),
+    deadline=None, suppress_health_check=list(HealthCheck))
 
 
 @settings(**_SETTINGS)
