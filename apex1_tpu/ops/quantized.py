@@ -61,8 +61,13 @@ def _dequant_matmul_xla(x, wq, scale):
     as the Pallas kernel does — both paths share one numerics contract
     (a bf16-cast scale here would make the gold ~0.4% noisier than the
     kernel it golds, and shape-dependent, since this composite is also
-    the unaligned-shape fallback)."""
-    y = jnp.matmul(x, wq.astype(jnp.bfloat16).T,
+    the unaligned-shape fallback). The ACTIVATION is cast to bf16 for
+    the same reason: the kernel feeds the MXU bf16 activations, and an
+    fp32-x composite would make fp32 callers' results shape-dependent
+    (kernel on aligned shapes, more-precise composite on unaligned —
+    found by the int8 shape fuzz, round 5). Production decode passes
+    bf16 activations, where this cast is a no-op."""
+    y = jnp.matmul(x.astype(jnp.bfloat16), wq.astype(jnp.bfloat16).T,
                    preferred_element_type=jnp.float32)
     return y * scale.astype(jnp.float32)
 
@@ -166,8 +171,16 @@ def _int8_matmul_fwd(x, wq, scale, block_n, block_k):
 
 def _int8_matmul_bwd(block_n, block_k, res, dy):
     x_proto, wq, scale = res
-    w = wq.astype(jnp.bfloat16) * scale[:, None].astype(jnp.bfloat16)
-    dx = jnp.matmul(dy.astype(jnp.bfloat16), w,
+    # fp32 AD transpose of the fwd contract y = (x₁₆ @ wq₁₆ᵀ)·s₃₂: the
+    # scale rides the fp32 cotangent and the whole dot runs fp32 (int8
+    # weight values are exact in any float width, and dx is a
+    # test/tooling path — decode weights are frozen — so precision
+    # beats MXU-operand casting). The previous form cast BOTH dy and
+    # the scale to bf16, the same shape-dependent-numerics class
+    # ADVICE r4 flagged on the fwd composite — caught by the int8
+    # shape fuzz.
+    dx = jnp.matmul(dy.astype(jnp.float32) * scale.astype(jnp.float32),
+                    wq.astype(jnp.float32),
                     preferred_element_type=jnp.float32).astype(
                         x_proto.dtype)
     return dx, jnp.zeros_like(wq), jnp.zeros_like(scale)

@@ -102,6 +102,89 @@ def test_linear_xent_fuzz(t, h, v, smoothing, seed):
 
 @settings(**_SETTINGS)
 @given(
+    t=st.integers(1, 40),
+    n=st.sampled_from([8, 128, 200, 256]),
+    k=st.sampled_from([16, 128, 512, 520]),
+    block_n=st.sampled_from([128, 256]),
+    block_k=st.sampled_from([128, 512]),
+    seed=st.integers(0, 2**16),
+)
+def test_int8_matmul_fuzz(t, n, k, block_n, block_k, seed):
+    """int8 weight-only GEMM across aligned AND unaligned shapes — the
+    aligned path runs the Pallas kernel (sublane row padding, block-fit
+    heuristics), unaligned falls back to the composite; both must match
+    the explicit dequant gold, and dx must flow (dw is defined zero)."""
+    from apex1_tpu.ops.quantized import (_dequant_matmul_xla, int8_matmul,
+                                         quantize_int8)
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(t, k)), jnp.float32)
+    wq, scale = quantize_int8(w)
+
+    with force_impl("pallas"):
+        f = lambda x: jnp.sum(
+            int8_matmul(x, wq, scale, block_n, block_k) ** 2)
+        got = int8_matmul(x, wq, scale, block_n, block_k)
+        gx = jax.grad(f)(x)
+    # gold = the op's OWN numerics contract (_dequant_matmul_xla: bf16
+    # operands, fp32 accumulation, fp32 per-channel scale — also the
+    # unaligned-shape fallback, so unaligned draws compare exactly).
+    # A fp32-activation reference would diverge by the bf16 input cast
+    # on cancellation-heavy outputs (observed 9% relative on ~2% of
+    # elements) — quantization noise shared by both paths, not a kernel
+    # defect; this fuzz also caught the composite NOT casting x, i.e.
+    # shape-dependent numerics for fp32 callers (fixed in quantized.py).
+    want = _dequant_matmul_xla(x, wq, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3 * np.sqrt(k))
+    # dx gold: the ANALYTIC fp32 transpose dy·s₃₂ @ wq — the op's bwd
+    # is the same fp32 dot, so this matches tightly; tight enough that
+    # the bf16-scale bug this fuzz originally caught (~0.4% off) cannot
+    # hide. AD of the composite is NOT the gold here: jax's matmul
+    # transpose emits the x-cotangent in x's bf16 operand dtype, i.e.
+    # the gold itself would be bf16-rounded.
+    dy = 2.0 * jnp.asarray(got)
+    wantg = (dy * scale[None, :]) @ wq.astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(wantg),
+                               rtol=1e-4, atol=1e-4 * np.sqrt(k) * 8)
+
+
+@settings(**_SETTINGS)
+@given(
+    s=st.integers(1, 50),
+    h=st.sampled_from([1, 3]),
+    # 256 is the ONLY dim here that passes rope.py's `half % 128 == 0`
+    # kernel gate — without it every draw silently compares the XLA
+    # composite to itself (the hw_numerics.py:270 trap); the small dims
+    # keep fuzzing the composite's own edge shapes
+    d=st.sampled_from([8, 32, 64, 256]),
+    interleaved=st.booleans(),
+    offset=st.integers(0, 100),
+    seed=st.integers(0, 2**16),
+)
+def test_rope_fuzz(s, h, d, interleaved, offset, seed):
+    """Fused RoPE vs the composite rotation at fuzzed seq/heads/dim and
+    position offsets, both conventions, fwd + the rotate-by-minus-theta
+    backward (kernel-eligible only at d=256 — see the d note above)."""
+    from apex1_tpu.ops.rope import apply_rotary_pos_emb, rope_tables
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(1, s, h, d)), jnp.float32)
+    cos, sin = rope_tables(offset + jnp.arange(s), d)
+
+    def run(impl):
+        with force_impl(impl):
+            f = lambda x: jnp.sum(apply_rotary_pos_emb(
+                x, cos, sin, interleaved=interleaved) ** 2)
+            return (f(x), jax.grad(f)(x))
+
+    (got, gx), (want, wx) = run("pallas"), run("xla")
+    np.testing.assert_allclose(float(got), float(want), rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(wx),
+                               rtol=3e-5, atol=3e-5)
+
+
+@settings(**_SETTINGS)
+@given(
     rows=st.integers(1, 40),
     h=st.sampled_from([8, 96, 130]),
     rms=st.booleans(),
