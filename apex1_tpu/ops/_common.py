@@ -72,6 +72,26 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
+def out_struct(shape, dtype, *like):
+    """``ShapeDtypeStruct`` for a ``pallas_call`` output whose ``vma``
+    (varying-across-mesh-axes set) is the union of the ``like`` inputs'.
+
+    Under ``jax.shard_map(..., check_vma=True)`` — the default — every
+    pallas_call output must declare its vma or tracing fails with
+    "`vma` on `jax.ShapeDtypeStruct` must not be `None`" (review r5:
+    this made the Pallas path of ring/Ulysses attention untraceable in
+    the shipped TPU configuration while the CPU/XLA fallback hid it
+    from the suite). A kernel output varies exactly like the inputs it
+    is computed from, so the union is the right declaration; outside
+    shard_map every vma is the empty frozenset, which pallas_call
+    accepts in plain jit.
+    """
+    vma = frozenset()
+    for x in like:
+        vma |= jax.typeof(x).vma
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def pad_to(x: jnp.ndarray, axis: int, multiple: int, value=0.0):
     """Pad ``axis`` up to a multiple; returns (padded, original_size).
 

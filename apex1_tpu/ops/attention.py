@@ -40,7 +40,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import NEG_INF, interpret_mode, pad_to, use_pallas
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
+                                    pad_to, use_pallas)
 
 _LANES = 128
 
@@ -499,8 +500,10 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
         in_specs=in_specs,
         out_specs=(q_spec, stat_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, g["Dp"]), q.dtype),
-            jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, 1), jnp.float32)),
+            out_struct((g["B"], g["Hq"], Sqp, g["Dp"]), q.dtype,
+                       qp, kp, vp),
+            out_struct((g["B"], g["Hq"], Sqp, 1), jnp.float32,
+                       qp, kp, vp)),
         scratch_shapes=[
             pltpu.VMEM((g["bq"], g["Dp"]), jnp.float32),
             pltpu.VMEM((g["bq"], _LANES), jnp.float32),
@@ -560,8 +563,8 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
         grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
         in_specs=in_specs,
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((g["B"], g["Hq"], Sqp, g["Dp"]),
-                                       q.dtype),
+        out_shape=out_struct((g["B"], g["Hq"], Sqp, g["Dp"]), q.dtype,
+                             qp, kp, vp, dop),
         scratch_shapes=[pltpu.VMEM((g["bq"], g["Dp"]), jnp.float32)],
         interpret=interpret_mode(),
     )(*args)[:, :, :g["Sq"], :g["D"]]
@@ -588,10 +591,10 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
         in_specs=in_specs,
         out_specs=(dkv_spec, dkv_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((g["B"], g["Hkv"], Skp, g["Dp"]),
-                                 jnp.float32),
-            jax.ShapeDtypeStruct((g["B"], g["Hkv"], Skp, g["Dp"]),
-                                 jnp.float32)),
+            out_struct((g["B"], g["Hkv"], Skp, g["Dp"]), jnp.float32,
+                       qp, kp, vp, dop),
+            out_struct((g["B"], g["Hkv"], Skp, g["Dp"]), jnp.float32,
+                       qp, kp, vp, dop)),
         scratch_shapes=[pltpu.VMEM((g["bk"], g["Dp"]), jnp.float32),
                         pltpu.VMEM((g["bk"], g["Dp"]), jnp.float32)],
         interpret=interpret_mode(),
@@ -648,8 +651,9 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
             grid=(Bb, Hb, g["n_q"], g["n_k"], n_r),
             in_specs=in_specs,
             out_specs=db_spec,
-            out_shape=jax.ShapeDtypeStruct(
-                (Bb, Hb, Sqp, g["n_k"] * g["bk"]), jnp.float32),
+            out_shape=out_struct(
+                (Bb, Hb, Sqp, g["n_k"] * g["bk"]), jnp.float32,
+                qp, kp, vp, dop, bp),
             scratch_shapes=[pltpu.VMEM((g["bq"], g["bk"]), jnp.float32)],
             interpret=interpret_mode(),
         )(*args)

@@ -34,8 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (as_rows, interpret_mode, pad_to,
-                                   row_block, use_pallas)
+from apex1_tpu.ops._common import (as_rows, interpret_mode, out_struct,
+                                   pad_to, row_block, use_pallas)
 
 
 # --------------------------------------------------------------------------
@@ -123,9 +123,9 @@ def _pallas_fwd(x2, gamma2, beta2, eps, true_h, rms, br):
         grid=(pl.cdiv(rows, br),),
         in_specs=in_specs,
         out_specs=(row, stat, stat),
-        out_shape=(jax.ShapeDtypeStruct((rows, h), x2.dtype),
-                   jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)),
+        out_shape=(out_struct((rows, h), x2.dtype, x2, gamma2),
+                   out_struct((rows, 1), jnp.float32, x2, gamma2),
+                   out_struct((rows, 1), jnp.float32, x2, gamma2)),
         interpret=interpret_mode(),
     )(*args)
 
@@ -136,17 +136,17 @@ def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta, br):
     if with_beta:
         kernel = functools.partial(_bwd_kernel, true_h=true_h, rms=rms)
         out_specs = (row, vec, vec)
-        out_shape = (jax.ShapeDtypeStruct((rows, h), x2.dtype),
-                     jax.ShapeDtypeStruct((1, h), jnp.float32),
-                     jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_shape = (out_struct((rows, h), x2.dtype, x2, gamma2, dy2),
+                     out_struct((1, h), jnp.float32, x2, gamma2, dy2),
+                     out_struct((1, h), jnp.float32, x2, gamma2, dy2))
     else:
         kernel = functools.partial(
             lambda xr, gr, mr, rr, dyr, dxr, dgr, **kw: _bwd_kernel(
                 xr, gr, mr, rr, dyr, dxr, dgr, None, **kw),
             true_h=true_h, rms=rms)
         out_specs = (row, vec)
-        out_shape = (jax.ShapeDtypeStruct((rows, h), x2.dtype),
-                     jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_shape = (out_struct((rows, h), x2.dtype, x2, gamma2, dy2),
+                     out_struct((1, h), jnp.float32, x2, gamma2, dy2))
     return pl.pallas_call(
         kernel,
         grid=(pl.cdiv(rows, br),),

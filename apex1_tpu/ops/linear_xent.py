@@ -38,7 +38,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import NEG_INF, interpret_mode, pad_to, use_pallas
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
+                                    pad_to, use_pallas)
 
 _LANES = 128
 
@@ -264,7 +265,7 @@ def shard_stats(x2, w_shard, t2, *, col_offset=0, num_classes=None,
         grid=(g["n_t"], g["n_v"]),
         in_specs=[x_spec, w_spec, stat_spec, off_spec],
         out_specs=(stat_spec,) * 4,
-        out_shape=(jax.ShapeDtypeStruct((Tp, 1), jnp.float32),) * 4,
+        out_shape=(out_struct((Tp, 1), jnp.float32, xp, wp, tp),) * 4,
         scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
         interpret=interpret_mode(),
     )(xp, wp, tp, _off_array(col_offset))
@@ -292,7 +293,7 @@ def shard_grads(x2, w_shard, t2, lse, dloss, *, col_offset=0,
         in_specs=[x_spec, w_spec, stat_spec, off_spec, stat_spec,
                   stat_spec],
         out_specs=x_spec,
-        out_shape=jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+        out_shape=out_struct(xp.shape, x2.dtype, xp, wp, tp, lse_p, dl),
         scratch_shapes=[pltpu.VMEM((g["bt"], g["Hp"]), jnp.float32)],
         interpret=interpret_mode(),
     )(xp, wp, tp, off, lse_p, dl)[:g["T"], :g["H"]]
@@ -304,7 +305,8 @@ def shard_grads(x2, w_shard, t2, lse, dloss, *, col_offset=0,
         in_specs=[x_spec, w_spec, stat_spec, off_spec, stat_spec,
                   stat_spec],
         out_specs=w_spec,
-        out_shape=jax.ShapeDtypeStruct(wp.shape, w_shard.dtype),
+        out_shape=out_struct(wp.shape, w_shard.dtype, xp, wp, tp,
+                             lse_p, dl),
         scratch_shapes=[pltpu.VMEM((g["bv"], g["Hp"]), jnp.float32)],
         interpret=interpret_mode(),
     )(xp, wp, tp, off, lse_p, dl)[:g["V"], :g["H"]]
@@ -331,8 +333,8 @@ def _fused_fwd(x2, weight, t2, smoothing, padding_idx, num_classes,
         grid=(g["n_t"], g["n_v"]),
         in_specs=[x_spec, w_spec, stat_spec, off_spec],
         out_specs=(stat_spec, stat_spec),
-        out_shape=(jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((Tp, 1), jnp.float32)),
+        out_shape=(out_struct((Tp, 1), jnp.float32, xp, wp, tp),
+                   out_struct((Tp, 1), jnp.float32, xp, wp, tp)),
         scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
         interpret=interpret_mode(),
     )(xp, wp, tp, _off_array(0))

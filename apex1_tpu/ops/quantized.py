@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import interpret_mode, use_pallas
+from apex1_tpu.ops._common import interpret_mode, out_struct, use_pallas
 
 
 def quantize_int8(w, *, axis: int = -1):
@@ -122,7 +122,7 @@ def _pallas_int8_matmul(x, wq, scale, block_n: int, block_k: int):
         ],
         out_specs=pl.BlockSpec((T, bn), lambda n, k: (0, n),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((T, N), jnp.float32),
+        out_shape=out_struct((T, N), jnp.float32, x, wq, scale),
         interpret=interpret_mode(),
     )(x, wq, scale.reshape(1, N))
 

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import interpret_mode, row_block, use_pallas
+from apex1_tpu.ops._common import (interpret_mode, out_struct, row_block,
+                                   use_pallas)
 
 
 def rope_tables(positions, head_dim: int, *, base: float = 10000.0,
@@ -57,8 +58,8 @@ def _pallas_rope(x1, x2, cos_r, sin_r):
         grid=(pl.cdiv(rows, br),),
         in_specs=[row, row, row, row],
         out_specs=(row, row),
-        out_shape=(jax.ShapeDtypeStruct(x1.shape, x1.dtype),
-                   jax.ShapeDtypeStruct(x2.shape, x2.dtype)),
+        out_shape=(out_struct(x1.shape, x1.dtype, x1, x2, cos_r, sin_r),
+                   out_struct(x2.shape, x2.dtype, x1, x2, cos_r, sin_r)),
         interpret=interpret_mode(),
     )(x1, x2, cos_r, sin_r)
 

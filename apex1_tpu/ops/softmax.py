@@ -28,8 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (NEG_INF, interpret_mode, pad_to,
-                                   row_block, use_pallas)
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
+                                   pad_to, row_block, use_pallas)
 
 
 def _fwd_kernel(x_ref, mask_ref, y_ref, *, scale, causal, true_k):
@@ -86,7 +86,7 @@ def _pallas_softmax_fwd(x4, mask4, scale, causal, true_k, bq):
         grid=grid,
         in_specs=in_specs,
         out_specs=x_spec,
-        out_shape=jax.ShapeDtypeStruct(x4.shape, x4.dtype),
+        out_shape=out_struct(x4.shape, x4.dtype, *args),
         interpret=interpret_mode(),
     )(*args)
 
@@ -100,7 +100,7 @@ def _pallas_softmax_bwd(y2, dy2, scale, bq):
         grid=(pl.cdiv(rows, bq),),
         in_specs=[row, row],
         out_specs=row,
-        out_shape=jax.ShapeDtypeStruct((rows, k), y2.dtype),
+        out_shape=out_struct((rows, k), y2.dtype, y2, dy2),
         interpret=interpret_mode(),
     )(y2, dy2)
 

@@ -27,8 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (NEG_INF, interpret_mode, pad_to,
-                                   row_block, use_pallas)
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
+                                   pad_to, row_block, use_pallas)
 
 
 
@@ -99,8 +99,8 @@ def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes):
         grid=(pl.cdiv(x2p.shape[0], br),),
         in_specs=[row, stat],
         out_specs=(stat, stat),
-        out_shape=(jax.ShapeDtypeStruct((x2p.shape[0], 1), jnp.float32),
-                   jax.ShapeDtypeStruct((x2p.shape[0], 1), jnp.float32)),
+        out_shape=(out_struct((x2p.shape[0], 1), jnp.float32, x2p, t2p),
+                   out_struct((x2p.shape[0], 1), jnp.float32, x2p, t2p)),
         interpret=interpret_mode(),
     )(x2p, t2p)
     loss = loss[:rows, 0].reshape(shape[:-1])
@@ -126,7 +126,7 @@ def _fused_xent_bwd(smoothing, padding_idx, num_classes, res, dloss):
         grid=(pl.cdiv(x2p.shape[0], br),),
         in_specs=[row, stat, stat, stat],
         out_specs=row,
-        out_shape=jax.ShapeDtypeStruct(x2p.shape, logits.dtype),
+        out_shape=out_struct(x2p.shape, logits.dtype, x2p, t2p, lse, d2p),
         interpret=interpret_mode(),
     )(x2p, t2p, lse, d2p)
     return dx[:rows, :shape[-1]].reshape(shape), None

@@ -44,7 +44,15 @@ def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
                                segment_ids=segment_ids, sm_scale=sm_scale,
                                block_q=block_q, block_k=block_k)
     Hq, Hkv = q.shape[1], k.shape[1]
-    if Hkv % n and n % Hkv == 0:
+    # validate BEFORE the GQA repeat below mutates Hkv: the error must
+    # name the USER'S head counts, and the repeat work must not run
+    # just to be thrown away (review r5)
+    hkv_eff = n if (Hkv % n and n % Hkv == 0) else Hkv
+    if Hq % n or hkv_eff % n:
+        raise ValueError(
+            f"ulysses needs head counts divisible by the axis size: "
+            f"Hq={Hq}, Hkv={Hkv}, n={n} (use ring_attention otherwise)")
+    if Hkv % n:
         # GQA with fewer KV heads than devices: materialize the group
         # repeat (exactly how GQA attention is defined) so KV heads
         # split evenly; costs KV bandwidth, preserves semantics
@@ -52,10 +60,6 @@ def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
         Hkv = n
-    if Hq % n or Hkv % n:
-        raise ValueError(
-            f"ulysses needs head counts divisible by the axis size: "
-            f"Hq={Hq}, Hkv={Hkv}, n={n} (use ring_attention otherwise)")
 
     def seq_to_heads(t):   # (B, H, S_l, D) -> (B, H/n, S, D)
         return jax.lax.all_to_all(t, axis_name, split_axis=1,

@@ -8,6 +8,21 @@ shape prenegotiation. On TPU the equivalent primitive is a ring
 porting parity and for tests; the scan-based schedules call ppermute
 directly. Shape negotiation (``tensor_shape`` args) is unnecessary: shapes
 are static under XLA.
+
+PAIRING CONTRACT (differs from NCCL two-sided semantics — review r5): the
+ONE ring permute in ``send_forward`` both sends and delivers, so after
+``y = send_forward(x)`` every stage already holds its received value —
+``recv_forward`` is therefore an IDENTITY shim, kept so reference-style
+paired call sites (``send_forward(out); x = recv_forward(out)``) port
+without double-shifting the ring. The fused names make the actual dataflow
+explicit; prefer them in new code.
+
+Ring wraparound: stage 0's "received" value after ``send_forward`` is stage
+P-1's output (a ring has no edge). The reference's ``recv_forward`` returns
+``None`` at the first stage instead; under SPMD every device computes, so
+callers mask stage 0's input themselves (the schedules inject the fresh
+microbatch there — see ``schedules.pipeline_apply``'s stage-0 select), and
+symmetrically stage P-1's input under ``send_backward``.
 """
 
 from __future__ import annotations
@@ -36,8 +51,22 @@ def send_backward_recv_backward(g, *, axis_name: str = AXIS_PP):
     return jax.lax.ppermute(g, axis_name, perm=_ring_perm(P, reverse=True))
 
 
-# single-direction names for API parity; on a ring each is the same permute
+# the permute lives in send_*; recv_* are identity shims so the
+# reference's paired send-then-recv call pattern performs exactly ONE
+# ring shift (see PAIRING CONTRACT above)
 send_forward = send_forward_recv_forward
-recv_forward = send_forward_recv_forward
 send_backward = send_backward_recv_backward
-recv_backward = send_backward_recv_backward
+
+
+def recv_forward(x, *, axis_name: str = AXIS_PP):
+    """Identity shim: after ``send_forward`` the received activation is
+    already resident (see PAIRING CONTRACT in the module docstring)."""
+    del axis_name
+    return x
+
+
+def recv_backward(g, *, axis_name: str = AXIS_PP):
+    """Identity shim: after ``send_backward`` the received gradient is
+    already resident (see PAIRING CONTRACT in the module docstring)."""
+    del axis_name
+    return g

@@ -824,3 +824,48 @@ class Test1F1B:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(dmb), np.asarray(gx),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestP2PCommunication:
+    """Behavioral direction pins for the p2p shims (review r5: a flipped
+    _ring_perm previously passed the whole suite — only the API surface
+    was checked)."""
+
+    def _vals(self, mesh, fn):
+        from jax.sharding import PartitionSpec as Ps
+        P_ = mesh.devices.size
+        x = jnp.arange(P_, dtype=jnp.float32).reshape(P_, 1)
+        y = jax.jit(jax.shard_map(
+            lambda x: fn(x[0])[None],
+            mesh=mesh, in_specs=Ps("pp"), out_specs=Ps("pp"),
+            check_vma=False))(x)
+        return np.asarray(y).ravel()
+
+    def test_send_forward_shifts_down_ring(self, devices):
+        from apex1_tpu.transformer.pipeline_parallel import (
+            p2p_communication as p2p)
+        mesh = make_mesh(pp=4, devices=devices[:4])
+        # stage s receives stage s-1's value; stage 0 wraps to P-1
+        got = self._vals(mesh, p2p.send_forward)
+        np.testing.assert_array_equal(got, [3.0, 0.0, 1.0, 2.0])
+
+    def test_send_backward_shifts_up_ring(self, devices):
+        from apex1_tpu.transformer.pipeline_parallel import (
+            p2p_communication as p2p)
+        mesh = make_mesh(pp=4, devices=devices[:4])
+        # stage s receives stage s+1's gradient; stage P-1 wraps to 0
+        got = self._vals(mesh, p2p.send_backward)
+        np.testing.assert_array_equal(got, [1.0, 2.0, 3.0, 0.0])
+
+    def test_paired_send_recv_is_one_shift(self, devices):
+        """The reference's two-call pattern must shift exactly once —
+        recv_* are identity shims (the module's PAIRING CONTRACT)."""
+        from apex1_tpu.transformer.pipeline_parallel import (
+            p2p_communication as p2p)
+        mesh = make_mesh(pp=4, devices=devices[:4])
+        got = self._vals(
+            mesh, lambda x: p2p.recv_forward(p2p.send_forward(x)))
+        np.testing.assert_array_equal(got, [3.0, 0.0, 1.0, 2.0])
+        got = self._vals(
+            mesh, lambda x: p2p.recv_backward(p2p.send_backward(x)))
+        np.testing.assert_array_equal(got, [1.0, 2.0, 3.0, 0.0])

@@ -309,10 +309,13 @@ def main():
                         return ulysses_attention(q, k, v, "cp",
                                                  causal=True)
 
+                # DEFAULT check_vma (True): guards the vma
+                # declaration on pallas_call out_shapes (review r5 —
+                # with check_vma=False here, the shipped-default
+                # config was untraceable and no gate caught it)
                 f = jax.shard_map(local, mesh=cp_mesh,
                                   in_specs=(P(None, None, "cp"),) * 3,
-                                  out_specs=P(None, None, "cp"),
-                                  check_vma=False)
+                                  out_specs=P(None, None, "cp"))
                 return f, arrs
             return builder
 
