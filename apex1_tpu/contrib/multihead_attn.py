@@ -33,9 +33,10 @@ from apex1_tpu.ops.attention import flash_attention
 
 def _attend(q, k, v, *, causal, mask_additive, dropout, deterministic,
             dropout_rng, sm_scale):
-    """(B,H,S,D) attention core: flash kernel, or the composite when
-    probability dropout / an additive mask is required."""
-    if dropout > 0.0 and not deterministic or mask_additive is not None:
+    """(B,H,S,D) attention core: flash kernel (additive masks ride its
+    bias operand — both paths compute softmax(scale·qk + mask)), or the
+    composite only when probability dropout must be materialized."""
+    if dropout > 0.0 and not deterministic:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                             preferred_element_type=jnp.float32)
         if causal:
@@ -45,12 +46,22 @@ def _attend(q, k, v, *, causal, mask_additive, dropout, deterministic,
             scores = jnp.where(col > row, -1e30, scores)
         probs = scaled_masked_softmax(scores, mask_additive, scale=sm_scale)
         probs = probs.astype(q.dtype)
-        if dropout > 0.0 and not deterministic:
-            keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout,
-                                        probs.shape)
-            probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
         return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    bias = mask_additive
+    if bias is not None:
+        # the kernel validates bias as (1|B, 1|H, Sq, Sk) with the seq
+        # dims FULL — broadcast a (B, 1, 1, Sk)-style mask's seq dims up
+        # front (batch/head dims stay size-1 into the kernel)
+        sq, sk = q.shape[2], k.shape[2]
+        while bias.ndim < 4:
+            bias = bias[None]
+        bias = jnp.broadcast_to(
+            bias, bias.shape[:2] + (sq, sk)).astype(jnp.float32)
+    return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                           bias=bias)
 
 
 class SelfMultiheadAttn(nn.Module):

@@ -71,7 +71,13 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     verify): S > 1 NEW tokens against a NON-empty cache — query j
     attends cache positions ≤ cache_index + j (history + causal within
     the chunk), via the composite path with a per-query mask. S == 1
-    decode is the chunk_decode special case.
+    decode is the chunk_decode special case. An EMPTY cache at
+    ``cache_index == 0`` is also legal here (the horizon mask reduces to
+    plain causal prefill) — this is the FIXED-SHAPE chunked-prefill mode
+    `apex1_tpu.serving`'s engine rides: one (1, C) chunk executable
+    serves every prompt length (pad the tail chunk on the RIGHT; query
+    j never reaches a pad slot k > cache_index + j, and the next write
+    overwrites the pad K/V before any query can see it).
 
     Returns (attn (B, H, S, D), new_cache_entry).
     """
@@ -127,6 +133,17 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     probs = jax.nn.softmax(scores_b, axis=-1).astype(q.dtype)
     attn = jnp.einsum("bhgsk,bhkd->bhgsd", probs, v_all)
     return attn.reshape(B, Hq, S, D), new_entry
+
+
+def last_real_logits(logits, lengths):
+    """(B, S, V) chunk logits → (B, V) at each row's LAST REAL token
+    (index ``lengths[b] - 1``). The gather behind fixed-shape prefill:
+    `apex1_tpu.serving`'s engine pads every prompt's tail chunk up to
+    the chunk width, so the logit to sample the first token from sits
+    at a per-row TRACED index, not at ``[:, -1]`` — one executable
+    serves every prompt length without re-jitting per call."""
+    idx = (jnp.asarray(lengths, jnp.int32) - 1).reshape(-1, 1, 1)
+    return jnp.take_along_axis(logits, idx, axis=1)[:, 0]
 
 
 def sample_token(logits, rng, *, temperature: float = 0.0,

@@ -86,9 +86,15 @@ def out_struct(shape, dtype, *like):
     shard_map every vma is the empty frozenset, which pallas_call
     accepts in plain jit.
     """
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        # older jax (< 0.6): no vma concept on avals and no `vma=`
+        # parameter on ShapeDtypeStruct — shard_map there has no
+        # check_vma gate either, so the plain struct is complete
+        return jax.ShapeDtypeStruct(shape, dtype)
     vma = frozenset()
     for x in like:
-        vma |= jax.typeof(x).vma
+        vma |= typeof(x).vma
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 

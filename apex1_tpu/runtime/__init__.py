@@ -622,3 +622,98 @@ class PrefetchLoader:
                     "PrefetchLoader worker did not stop within 60s (source "
                     "blocked?); resources it reads must outlive it",
                     RuntimeWarning, stacklevel=2)
+
+
+class RequestFeeder:
+    """Background request-ingest thread for `apex1_tpu.serving`: pulls
+    raw prompts from ``source`` (an iterable of anything — text lines,
+    token lists), tokenizes them OFF the engine's critical path, and
+    pushes them through ``submit`` (the engine/scheduler entry point),
+    absorbing `Backpressure` with bounded retry instead of dropping —
+    the host-side half of continuous batching (the device never waits
+    on tokenization; the queue never overflows silently).
+
+    ``tokenize(item) -> (tokens, kwargs)`` where kwargs go straight to
+    ``submit(tokens, **kwargs)`` (``max_new_tokens`` etc.). Rejections
+    that outlive ``retries`` land in ``dropped`` with the reason.
+
+    The worker only SUBMITS; stepping the engine stays with the caller
+    (the engine is not thread-safe by design — one loop owns the
+    device). Typical shape::
+
+        feeder = RequestFeeder(prompts, tokenize, engine.submit)
+        feeder.start()
+        while not feeder.idle or engine.n_active or engine.scheduler.depth:
+            engine.step()
+        feeder.join()
+    """
+
+    def __init__(self, source: Iterable, tokenize: Callable,
+                 submit: Callable, *, retries: int = 100,
+                 retry_wait_s: float = 0.005):
+        self.source = source
+        self.tokenize = tokenize
+        self.submit = submit
+        self.retries = int(retries)
+        self.retry_wait_s = float(retry_wait_s)
+        self.submitted: list = []
+        self.dropped: list = []          # (item, reason)
+        self.errors: list = []
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+
+    @property
+    def idle(self) -> bool:
+        """True once the source is drained and every item dispatched."""
+        return self._done.is_set()
+
+    def start(self) -> "RequestFeeder":
+        from apex1_tpu.serving.scheduler import (Backpressure,
+                                                 new_request_id)
+
+        def work():
+            try:
+                for item in self.source:
+                    # a PER-ITEM failure (tokenizer bug, contract
+                    # ValueError from submit) drops THAT item and keeps
+                    # feeding — one malformed request must not silently
+                    # starve the rest of the stream (review finding)
+                    try:
+                        tokens, kw = self.tokenize(item)
+                    except Exception as e:
+                        self.dropped.append((item, f"tokenize: {e!r}"))
+                        self.errors.append(e)
+                        continue
+                    # one id across every retry attempt: transient
+                    # backpressure rejections then update ONE metrics
+                    # record instead of minting a phantom rejected
+                    # record per attempt (review finding)
+                    kw.setdefault("req_id", new_request_id())
+                    for attempt in range(self.retries + 1):
+                        try:
+                            self.submitted.append(
+                                self.submit(tokens, **kw))
+                            break
+                        except Backpressure as e:
+                            if attempt == self.retries:
+                                self.dropped.append((item, e.reason))
+                            else:
+                                _time.sleep(self.retry_wait_s)
+                        except Exception as e:
+                            self.dropped.append((item, repr(e)))
+                            self.errors.append(e)
+                            break
+            except BaseException as e:   # source iteration died —
+                self.errors.append(e)    # surfaced via join()
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.errors:
+            raise self.errors[0]

@@ -1,0 +1,28 @@
+"""`apex1_tpu.serving` — continuous-batching inference engine.
+
+The serving layer the ROADMAP's "heavy traffic" north star needs on
+top of the `models.generate` decode spine: a request scheduler with
+backpressure and deadlines (`scheduler`), a fixed-slot KV pool with
+refcounted shared-prefix pages (`kv_pool`), the two-executable
+continuous-batching loop itself (`engine`), and per-request lifecycle
+metrics (`metrics`). See ``docs/serving.md`` § Engine.
+
+Quick start::
+
+    from apex1_tpu.models.generate import llama_decoder
+    from apex1_tpu.serving import Engine, EngineConfig
+
+    engine = Engine(*llama_decoder(model), params,
+                    EngineConfig(max_slots=8, max_len=512, eos_id=2))
+    rid = engine.submit(prompt_ids, max_new_tokens=64)
+    engine.run()
+    print(engine.results[rid].tokens)
+"""
+
+from apex1_tpu.serving.engine import (Engine, EngineConfig,  # noqa: F401
+                                      RequestResult)
+from apex1_tpu.serving.kv_pool import KVPool, PrefixPage  # noqa: F401
+from apex1_tpu.serving.metrics import (RequestRecord,  # noqa: F401
+                                       ServingMetrics)
+from apex1_tpu.serving.scheduler import (Backpressure,  # noqa: F401
+                                         Request, Scheduler)

@@ -1,0 +1,465 @@
+"""Continuous-batching inference engine over the chunk-decode spine.
+
+`models.generate` runs ONE batch, assembled by the caller, start to
+finish; the TPU idles while the host builds the next batch, and a long
+request holds the whole batch hostage. This engine serves a STREAM:
+requests join a fixed pool of KV slots the moment one frees, and leave
+on EOS / length / deadline — the decode step never stops for them.
+
+TPU-first shape discipline (PAPER.md: static shapes, one dispatch —
+the serving corollary of the training thesis): the pool is a fixed
+``(max_slots, max_len)`` cache pytree, and the whole engine compiles
+EXACTLY TWO executables, traced once each for the life of the engine:
+
+- **prefill** — one ``(1, prefill_chunk)`` chunk-decode forward against
+  one slot's lane. Every prompt, of any length, is fed as right-padded
+  fixed-width chunks at a traced ``cache_index`` (the chunk mode of
+  `cached_attention` subsumes prefill — an empty cache at index 0 is
+  its degenerate case), so admission never retraces. The slot id, the
+  install-this-lane flag (zeros for a fresh request, a shared-prefix
+  page for a sharer), and the real-token count are all traced operands.
+- **decode** — one step for ALL slots: a ``vmap`` of the batch-1 cached
+  forward over the pool's leading axis, each row carrying its OWN
+  traced cache index (rows are at different depths — that is the whole
+  point). Inactive lanes compute masked garbage into their free slot;
+  retirement and admission change only ARRAY VALUES, never shapes.
+
+``Engine.trace_counts`` is the compilation-count hook: the counter
+increments inside each traced Python body, so a retrace — the thing
+this design forbids — is observable as a count > 1 (`test_serving::
+TestContinuousBatching::
+test_staggered_join_leave_token_identical_two_executables`).
+
+ASYNC DISPATCH: the decode control vectors (token/index/active per
+slot) live on DEVICE and are patched in place at join/leave
+boundaries, so the step chain is dispatch-only from the host's side.
+With ``eos_id=None`` retirement is purely length-based (known at
+admission) and the engine NEVER reads a step's tokens back before
+dispatching the next — per-step outputs accumulate in a device-side
+log and are materialized once, at retirement. With an ``eos_id`` the
+engine must observe each step's tokens to retire rows (one small
+blocking readback per step) — the latency cost of data-dependent
+control, paid only when asked for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex1_tpu.models.generate import last_real_logits, sample_token
+from apex1_tpu.serving.kv_pool import KVPool
+from apex1_tpu.serving.metrics import ServingMetrics
+from apex1_tpu.serving.scheduler import Backpressure, Request, Scheduler
+from apex1_tpu.utils.observability import MetricsLogger, annotate
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Engine shape/sampling/admission knobs. Everything here is STATIC
+    for the life of the engine (baked into the two executables); all
+    per-request variation rides traced operands."""
+
+    max_slots: int = 8           # concurrent requests (pool batch)
+    max_len: int = 256           # cache positions per slot
+    prefill_chunk: int = 16      # prompt tokens per prefill call
+    temperature: float = 0.0     # 0 = greedy (engine-global; a per-
+    top_k: Optional[int] = None  # request temperature would retrace)
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    vocab_size: Optional[int] = None
+    seed: int = 0
+    max_queue: int = 64          # admission backpressure bound
+    policy: str = "fifo"         # or "sjf" (see serving.scheduler)
+
+    def __post_init__(self):
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self.max_len < 2:
+            raise ValueError("max_len must be >= 2")
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal outcome. ``tokens`` holds whatever was generated before
+    the terminal event (full output for "done", a prefix for evictions
+    and cancellations)."""
+
+    req_id: int
+    status: str                  # done | evicted | cancelled
+    tokens: np.ndarray
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side state of one occupied pool lane."""
+
+    req: Request
+    first_tok: object            # device scalar (or int once read)
+    start_step: int              # engine step its first DECODE lands at
+    n_out: int = 1               # tokens emitted so far (first included)
+    in_batch: bool = False       # joined the decode batch (not retired
+    eos_seen: bool = False       #  at prefill)
+    cancel: bool = False
+    produced: List[int] = dataclasses.field(default_factory=list)
+
+
+class Engine:
+    """Continuous-batching engine over a ``(apply_fn, make_cache)``
+    decoder pair (`models.generate.gpt2_decoder` / `llama_decoder`).
+
+    Drive it with `submit` + `step`/`run`; finished requests appear in
+    `results`. One `step()` = retire (deadline/cancel) → admit queued
+    requests into free slots (chunked prefill) → one pooled decode
+    step. ``metrics`` collects the full lifecycle (`ServingMetrics`).
+    """
+
+    def __init__(self, apply_fn: Callable, make_cache: Callable, params,
+                 config: Optional[EngineConfig] = None, *,
+                 metrics_logger: Optional[MetricsLogger] = None,
+                 cache_dtype=None):
+        self.cfg = cfg = config or EngineConfig()
+        self.params = params
+        self._apply_fn = apply_fn
+        # the pool carries prefill_chunk-1 slack positions past the
+        # usable max_len: the FINAL prefill chunk is right-padded to the
+        # full chunk width, so its write can extend up to that far past
+        # the last real token — without the slack,
+        # `dynamic_update_slice` would CLAMP the start index and
+        # silently shift the whole chunk onto earlier K/V (the same
+        # hazard generate()'s capacity check guards). The pad K/V in
+        # the slack is masked (never attended) and overwritten by later
+        # writes; max_len itself stays the admission contract.
+        self.kv = KVPool(make_cache, cfg.max_slots,
+                         cfg.max_len + cfg.prefill_chunk - 1,
+                         dtype=cache_dtype)
+        self.scheduler = Scheduler(max_queue=cfg.max_queue,
+                                   policy=cfg.policy)
+        self.metrics = ServingMetrics(metrics_logger)
+        self.results: Dict[int, RequestResult] = {}
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        self._slots: List[Optional[_Slot]] = [None] * cfg.max_slots
+        self._rng = jax.random.key(cfg.seed)
+        # device-resident control vectors, patched in place at
+        # join/leave boundaries — the steady-state step chain re-feeds
+        # the previous step's outputs without ever touching the host
+        self._d_toks = jnp.zeros((cfg.max_slots,), jnp.int32)
+        self._d_idxs = jnp.zeros((cfg.max_slots,), jnp.int32)
+        self._d_active = jnp.zeros((cfg.max_slots,), bool)
+        self._n_active = 0
+        # eos_id=None: retirement is length-based, so step tokens are
+        # only READ at retirement — the log keeps each step's (N,)
+        # output (device array until first fetch memoizes it as numpy)
+        self._defer = cfg.eos_id is None
+        self._tok_log: Dict[int, object] = {}
+        self._step_no = 0
+        self._build_executables()
+
+    # ---- the two executables -------------------------------------------
+
+    def _build_executables(self):
+        cfg = self.cfg
+        apply_fn = self._apply_fn
+        C = cfg.prefill_chunk
+        sample_kw = dict(temperature=cfg.temperature, top_k=cfg.top_k,
+                         vocab_size=cfg.vocab_size)
+
+        def prefill(params, pool, slot, init_lane, install, tokens, idx,
+                    n_real, rng):
+            self.trace_counts["prefill"] += 1   # the compile-count hook
+            lane = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, slot, 1, 0),
+                pool)
+            lane = jax.tree_util.tree_map(
+                lambda cur, ini: jnp.where(install, ini, cur), lane,
+                init_lane)
+            positions = (jnp.asarray(idx, jnp.int32)
+                         + jnp.arange(C, dtype=jnp.int32))[None]
+            logits, lane = apply_fn(params, tokens, lane, idx,
+                                    positions=positions,
+                                    chunk_decode=True)
+            pool = jax.tree_util.tree_map(
+                lambda p, l: jax.lax.dynamic_update_slice_in_dim(
+                    p, l.astype(p.dtype), slot, 0), pool, lane)
+            rng, sub = jax.random.split(rng)
+            tok = sample_token(last_real_logits(logits, n_real[None]),
+                               sub, **sample_kw)[0]
+            return tok, pool, rng
+
+        def decode(params, pool, toks, idxs, active, rng):
+            self.trace_counts["decode"] += 1    # the compile-count hook
+            keys = jax.random.split(rng, cfg.max_slots + 1)
+
+            def row(tok, lane, idx, key):
+                lane = jax.tree_util.tree_map(lambda x: x[None], lane)
+                logits, lane = apply_fn(params, tok.reshape(1, 1), lane,
+                                        idx)
+                nxt = sample_token(logits[:, -1], key, **sample_kw)[0]
+                return nxt, jax.tree_util.tree_map(lambda x: x[0], lane)
+
+            nxt, pool = jax.vmap(row)(toks, pool, idxs,
+                                      keys[:cfg.max_slots])
+            nxt = jnp.where(active, nxt, cfg.pad_id)
+            idxs = idxs + active.astype(jnp.int32)
+            return nxt, idxs, pool, keys[cfg.max_slots]
+
+        # donate the pool so XLA updates the cache in place; CPU lacks
+        # input/output aliasing for some buffers — skip there to avoid
+        # per-call warnings (semantics identical, one extra copy)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._decode = jax.jit(decode, donate_argnums=donate)
+
+    # ---- submission -----------------------------------------------------
+
+    def submit(self, tokens, max_new_tokens: int, *, prefix=None,
+               deadline: Optional[float] = None,
+               req_id: Optional[int] = None) -> int:
+        """Enqueue a request. Raises `Backpressure` when the queue is
+        full (the caller's 429) and `ValueError` when the request can
+        NEVER fit (prefix + prompt + max_new_tokens - 1 > max_len — not
+        backpressure, a contract violation)."""
+        req = Request(tokens=tokens, max_new_tokens=max_new_tokens,
+                      prefix=prefix, deadline=deadline, req_id=req_id)
+        if req.total_len > self.cfg.max_len:
+            raise ValueError(
+                f"request needs {req.total_len} cache positions but "
+                f"slots hold max_len={self.cfg.max_len}")
+        try:
+            rid = self.scheduler.submit(req)
+        except Backpressure as e:
+            self.metrics.event(req.req_id, "queued",
+                               n_prompt=req.tokens.size)
+            self.metrics.event(req.req_id, "rejected", reason=e.reason)
+            raise
+        self.metrics.event(rid, "queued", n_prompt=req.tokens.size)
+        return rid
+
+    def cancel(self, req_id: int) -> bool:
+        """Cancel a queued OR running request. Running requests retire
+        (and free their slot) at the next step boundary."""
+        if self.scheduler.cancel(req_id):
+            self._finish(req_id, "cancelled", "cancelled queued", [])
+            return True
+        for slot in self._slots:
+            if slot is not None and slot.req.req_id == req_id:
+                slot.cancel = True
+                return True
+        return False
+
+    # ---- the engine loop ------------------------------------------------
+
+    def step(self) -> int:
+        """One engine iteration: retire (deadline/cancel) → admit → one
+        decode step over every occupied slot. Returns the number of
+        active slots that decoded (0 = idle)."""
+        now = time.monotonic()
+        for req in self.scheduler.expire(now):
+            self._finish(req.req_id, "evicted", "deadline (queued)", [])
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.cancel:
+                self._retire(i, "cancelled", "cancelled running")
+            elif (slot.req.deadline is not None
+                  and slot.req.deadline <= now):
+                self._retire(i, "evicted", "deadline")
+        self._admit_all()
+        n_active = self._n_active
+        if n_active == 0:
+            self.metrics.step_sample(0, self.cfg.max_slots,
+                                     self.scheduler.depth)
+            return 0
+        with annotate("serving/decode_step"):
+            nxt, idxs, self.kv.cache, self._rng = self._decode(
+                self.params, self.kv.cache, self._d_toks, self._d_idxs,
+                self._d_active, self._rng)
+        self._d_toks, self._d_idxs = nxt, idxs
+        if self._defer:
+            self._tok_log[self._step_no] = nxt     # fetched at retire
+            toks = None
+        else:
+            toks = np.asarray(nxt)                 # eos needs the values
+        self._step_no += 1
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.n_out += 1
+            self.metrics.event(slot.req.req_id, "token")
+            if toks is not None:
+                tok = int(toks[i])
+                slot.produced.append(tok)
+                if tok == self.cfg.eos_id:
+                    slot.eos_seen = True
+                    self._retire(i, "done", "eos")
+                    continue
+            if slot.n_out >= slot.req.max_new_tokens:
+                self._retire(i, "done", "length")
+        self.metrics.step_sample(n_active, self.cfg.max_slots,
+                                 self.scheduler.depth)
+        return n_active
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[int,
+                                                           RequestResult]:
+        """Step until queue and slots drain (or ``max_steps``)."""
+        steps = 0
+        while self.scheduler.depth > 0 or any(
+                s is not None for s in self._slots):
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.results
+
+    # ---- admission ------------------------------------------------------
+
+    def _admit_all(self):
+        while self.kv.n_free > 0:
+            batch = self.scheduler.pop(1)
+            if not batch:
+                return
+            self._admit(batch[0])
+
+    def _admit(self, req: Request):
+        cfg = self.cfg
+        slot = self.kv.alloc()
+        assert slot is not None
+        self.metrics.event(req.req_id, "prefill")
+        with annotate("serving/prefill"):
+            idx0 = 0
+            install_lane = self.kv.zeros_lane
+            if req.prefix:
+                if self.kv.has_prefix(req.prefix):
+                    page = self.kv.acquire_prefix(req.prefix, slot)
+                    install_lane, idx0 = page.lane, page.length
+                else:
+                    # first sharer pays: run the prefix's own chunks,
+                    # snapshot the lane as the page, keep going
+                    self._run_chunks(slot, np.asarray(req.prefix,
+                                                      np.int32),
+                                     0, self.kv.zeros_lane)
+                    lane = jax.tree_util.tree_map(
+                        lambda x: x[slot:slot + 1], self.kv.cache)
+                    self.kv.put_prefix(req.prefix, lane,
+                                       len(req.prefix))
+                    self.kv.acquire_prefix(req.prefix, slot)
+                    install_lane, idx0 = None, len(req.prefix)
+            tok0 = self._run_chunks(slot, req.tokens, idx0, install_lane)
+        self.metrics.event(req.req_id, "first_token")
+        idx = idx0 + int(req.tokens.size)
+        st = _Slot(req=req, first_tok=tok0, start_step=self._step_no)
+        self._slots[slot] = st
+        if not self._defer:
+            first = int(np.asarray(tok0))
+            st.produced.append(first)
+            st.first_tok = first
+            if first == cfg.eos_id:
+                st.eos_seen = True
+                self._retire(slot, "done", "eos")
+                return
+        if req.max_new_tokens == 1:
+            # finished at prefill: never occupies a decode step
+            self._retire(slot, "done", "length")
+            return
+        # device-side boundary patch: the slot joins the decode batch
+        self._d_toks = self._d_toks.at[slot].set(
+            jnp.asarray(tok0, jnp.int32))
+        self._d_idxs = self._d_idxs.at[slot].set(idx)
+        self._d_active = self._d_active.at[slot].set(True)
+        st.in_batch = True
+        self._n_active += 1
+
+    def _run_chunks(self, slot: int, tokens: np.ndarray, idx0: int,
+                    install_lane):
+        """Feed ``tokens`` through the prefill executable in fixed-width
+        right-padded chunks starting at cache position ``idx0``.
+        ``install_lane``: batch-1 pytree written over the slot's lane
+        before the FIRST chunk (zeros, or a shared-prefix page); None
+        continues on the lane as-is. Returns the (device) token sampled
+        after the final chunk."""
+        C = self.cfg.prefill_chunk
+        n = int(tokens.size)
+        tok = None
+        for c in range(math.ceil(n / C)):
+            seg = tokens[c * C:(c + 1) * C]
+            buf = np.zeros((1, C), np.int32)
+            buf[0, :seg.size] = seg
+            install = np.bool_(c == 0 and install_lane is not None)
+            lane_arg = (install_lane if install
+                        else self.kv.zeros_lane)
+            tok, self.kv.cache, self._rng = self._prefill(
+                self.params, self.kv.cache, np.int32(slot), lane_arg,
+                install, buf, np.int32(idx0 + c * C),
+                np.int32(seg.size), self._rng)
+        return tok
+
+    # ---- retirement -----------------------------------------------------
+
+    def _materialize(self, st: _Slot, slot_idx: int) -> List[int]:
+        """Collect a deferred-mode slot's tokens from the step log (the
+        only point the engine blocks on decode outputs)."""
+        out = [int(np.asarray(st.first_tok))]
+        for s in range(st.start_step,
+                       st.start_step + max(st.n_out - 1, 0)):
+            buf = self._tok_log[s]
+            if not isinstance(buf, np.ndarray):     # memoize the fetch
+                buf = np.asarray(buf)
+                self._tok_log[s] = buf
+            out.append(int(buf[slot_idx]))
+        return out
+
+    def _prune_log(self):
+        if not self._tok_log:
+            return
+        live = [s.start_step for s in self._slots if s is not None]
+        floor = min(live) if live else self._step_no
+        for s in [s for s in self._tok_log if s < floor]:
+            del self._tok_log[s]
+
+    def _retire(self, slot_idx: int, status: str, reason: str):
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        if self._defer:
+            produced = self._materialize(slot, slot_idx)
+            self._prune_log()
+        else:
+            produced = slot.produced
+        if slot.in_batch:
+            # boundary patch: drop the lane from the decode batch (the
+            # freed lane keeps computing masked garbage — values only)
+            self._d_active = self._d_active.at[slot_idx].set(False)
+            self._n_active -= 1
+        self.kv.free(slot_idx)
+        self._finish(slot.req.req_id, status, reason, produced)
+
+    def _finish(self, req_id: int, status: str, reason: str,
+                produced: List[int]):
+        self.metrics.event(req_id, status, reason=reason,
+                           n_generated=len(produced))
+        self.results[req_id] = RequestResult(
+            req_id=req_id, status=status,
+            tokens=np.asarray(produced, np.int32), reason=reason)
+
+    # ---- introspection --------------------------------------------------
+
+    def pop_result(self, req_id: int) -> Optional[RequestResult]:
+        """Remove and return a finished request's result — the
+        long-running server's pressure valve (`results` is otherwise
+        bounded only by the number of requests ever served; pair with
+        `metrics.drain()`)."""
+        return self.results.pop(req_id, None)
+
+    @property
+    def n_active(self) -> int:
+        return self._n_active
+
+    def slot_view(self) -> List[Optional[int]]:
+        """req_id per slot (None = free) — the occupancy diagram."""
+        return [None if s is None else s.req.req_id for s in self._slots]

@@ -1,0 +1,148 @@
+"""Fixed-slot KV pool + shared-prefix store for the serving engine.
+
+The pool IS the existing cache layout (`models.generate.init_cache`:
+``{"layer{i}": {"k","v": (max_slots, Hkv, max_len, D)}}``) — slot s is
+lane s of every leaf. TPU-first consequence: the pool's shapes never
+change for the life of the engine, so requests joining and leaving
+never retrace anything; all slot traffic is ``dynamic_slice`` /
+``dynamic_update_slice`` on the leading axis inside the engine's two
+jitted executables. This module is the HOST-side bookkeeping around
+that device pytree: which lanes are free, and which shared-prefix
+K/V snapshots exist.
+
+Prefix sharing is at SLOT granularity (not paged): a common system
+prompt's K/V is computed once, snapshotted as a batch-1 lane pytree
+("page"), and INSTALLED (one on-device lane copy inside the prefill
+executable) into each slot that reuses it — the prefix's attention
+FLOPs are paid once per distinct prefix, not once per request. Pages
+are refcounted: a page acquired by a live slot can never be evicted
+(`test_serving::TestPrefixRefcounts::test_refcount_never_frees_live_page`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class PrefixPage:
+    """One shared-prefix K/V snapshot: a batch-1 cache pytree holding
+    ``length`` real positions (the tail beyond ``length`` is write-noise
+    the attention masks — see `cached_attention`'s chunk mode)."""
+
+    lane: Any                    # batch-1 cache pytree (device arrays)
+    length: int                  # real positions held
+    refcount: int = 0            # live slots currently built on it
+    hits: int = 0                # admissions served (the saved prefills)
+
+
+class KVPool:
+    """Slot allocator + prefix-page store over one pooled cache pytree.
+
+    The device pytree itself is handed back and forth with the engine
+    (its jitted calls donate and return it); the pool only tracks lane
+    ownership. ``alloc``/``free`` are O(1) against a free list — the
+    admission policy (who gets the slot) lives in `serving.scheduler`.
+    """
+
+    def __init__(self, make_cache, max_slots: int, max_len: int,
+                 dtype=None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        kw = {} if dtype is None else {"dtype": dtype}
+        self.cache = make_cache(self.max_slots, self.max_len, **kw)
+        # a zeroed batch-1 lane: installed on admission so a fresh
+        # request never attends a retired request's stale K/V through a
+        # masking bug — defense in depth, the horizon mask already
+        # excludes unwritten positions
+        self.zeros_lane = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((1,) + x.shape[1:], x.dtype), self.cache)
+        self._free: List[int] = list(range(self.max_slots))
+        self._slot_prefix: Dict[int, tuple] = {}   # slot -> prefix key
+        self._prefixes: Dict[tuple, PrefixPage] = {}
+
+    # ---- slots ----------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.max_slots
+
+    def alloc(self) -> Optional[int]:
+        """Lowest free slot, or None when the pool is full."""
+        return self._free.pop(0) if self._free else None
+
+    def free(self, slot: int) -> None:
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(f"slot {slot} out of range")
+        key = self._slot_prefix.pop(slot, None)
+        if key is not None:
+            self.release_prefix(key)
+        self._free.append(slot)
+        self._free.sort()
+
+    # ---- prefix pages ---------------------------------------------------
+
+    def has_prefix(self, key: tuple) -> bool:
+        return tuple(key) in self._prefixes
+
+    def put_prefix(self, key: tuple, lane, length: int) -> PrefixPage:
+        """Register a computed prefix snapshot. ``lane`` is a batch-1
+        cache pytree (the engine slices it out of the pool right after
+        the prefix chunks complete)."""
+        key = tuple(key)
+        if key in self._prefixes:
+            raise ValueError(f"prefix {key!r} already registered")
+        page = PrefixPage(lane=lane, length=int(length))
+        self._prefixes[key] = page
+        return page
+
+    def acquire_prefix(self, key: tuple, slot: int) -> PrefixPage:
+        """Refcount++ on behalf of ``slot`` (released by `free`)."""
+        key = tuple(key)
+        page = self._prefixes[key]
+        page.refcount += 1
+        page.hits += 1
+        self._slot_prefix[slot] = key
+        return page
+
+    def release_prefix(self, key: tuple) -> None:
+        page = self._prefixes[tuple(key)]
+        if page.refcount <= 0:
+            raise ValueError(f"prefix {key!r} released below zero")
+        page.refcount -= 1
+
+    def evict_prefix(self, key: tuple, force: bool = False) -> bool:
+        """Drop a prefix page (reclaim its host/device memory). A page
+        with live references is NEVER freed: returns False (or raises
+        with ``force=True`` — force still refuses; it exists so callers
+        who believe the page is dead fail loudly instead of silently
+        keeping it)."""
+        key = tuple(key)
+        page = self._prefixes.get(key)
+        if page is None:
+            return False
+        if page.refcount > 0:
+            if force:
+                raise RuntimeError(
+                    f"prefix {key!r} has {page.refcount} live slot(s) — "
+                    f"refusing to free a live page")
+            return False
+        del self._prefixes[key]
+        return True
+
+    def prefix_stats(self) -> dict:
+        return {repr(k): {"length": p.length, "refcount": p.refcount,
+                          "hits": p.hits}
+                for k, p in self._prefixes.items()}

@@ -1,0 +1,187 @@
+"""Per-request lifecycle metrics for the serving engine.
+
+Every request walks the state machine
+``queued → prefill → decode → {done | evicted | cancelled}`` (or is
+``rejected`` at the door); each transition is an EVENT with a
+monotonic timestamp. Events stream through
+`utils.observability.MetricsLogger` as JSON lines when a logger is
+supplied (the same sink the training loop uses, so one log carries
+both), and always accumulate in memory for `summary()` — the
+offered-load sweep in ``tools/bench_serving.py`` reads tokens/sec,
+p50/p99 time-to-first-token, and mean slot occupancy from it.
+
+Schema (`docs/serving.md` § Engine): every event line is
+``{"event", "req", "t", **fields}``; per-step samples are
+``{"event": "step", "t", "active", "queue_depth", "occupancy"}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from apex1_tpu.utils.observability import MetricsLogger
+
+#: terminal request states
+TERMINAL = ("done", "evicted", "cancelled", "rejected")
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """Lifecycle timestamps + counters for one request."""
+
+    req_id: int
+    n_prompt: int = 0
+    n_generated: int = 0
+    t_queued: Optional[float] = None
+    t_prefill: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_done: Optional[float] = None
+    status: str = "queued"
+    reason: str = ""
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time-to-first-token: submit → first sampled token. With the
+        engine's deferred mode (``eos_id=None``) the first-token event
+        marks the prefill chain's DISPATCH under async dispatch — a
+        lower bound on availability (the value lands with the step
+        chain); with an ``eos_id`` every step blocks on its tokens, so
+        the instant is exact."""
+        if self.t_queued is None or self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_queued
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.t_queued is None or self.t_done is None:
+            return None
+        return self.t_done - self.t_queued
+
+
+class ServingMetrics:
+    """Event sink + aggregator. ``logger`` (a `MetricsLogger`) makes
+    every event a JSON line; omit it for in-memory-only collection
+    (tests, benches that only want `summary()`)."""
+
+    def __init__(self, logger: Optional[MetricsLogger] = None):
+        self.logger = logger
+        self.records: Dict[int, RequestRecord] = {}
+        # step samples fold into RUNNING aggregates (count / occupancy
+        # sum / peak queue) — a long-lived engine steps indefinitely,
+        # so per-step dicts would leak host memory (review finding);
+        # per-request records are bounded by `drain()` below
+        self._step_n = 0
+        self._occ_sum = 0.0
+        self._peak_queue = 0
+        self._event_seq = 0
+        self._t0 = time.monotonic()
+        # submit (and its queued/rejected events) may run on an ingest
+        # thread (`runtime.RequestFeeder`) while the engine loop logs
+        # token/terminal events — same cross-thread pattern the
+        # Scheduler locks for; unlocked counters would lose updates
+        self._lock = threading.Lock()
+
+    # ---- events ---------------------------------------------------------
+
+    def event(self, req_id: int, name: str, now: Optional[float] = None,
+              **fields) -> RequestRecord:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._event_locked(req_id, name, now, fields)
+
+    def _event_locked(self, req_id: int, name: str, now: float,
+                      fields: dict) -> RequestRecord:
+        rec = self.records.setdefault(req_id, RequestRecord(req_id))
+        if name == "queued":
+            # also on RE-queue: a retried submission (stable req_id
+            # after a transient rejection) returns to the queued state
+            rec.status = "queued"
+            rec.t_queued = now
+            rec.n_prompt = int(fields.get("n_prompt", 0))
+        elif name == "prefill":
+            rec.status = "prefill"
+            rec.t_prefill = now
+        elif name == "first_token":
+            rec.status = "decode"
+            rec.t_first_token = now
+            rec.n_generated = 1
+        elif name == "token":
+            rec.n_generated += int(fields.get("n", 1))
+        elif name in TERMINAL:
+            rec.status = name
+            rec.t_done = now
+            rec.reason = str(fields.get("reason", ""))
+            rec.n_generated = int(fields.get("n_generated",
+                                             rec.n_generated))
+        else:
+            raise ValueError(f"unknown lifecycle event {name!r}")
+        if self.logger is not None and name != "token":
+            # per-token lines would dominate the log; counts ride the
+            # terminal event instead
+            self._event_seq += 1
+            self.logger.log(self._event_seq,
+                            {"event": name, "req": int(req_id),
+                             "t": now - self._t0, **{
+                                 k: v for k, v in fields.items()}})
+        return rec
+
+    def step_sample(self, active: int, max_slots: int,
+                    queue_depth: int) -> None:
+        """One engine-step occupancy sample (drives mean occupancy and
+        peak queue depth — folded into running aggregates, O(1) space
+        for the life of the engine)."""
+        with self._lock:
+            self._step_n += 1
+            self._occ_sum += active / max_slots
+            if queue_depth > self._peak_queue:
+                self._peak_queue = queue_depth
+
+    def drain(self) -> Dict[int, RequestRecord]:
+        """Remove and return all TERMINAL request records — the
+        long-running server's pressure valve (ship them to a sink, let
+        the dict stay bounded by in-flight work); pair with
+        `Engine.pop_result`. The occupancy/step aggregates and the
+        wall clock in `summary()` are LIFETIME values and do not reset
+        — for a fresh measurement window, swap in a new
+        `ServingMetrics` (what `tools/bench_serving.py` does between
+        reps)."""
+        with self._lock:
+            gone = {k: r for k, r in self.records.items()
+                    if r.status in TERMINAL}
+            for k in gone:
+                del self.records[k]
+            return gone
+
+    # ---- aggregates -----------------------------------------------------
+
+    def summary(self) -> dict:
+        """Aggregate view: counts per terminal status, throughput over
+        the engine's wall clock, TTFT percentiles, occupancy."""
+        with self._lock:
+            recs = list(self.records.values())
+        done = [r for r in recs if r.status == "done"]
+        ttfts = sorted(r.ttft for r in recs if r.ttft is not None)
+        gen = sum(r.n_generated for r in recs)
+        wall = max(time.monotonic() - self._t0, 1e-9)
+        out = {
+            "requests": len(recs),
+            "done": len(done),
+            "evicted": sum(r.status == "evicted" for r in recs),
+            "cancelled": sum(r.status == "cancelled" for r in recs),
+            "rejected": sum(r.status == "rejected" for r in recs),
+            "generated_tokens": int(gen),
+            "tokens_per_sec": gen / wall,
+            "steps": self._step_n,
+        }
+        if ttfts:
+            out["ttft_p50_ms"] = 1e3 * float(np.percentile(ttfts, 50))
+            out["ttft_p99_ms"] = 1e3 * float(np.percentile(ttfts, 99))
+        if self._step_n:
+            out["mean_occupancy"] = self._occ_sum / self._step_n
+            out["peak_queue_depth"] = self._peak_queue
+        return out

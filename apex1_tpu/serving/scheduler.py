@@ -1,0 +1,168 @@
+"""Admission queue for the serving engine: bounded backpressure, FIFO /
+shortest-prompt-first policies, per-request deadlines, cancellation.
+
+The scheduler is pure host-side bookkeeping — it decides WHICH request
+enters a freed KV slot; the engine decides WHEN (whenever a slot is
+free at a step boundary). Policies:
+
+- ``fifo`` — arrival order. Predictable TTFT ordering; long prompts at
+  the head delay everyone (head-of-line blocking).
+- ``sjf`` — shortest prompt first. Minimizes mean TTFT under mixed
+  lengths (a short prompt's prefill is cheap, so serving it first costs
+  the long one little); starvation is bounded by the queue's deadline
+  mechanism, not by the policy.
+
+Backpressure is a bounded queue: `submit` on a full queue raises
+`Backpressure` carrying a machine-readable reason — the caller (an RPC
+frontend, `runtime.RequestFeeder`) turns that into a 429/retry. A
+silent unbounded queue would instead convert overload into unbounded
+TTFT, the failure mode continuous batching exists to avoid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+POLICIES = ("fifo", "sjf")
+
+_ids = itertools.count()
+
+
+def new_request_id() -> int:
+    """Reserve a request id up front — for callers that may SUBMIT the
+    same logical request several times (`runtime.RequestFeeder`'s
+    bounded backpressure retry): a stable id keeps metrics at one
+    record per request instead of one per attempt."""
+    return next(_ids)
+
+
+class Backpressure(Exception):
+    """Admission rejected; ``reason`` says why (machine-readable)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``tokens``: prompt ids (1-D). ``prefix``: optional shared-prefix ids
+    (e.g. a system prompt) — requests with an identical prefix tuple
+    share its K/V through the pool's prefix pages. ``deadline``:
+    absolute `time.monotonic()` instant; past it the request is evicted
+    wherever it is (queued or mid-decode) and its slot freed.
+    """
+
+    tokens: np.ndarray
+    max_new_tokens: int
+    prefix: Optional[Tuple[int, ...]] = None
+    deadline: Optional[float] = None
+    req_id: Optional[int] = None
+    submitted_at: float = 0.0
+
+    def __post_init__(self):
+        self.tokens = np.asarray(self.tokens, np.int32).reshape(-1)
+        if self.tokens.size < 1:
+            raise ValueError("empty prompt (after the shared prefix, a "
+                             "request needs >= 1 token of its own)")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.prefix is not None:
+            self.prefix = tuple(int(t) for t in self.prefix)
+        if self.req_id is None:
+            self.req_id = next(_ids)
+
+    @property
+    def total_len(self) -> int:
+        """Cache positions the request needs: prefix + prompt +
+        generated (the final sampled token is never written back)."""
+        plen = len(self.prefix) if self.prefix else 0
+        return plen + self.tokens.size + self.max_new_tokens - 1
+
+
+class Scheduler:
+    """Bounded admission queue with pluggable dequeue policy."""
+
+    def __init__(self, max_queue: int = 64, policy: str = "fifo"):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self.policy = policy
+        self._queue: List[Request] = []
+        # submit may run on an ingest thread (`runtime.RequestFeeder`)
+        # while the engine loop pops — one lock keeps the bound exact
+        self._lock = threading.Lock()
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req: Request, now: Optional[float] = None) -> int:
+        """Enqueue or raise `Backpressure`. Returns the request id."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise Backpressure(
+                    f"queue full ({self.max_queue}); retry later")
+            if req.deadline is not None and req.deadline <= now:
+                raise Backpressure("deadline already passed at submit")
+            req.submitted_at = now
+            self._queue.append(req)
+            return req.req_id
+
+    def cancel(self, req_id: int) -> bool:
+        """Remove a QUEUED request. Returns False if not queued (it may
+        already be running — the engine owns cancellation there)."""
+        with self._lock:
+            for i, r in enumerate(self._queue):
+                if r.req_id == req_id:
+                    del self._queue[i]
+                    return True
+            return False
+
+    def expire(self, now: Optional[float] = None) -> List[Request]:
+        """Drop and return queued requests whose deadline has passed."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            dead = [r for r in self._queue
+                    if r.deadline is not None and r.deadline <= now]
+            if dead:
+                gone = {r.req_id for r in dead}
+                self._queue = [r for r in self._queue
+                               if r.req_id not in gone]
+            return dead
+
+    def pop(self, n: int) -> List[Request]:
+        """Up to ``n`` requests to admit, per policy. Deadline expiry is
+        the ENGINE's job (call `expire` first) so evictions are observed
+        in one place."""
+        with self._lock:
+            if n <= 0 or not self._queue:
+                return []
+            if self.policy == "sjf":
+                order = sorted(
+                    range(len(self._queue)),
+                    key=lambda i: (self._queue[i].tokens.size, i))
+                take = order[:n]
+                out = [self._queue[i] for i in take]  # shortest first
+                taken = set(take)
+                self._queue = [r for i, r in enumerate(self._queue)
+                               if i not in taken]
+                return out
+            out, self._queue = self._queue[:n], self._queue[n:]
+            return out
+
+    def snapshot(self) -> Sequence[int]:
+        return [r.req_id for r in self._queue]

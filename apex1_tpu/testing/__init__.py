@@ -72,20 +72,25 @@ def child_cache_env(default_dir: str | None = None) -> dict:
     """Env-var form of :func:`enable_persistent_compilation_cache` for
     CHILD processes a test harness spawns (example smokes, multiproc
     clusters): same ``APEX1_JAX_CACHE_DIR`` resolution — empty disables —
-    and an already-exported ``JAX_COMPILATION_CACHE_DIR`` wins, so an
-    operator pointing everything at a shared cache is not silently
-    split. Merge the returned dict into the child env."""
+    and an already-exported ``JAX_COMPILATION_CACHE_DIR`` wins (exported
+    EMPTY counts: that is the operator disabling the cache), so an
+    operator pointing everything at a shared cache — or at none — is not
+    silently overridden. Merge the returned dict into the child env."""
     # always lower the min-compile-time to catch the sub-second tiny-model
     # compiles these harnesses are made of (JAX's default 1.0s skips them),
     # unless the operator pinned their own threshold
     out = {}
     if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
         out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return out  # dir inherited via dict(os.environ) in the launcher
+    if "JAX_COMPILATION_CACHE_DIR" in os.environ:
+        # presence (not truthiness): an exported-but-EMPTY dir is the
+        # operator disabling the cache, mirroring APEX1_JAX_CACHE_DIR= —
+        # re-enabling the repo default here would silently override them.
+        # Dir (or the disable) inherited via dict(os.environ) launchers.
+        return out
     cache = _resolve_cache_dir(default_dir)
     if not cache:
-        return {}
+        return out  # cache disabled, but keep the min-compile override
     out["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(cache)
     return out
 

@@ -11,11 +11,17 @@ are static under XLA.
 
 PAIRING CONTRACT (differs from NCCL two-sided semantics — review r5): the
 ONE ring permute in ``send_forward`` both sends and delivers, so after
-``y = send_forward(x)`` every stage already holds its received value —
-``recv_forward`` is therefore an IDENTITY shim, kept so reference-style
-paired call sites (``send_forward(out); x = recv_forward(out)``) port
-without double-shifting the ring. The fused names make the actual dataflow
-explicit; prefer them in new code.
+``y = send_forward(x)`` the RETURN VALUE ``y`` is the received activation —
+``recv_forward`` is an IDENTITY shim. The only supported paired form is
+therefore the CHAINED one::
+
+    x = recv_forward(send_forward(out))   # == send_forward(out)
+
+A reference-style statement pair ``send_forward(out); x = recv_forward(out)``
+is a SILENT NO-OP: it binds ``x`` to the unshifted local ``out`` while
+``send_forward``'s returned permute is discarded dead code (XLA DCE's it —
+no communication happens at all). Port such call sites to the chained form,
+or better, to the fused names, which make the actual dataflow explicit.
 
 Ring wraparound: stage 0's "received" value after ``send_forward`` is stage
 P-1's output (a ring has no edge). The reference's ``recv_forward`` returns
@@ -59,14 +65,18 @@ send_backward = send_backward_recv_backward
 
 
 def recv_forward(x, *, axis_name: str = AXIS_PP):
-    """Identity shim: after ``send_forward`` the received activation is
-    already resident (see PAIRING CONTRACT in the module docstring)."""
+    """Identity shim: pass it ``send_forward``'s RETURN VALUE
+    (``x = recv_forward(send_forward(out))``). Called standalone on a
+    local value it is a no-op that silently drops the communication —
+    see PAIRING CONTRACT in the module docstring."""
     del axis_name
     return x
 
 
 def recv_backward(g, *, axis_name: str = AXIS_PP):
-    """Identity shim: after ``send_backward`` the received gradient is
-    already resident (see PAIRING CONTRACT in the module docstring)."""
+    """Identity shim: pass it ``send_backward``'s RETURN VALUE
+    (``g = recv_backward(send_backward(out))``). Called standalone on a
+    local value it is a no-op that silently drops the communication —
+    see PAIRING CONTRACT in the module docstring."""
     del axis_name
     return g

@@ -479,6 +479,11 @@ def _last_banked(config, results_dir=None):
                         best = cand
         except OSError:
             continue
+    if best is not None:
+        # the record states its own selection rule: it is the BEST value
+        # across every banked log for the config (any shape), not the
+        # most recent run at the standard shape (ADVICE r5)
+        best["selection"] = "max across queue logs"
     return best
 
 
@@ -516,7 +521,7 @@ def main():
         except Exception:
             prior = None
         if prior is not None:
-            fallback["last_measured"] = prior
+            fallback["best_banked"] = prior
         _emit(fallback)
         return
 

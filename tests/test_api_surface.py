@@ -81,7 +81,11 @@ SURFACE = {
         "master_params_to_model_params", "prep_param_lists"],
     "apex1_tpu.runtime": [
         "PrefetchLoader", "TokenDataset", "pack_documents",
-        "write_token_file", "flatten", "unflatten"],
+        "write_token_file", "flatten", "unflatten", "RequestFeeder"],
+    "apex1_tpu.serving": [
+        "Engine", "EngineConfig", "RequestResult", "Scheduler",
+        "Request", "Backpressure", "KVPool", "PrefixPage",
+        "ServingMetrics", "RequestRecord"],
     "apex1_tpu.core.mesh": [
         "make_mesh", "make_hybrid_mesh", "MeshConfig", "MeshResource",
         "shard_batch", "replicate"],

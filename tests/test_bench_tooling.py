@@ -2,7 +2,7 @@
 
 Two suites: bench.py's unreachable-backend fallback (the JSON line
 must always emit and, when banked on-silicon records exist in
-perf_results/, carry a `last_measured` pointer — bench.py::_last_banked,
+perf_results/, carry a `best_banked` pointer — bench.py::_last_banked,
 pinned against synthetic queue logs including the malformed lines a
 tunnel death can leave behind), and tools/measured_vs_predicted.py's
 roofline-scoring join (its rows feed BASELINE.md and the judge's perf
@@ -49,6 +49,9 @@ class TestLastBanked:
         rec = bench_mod._last_banked("gpt2", res)
         assert rec["value"] == 200.0
         assert rec["source_log"].endswith("bench_gpt2_b24.log")
+        # the record names its own selection rule (the key is
+        # `best_banked`, NOT "most recent at the standard shape")
+        assert rec["selection"] == "max across queue logs"
 
     def test_requires_tpu_backend_tag(self, bench_mod, tmp_path):
         res = _results(tmp_path, {

@@ -133,6 +133,15 @@ class TestASP:
 # every example script, grouped so each child process (one cold JAX
 # import + backend init, ~10-12s) amortizes over several scripts —
 # 9 solo children cost ~1.5 min of pure startup on the single-core box
+#
+# SHARED-BACKEND CONSTRAINT: a group is ONE process, so JAX's backend
+# (platform + virtual device count from XLA_FLAGS) is pinned by
+# whichever script initializes it first — every script grouped together
+# must expect the same platform/device-count (all current examples use
+# the default cpu x 8). A future example needing a different count must
+# go in its OWN group (or the runner must assert jax.device_count()
+# per script) — grouped after an 8-device script it would silently run
+# under a stale mesh (ADVICE r5).
 _EXAMPLE_GROUPS = {
     "data_parallel": [
         ("examples/distributed_data_parallel.py", []),

@@ -1,0 +1,368 @@
+"""`apex1_tpu.serving` tests — the continuous-batching engine must be
+INVISIBLE in the tokens: requests joining and leaving mid-flight
+produce output token-identical to a solo `models.generate` run of each
+request, with exactly TWO traced executables for the whole workload
+(the compilation-count hook `Engine.trace_counts`). Plus the control
+plane: backpressure rejection, deadline eviction freeing the slot,
+cancellation, prefix-page refcounts never freeing a live page, and the
+scheduler/pool/feeder units."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.core.policy import get_policy
+from apex1_tpu.models.generate import generate, gpt2_decoder
+from apex1_tpu.models.gpt2 import GPT2, GPT2Config
+from apex1_tpu.runtime import RequestFeeder
+from apex1_tpu.serving import (Backpressure, Engine, EngineConfig, KVPool,
+                               Request, Scheduler)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """Tiny fp32 GPT-2 + its decoder pair + a solo-generate oracle."""
+    cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(11)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 7)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    apply_fn, make_cache = gpt2_decoder(model)
+
+    def solo(tokens, n_new):
+        cache = make_cache(1, len(tokens) + n_new)
+        return np.asarray(generate(
+            apply_fn, params, jnp.asarray([tokens], jnp.int32),
+            max_new_tokens=n_new, cache=cache,
+            vocab_size=cfg.vocab_size))[0]
+
+    return cfg, params, apply_fn, make_cache, solo
+
+
+def _engine(tiny, **kw):
+    cfg, params, apply_fn, make_cache, _ = tiny
+    ekw = dict(max_slots=3, max_len=48, prefill_chunk=4,
+               vocab_size=cfg.vocab_size)
+    ekw.update(kw)
+    return Engine(apply_fn, make_cache, params, EngineConfig(**ekw))
+
+
+class TestContinuousBatching:
+    def test_staggered_join_leave_token_identical_two_executables(
+            self, tiny, rng):
+        """The acceptance workload: more requests than slots, mixed
+        prompt lengths (crossing chunk boundaries), mixed output
+        lengths, arrivals staggered across live decode steps — every
+        completed request must match its solo `generate` run and the
+        engine must have traced exactly its two executables."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny)
+        lens = [3, 7, 5, 9, 4, 6]          # 3,5 < chunk=4 <= 5,7,9
+        news = [6, 5, 7, 4, 6, 5]
+        prompts = [rng.integers(0, cfg.vocab_size, (L,)).tolist()
+                   for L in lens]
+        ids = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(prompts[:3], news[:3])]
+        eng.step()                          # 3 in flight
+        ids.append(eng.submit(prompts[3], max_new_tokens=news[3]))
+        eng.step()                          # joins as slots free
+        ids += [eng.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts[4:], news[4:])]
+        eng.run(max_steps=200)
+        for p, n, rid in zip(prompts, news, ids):
+            res = eng.results[rid]
+            assert res.status == "done"
+            np.testing.assert_array_equal(res.tokens, solo(p, n))
+        # the compilation-count hook: requests of 6 shapes joined and
+        # left; the engine must not have retraced for any of it
+        assert eng.trace_counts == {"prefill": 1, "decode": 1}
+        # with 6 requests over 3 slots, slots were genuinely reused
+        assert eng.metrics.summary()["done"] == 6
+
+    def test_eos_early_stop_matches_solo_truncation(self, tiny, rng):
+        cfg, _, _, _, solo = tiny
+        prompt = rng.integers(0, cfg.vocab_size, (6,)).tolist()
+        full = solo(prompt, 8)
+        eos = int(full[3])                  # an id greedy decoding emits
+        eng = _engine(tiny, eos_id=eos)
+        rid = eng.submit(prompt, max_new_tokens=8)
+        eng.run(max_steps=50)
+        res = eng.results[rid]
+        assert res.status == "done" and res.reason == "eos"
+        want = full[:list(full).index(eos) + 1]
+        np.testing.assert_array_equal(res.tokens, want)
+
+    def test_prefix_sharing_token_identical_and_counted(self, tiny, rng):
+        """Sharers of a system prompt must decode exactly as if the
+        full (prefix + own) prompt had been prefilled solo, while the
+        prefix's K/V is computed once (page hits prove the reuse)."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (9,)).tolist())
+        owns = [rng.integers(0, cfg.vocab_size, (L,)).tolist()
+                for L in (4, 6, 3)]
+        ids = [eng.submit(o, max_new_tokens=5, prefix=sysp) for o in owns]
+        eng.run(max_steps=100)
+        for o, rid in zip(owns, ids):
+            np.testing.assert_array_equal(eng.results[rid].tokens,
+                                          solo(list(sysp) + o, 5))
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["hits"] == 3 and stats["refcount"] == 0
+        assert eng.trace_counts == {"prefill": 1, "decode": 1}
+
+    def test_backpressure_rejection_with_reason(self, tiny, rng):
+        cfg = tiny[0]
+        eng = _engine(tiny, max_slots=1, max_queue=2)
+        p = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        eng.submit(p, max_new_tokens=4)
+        eng.submit(p, max_new_tokens=4)
+        with pytest.raises(Backpressure, match="queue full"):
+            eng.submit(p, max_new_tokens=4)
+        assert eng.metrics.summary()["rejected"] == 1
+        eng.run(max_steps=50)               # the accepted two still finish
+        assert eng.metrics.summary()["done"] == 2
+
+    def test_oversized_request_is_contract_error_not_backpressure(
+            self, tiny):
+        eng = _engine(tiny, max_len=16)
+        with pytest.raises(ValueError, match="cache positions"):
+            eng.submit(list(range(10)), max_new_tokens=10)
+
+    def test_deadline_eviction_frees_slot_for_next_request(self, tiny,
+                                                           rng):
+        """A request whose deadline passes mid-decode is evicted with
+        its partial output, and the freed slot serves the next request
+        to completion."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=1)
+        p1 = rng.integers(0, cfg.vocab_size, (5,)).tolist()
+        p2 = rng.integers(0, cfg.vocab_size, (6,)).tolist()
+        r1 = eng.submit(p1, max_new_tokens=30,
+                        deadline=time.monotonic() + 0.05)
+        eng.step()                          # admitted, decoding
+        assert eng.n_active == 1
+        time.sleep(0.06)                    # let the deadline lapse
+        eng.step()                          # eviction observed here
+        res1 = eng.results[r1]
+        assert res1.status == "evicted" and "deadline" in res1.reason
+        assert 0 < res1.tokens.size < 30    # partial output survives
+        assert eng.n_active == 0 and eng.kv.n_free == 1
+        r2 = eng.submit(p2, max_new_tokens=5)
+        eng.run(max_steps=50)
+        assert eng.results[r2].status == "done"
+        np.testing.assert_array_equal(eng.results[r2].tokens, solo(p2, 5))
+
+    def test_cancel_queued_and_running(self, tiny, rng):
+        cfg = tiny[0]
+        eng = _engine(tiny, max_slots=1)
+        p = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        r1 = eng.submit(p, max_new_tokens=20)
+        r2 = eng.submit(p, max_new_tokens=4)
+        eng.step()                          # r1 running, r2 queued
+        assert eng.cancel(r2)               # queued: removed outright
+        assert eng.cancel(r1)               # running: retires next step
+        eng.step()
+        assert eng.results[r2].status == "cancelled"
+        assert eng.results[r1].status == "cancelled"
+        assert eng.results[r1].tokens.size > 0
+        assert eng.kv.n_free == 1
+        assert not eng.cancel(r1)           # already terminal
+
+    def test_tail_chunk_pad_never_clamps_past_max_len(self, tiny, rng):
+        """A request whose FINAL right-padded prefill chunk extends past
+        max_len must still decode token-identically: without the pool's
+        prefill_chunk-1 slack, dynamic_update_slice would clamp the
+        chunk's start and silently shift its K/V onto earlier positions
+        (review finding)."""
+        cfg, _, _, _, solo = tiny
+        # max_len=16, chunk=8, 1-token prefix: own chunks start at 1
+        # and 9, so the padded second chunk writes [9, 17) — one past
+        # max_len. total_len = 1+13+3-1 = 16 <= 16 is admissible, so
+        # only the pool's slack keeps the write from being clamped
+        eng = _engine(tiny, max_slots=1, max_len=16, prefill_chunk=8)
+        # the invariant that prevents the clamp: the pool allocates
+        # prefill_chunk-1 positions past the usable max_len, so every
+        # padded chunk write [start, start+chunk) fits
+        s_max = jax.tree_util.tree_leaves(eng.kv.cache)[0].shape[2]
+        assert s_max == 16 + 8 - 1
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (1,)).tolist())
+        p = rng.integers(0, cfg.vocab_size, (13,)).tolist()
+        rid = eng.submit(p, max_new_tokens=3, prefix=sysp)
+        eng.run(max_steps=30)
+        res = eng.results[rid]
+        assert res.status == "done"
+        np.testing.assert_array_equal(res.tokens,
+                                      solo(list(sysp) + p, 3))
+
+    def test_rejection_reason_reflects_cause(self, tiny, rng):
+        """The rejected metrics event must carry the scheduler's actual
+        reason, not a hardcoded 'queue full' (review finding)."""
+        cfg = tiny[0]
+        eng = _engine(tiny)
+        p = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        with pytest.raises(Backpressure):
+            eng.submit(p, max_new_tokens=4,
+                       deadline=time.monotonic() - 1.0)
+        (rec,) = eng.metrics.records.values()
+        assert rec.status == "rejected"
+        assert "deadline" in rec.reason
+
+    def test_metrics_lifecycle_and_ttft(self, tiny, rng):
+        cfg = tiny[0]
+        eng = _engine(tiny)
+        p = rng.integers(0, cfg.vocab_size, (5,)).tolist()
+        rid = eng.submit(p, max_new_tokens=4)
+        eng.run(max_steps=50)
+        rec = eng.metrics.records[rid]
+        assert rec.status == "done"
+        assert (rec.t_queued <= rec.t_prefill <= rec.t_first_token
+                <= rec.t_done)
+        assert rec.ttft is not None and rec.ttft >= 0
+        s = eng.metrics.summary()
+        assert s["generated_tokens"] == 4
+        assert 0 < s["mean_occupancy"] <= 1
+        assert "ttft_p50_ms" in s and "ttft_p99_ms" in s
+
+
+class TestPrefixRefcounts:
+    def test_refcount_never_frees_live_page(self, tiny, rng):
+        cfg = tiny[0]
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (6,)).tolist())
+        own = rng.integers(0, cfg.vocab_size, (3,)).tolist()
+        eng.submit(own, max_new_tokens=10, prefix=sysp)
+        eng.submit(own, max_new_tokens=10, prefix=sysp)
+        eng.step()                          # both admitted, page live
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 2
+        assert eng.kv.evict_prefix(sysp) is False      # refused
+        with pytest.raises(RuntimeError, match="live page"):
+            eng.kv.evict_prefix(sysp, force=True)      # loud, still no
+        assert eng.kv.has_prefix(sysp)
+        eng.run(max_steps=100)              # both retire -> refcount 0
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 0
+        assert eng.kv.evict_prefix(sysp) is True
+        assert not eng.kv.has_prefix(sysp)
+
+
+class TestScheduler:
+    def _req(self, n, **kw):
+        return Request(tokens=np.arange(1, n + 1), max_new_tokens=4, **kw)
+
+    def test_fifo_order(self):
+        s = Scheduler(max_queue=8)
+        ids = [s.submit(self._req(n)) for n in (5, 2, 9)]
+        assert [r.req_id for r in s.pop(3)] == ids
+
+    def test_sjf_prefers_short_prompts(self):
+        s = Scheduler(max_queue=8, policy="sjf")
+        long = s.submit(self._req(9))
+        short = s.submit(self._req(2))
+        mid = s.submit(self._req(5))
+        assert [r.req_id for r in s.pop(2)] == [short, mid]
+        assert [r.req_id for r in s.pop(2)] == [long]
+
+    def test_bound_and_reasons(self):
+        s = Scheduler(max_queue=1)
+        s.submit(self._req(3))
+        with pytest.raises(Backpressure) as ei:
+            s.submit(self._req(3))
+        assert "queue full" in ei.value.reason
+        s2 = Scheduler(max_queue=4)
+        with pytest.raises(Backpressure, match="deadline"):
+            s2.submit(self._req(3, deadline=time.monotonic() - 1))
+
+    def test_cancel_and_expire(self):
+        s = Scheduler(max_queue=8)
+        a = s.submit(self._req(3))
+        b = s.submit(self._req(3, deadline=time.monotonic() + 100))
+        assert s.cancel(a) and not s.cancel(a)
+        assert s.expire(now=time.monotonic() + 200)[0].req_id == b
+        assert s.depth == 0
+
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match="empty prompt"):
+            Request(tokens=[], max_new_tokens=4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request(tokens=[1], max_new_tokens=0)
+        with pytest.raises(ValueError, match="policy"):
+            Scheduler(policy="lifo")
+
+
+class TestKVPool:
+    def test_alloc_free_cycle(self, tiny):
+        _, _, _, make_cache, _ = tiny
+        pool = KVPool(make_cache, max_slots=2, max_len=8)
+        a, b = pool.alloc(), pool.alloc()
+        assert (a, b) == (0, 1) and pool.alloc() is None
+        assert pool.occupancy == 1.0
+        pool.free(a)
+        assert pool.n_free == 1 and pool.alloc() == 0
+        with pytest.raises(ValueError, match="double-freed"):
+            pool.free(b) or pool.free(b)
+
+    def test_duplicate_prefix_registration_rejected(self, tiny):
+        _, _, _, make_cache, _ = tiny
+        pool = KVPool(make_cache, max_slots=1, max_len=8)
+        pool.put_prefix((1, 2), pool.zeros_lane, 2)
+        with pytest.raises(ValueError, match="already registered"):
+            pool.put_prefix((1, 2), pool.zeros_lane, 2)
+
+
+class TestRequestFeeder:
+    def test_feeder_drives_engine_through_backpressure(self, tiny, rng):
+        """Ingest thread tokenizes + submits under a deliberately tiny
+        queue; the engine loop drains it; nothing is lost."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2, max_queue=2)
+        prompts = [rng.integers(0, cfg.vocab_size, (3 + i % 4,)).tolist()
+                   for i in range(7)]
+
+        def tokenize(text):
+            return text, {"max_new_tokens": 4}
+
+        feeder = RequestFeeder(prompts, tokenize, eng.submit,
+                               retries=1000, retry_wait_s=0.001).start()
+        deadline = time.monotonic() + 30.0
+        while ((not feeder.idle or eng.scheduler.depth or eng.n_active)
+               and time.monotonic() < deadline):
+            eng.step()
+        feeder.join(timeout=10.0)
+        assert not feeder.dropped
+        assert len(feeder.submitted) == 7
+        # retries reuse one req_id per item: no phantom per-attempt
+        # rejected records, despite the deliberately tiny queue
+        assert len(eng.metrics.records) == 7
+        assert eng.metrics.summary()["rejected"] == 0
+        for p, rid in zip(prompts, feeder.submitted):
+            np.testing.assert_array_equal(eng.results[rid].tokens,
+                                          solo(p, 4))
+        assert eng.trace_counts == {"prefill": 1, "decode": 1}
+
+    def test_per_item_error_drops_item_and_feed_continues(self, tiny,
+                                                          rng):
+        """One malformed request (submit's contract ValueError) must
+        land in `dropped` while the rest of the stream is still served
+        — not silently abort the feed (review finding)."""
+        cfg = tiny[0]
+        eng = _engine(tiny, max_len=32)
+        good = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        work = [good, list(range(40)), good]   # middle one can't fit
+        feeder = RequestFeeder(
+            work, lambda t: (t, {"max_new_tokens": 4}),
+            eng.submit).start()
+        deadline = time.monotonic() + 30.0
+        while ((not feeder.idle or eng.scheduler.depth or eng.n_active)
+               and time.monotonic() < deadline):
+            eng.step()
+        assert len(feeder.submitted) == 2      # both good ones served
+        assert len(feeder.dropped) == 1
+        assert "cache positions" in feeder.dropped[0][1]
+        with pytest.raises(ValueError, match="cache positions"):
+            feeder.join()                      # error still surfaced
+        assert all(eng.results[r].status == "done"
+                   for r in feeder.submitted)

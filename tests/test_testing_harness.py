@@ -37,3 +37,33 @@ def test_standalone_models_train_one_step(devices):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         assert np.isfinite(float(loss))
         assert all(np.all(np.isfinite(g)) for g in jax.tree.leaves(grads))
+
+
+class TestChildCacheEnv:
+    """`testing.child_cache_env` must honor the OPERATOR's exported
+    `JAX_COMPILATION_CACHE_DIR` by presence, not truthiness (exported
+    EMPTY = deliberately disabled), and always carry the min-compile
+    override (ADVICE r5)."""
+
+    def test_exported_empty_dir_is_not_reenabled(self, monkeypatch):
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "")
+        monkeypatch.delenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           raising=False)
+        out = testing.child_cache_env()
+        assert "JAX_COMPILATION_CACHE_DIR" not in out  # inherit the disable
+        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.5"
+
+    def test_disabled_path_still_lowers_min_compile_time(self, monkeypatch):
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        monkeypatch.delenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           raising=False)
+        monkeypatch.setenv("APEX1_JAX_CACHE_DIR", "")  # disable convention
+        out = testing.child_cache_env()
+        assert "JAX_COMPILATION_CACHE_DIR" not in out
+        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.5"
+
+    def test_exported_dir_wins_and_is_inherited(self, monkeypatch):
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/op_cache")
+        out = testing.child_cache_env()
+        # dir reaches the child via dict(os.environ); no duplicate key
+        assert "JAX_COMPILATION_CACHE_DIR" not in out

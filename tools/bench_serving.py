@@ -1,0 +1,206 @@
+"""Offered-load benchmark for `apex1_tpu.serving.Engine` — the
+continuous-batching headline: tokens/sec, p50/p99 time-to-first-token,
+and slot occupancy across an offered-load sweep, against the SERIAL
+baseline (each request through its own jitted `models.generate` call,
+one after another — the repo's status quo before the engine).
+
+Emits ONE JSON line (bench.py's `_emit` convention) with the peak
+sweep point as the headline ``value`` plus the per-load rows, e.g.::
+
+  {"metric": "serving tokens/sec gpt2-serving [cpu]", "value": ...,
+   "unit": "tokens/sec", "vs_serial": 2.7, "sweep": [...]}
+
+``vs_serial`` >= 2.0 at 8 concurrent staggered requests is the
+acceptance line (CPU proxy): decode is weight-streaming-bound, so the
+pooled step serves 8 rows for nearly the price of 1 — continuous
+batching converts that into throughput the serial loop leaves idle.
+
+Usage::
+
+  python tools/bench_serving.py                  # full sweep (1,2,4,8)
+  python tools/bench_serving.py --smoke          # CPU-gate smoke (~1 min)
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    # decode is weight-streaming-bound; the model must be big enough
+    # that streaming its weights (not per-step dispatch) dominates, or
+    # the CPU proxy under-reports the batching win (hidden 256 measured
+    # 1.4x where hidden 512 measures ~2.9x steady-state)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--new", type=int, default=32,
+                    help="tokens generated per request")
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--loads", type=int, nargs="*", default=[1, 2, 4, 8],
+                    help="concurrency sweep points (engine slots)")
+    ap.add_argument("--requests-per-slot", type=int, default=3,
+                    help="offered load: requests = this x slots, so the "
+                         "pool stays saturated past the arrival ramp "
+                         "(concurrency is still bounded by the slots)")
+    ap.add_argument("--stagger", type=int, default=2,
+                    help="engine steps between arrivals")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + [1, 4] sweep for the CPU gate "
+                         "(correctness/plumbing only: a dispatch-"
+                         "dominated tiny model can't show the batching "
+                         "win — the ratio is the full sweep's job)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.hidden, args.layers, args.vocab = 128, 2, 256
+        args.new, args.loads = 16, [1, 4]
+
+    # examples/tools convention: the env var must beat the container's
+    # sitecustomize platform pin; default to CPU for a proxy-able bench
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                   honor_jax_platforms_env)
+    honor_jax_platforms_env()
+    enable_persistent_compilation_cache()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.generate import generate, gpt2_decoder
+    from apex1_tpu.models.gpt2 import GPT2, GPT2Config
+    from apex1_tpu.serving import Engine, EngineConfig, ServingMetrics
+
+    max_slots = max(args.loads)
+    n_req_max = args.requests_per_slot * max_slots
+    max_len = args.prompt_len + args.new + 8
+    cfg = GPT2Config.tiny(policy=get_policy("O0"), vocab_size=args.vocab,
+                          hidden_size=args.hidden, num_layers=args.layers,
+                          num_heads=args.heads,
+                          max_seq_len=max(128, max_len))
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (args.prompt_len,)).astype(np.int32)
+               for _ in range(n_req_max)]
+    params = model.init(jax.random.key(0),
+                        jnp.asarray(prompts[0][None]))["params"]
+    apply_fn, make_cache = gpt2_decoder(model)
+
+    # ---- serial baseline: one jitted generate per request, back to
+    # back (compile excluded — one warmup call at the fixed shape)
+    gen = jax.jit(functools.partial(
+        generate, apply_fn, max_new_tokens=args.new,
+        vocab_size=cfg.vocab_size))
+
+    def serial_run(n_req):
+        outs = []
+        for i in range(n_req):
+            cache = make_cache(1, max_len)
+            outs.append(gen(params, jnp.asarray(prompts[i][None]),
+                            cache=cache))
+        return [np.asarray(o)[0] for o in outs]
+
+    serial_out = serial_run(n_req_max)      # compile + the oracle run
+
+    def serial_best(n_req, reps=3):
+        """Best-of-``reps`` serial tokens/sec over ``n_req`` requests —
+        measured ADJACENT to each engine point so machine drift over
+        the sweep cancels in the ratio instead of polluting it (the
+        baseline still gets every benefit of the doubt: its best rep).
+        """
+        best_s = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            serial_run(n_req)
+            best_s = min(best_s, time.perf_counter() - t0)
+        return n_req * args.new / best_s
+
+    # ---- engine sweep: n staggered arrivals into an n-slot pool
+    sweep = []
+    serial_tps = 0.0
+    for load in args.loads:
+        n_req = args.requests_per_slot * load
+        serial_tps = serial_best(n_req)
+        eng = Engine(apply_fn, make_cache, params,
+                     EngineConfig(max_slots=load, max_len=max_len,
+                                  prefill_chunk=args.chunk,
+                                  vocab_size=cfg.vocab_size,
+                                  max_queue=n_req))
+        # warm both executables off the clock (jit compile), then bench
+        # a fresh engine-shaped workload on the SAME engine (the two
+        # executables are already traced; trace_counts pins that)
+        wid = eng.submit(prompts[0], max_new_tokens=2)
+        eng.run(max_steps=8)
+        assert eng.results[wid].status == "done"
+        # best-of-3, mirroring the serial baseline's best-of-3: both
+        # sides shed co-tenant noise; parity is asserted on every rep
+        dt = float("inf")
+        for _ in range(3):
+            eng.metrics = ServingMetrics()  # drop prior reps' records
+            eng.results.clear()
+            t0 = time.perf_counter()
+            ids = []
+            k = 0
+            while k < n_req or eng.scheduler.depth or eng.n_active:
+                if k < n_req:
+                    ids.append(eng.submit(prompts[k],
+                                          max_new_tokens=args.new))
+                    k += 1
+                    for _ in range(args.stagger - 1):
+                        eng.step()
+                eng.step()
+            rep = time.perf_counter() - t0
+            for i, rid in enumerate(ids):  # parity stays the oracle
+                np.testing.assert_array_equal(eng.results[rid].tokens,
+                                              serial_out[i])
+            if rep < dt:
+                dt, s = rep, eng.metrics.summary()
+        assert eng.trace_counts == {"prefill": 1, "decode": 1}, \
+            eng.trace_counts
+        tps = n_req * args.new / dt
+        sweep.append({
+            "load": load, "tokens_per_sec": round(tps, 1),
+            "serial_tokens_per_sec": round(serial_tps, 1),
+            "vs_serial": round(tps / serial_tps, 3),
+            "ttft_p50_ms": round(s.get("ttft_p50_ms", 0.0), 2),
+            "ttft_p99_ms": round(s.get("ttft_p99_ms", 0.0), 2),
+            "mean_occupancy": round(s.get("mean_occupancy", 0.0), 3),
+        })
+
+    best = max(sweep, key=lambda r: r["tokens_per_sec"])
+    backend = jax.default_backend()
+    record = {
+        "metric": f"serving tokens/sec gpt2-serving [{backend}]",
+        "value": best["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_serial": best["vs_serial"],
+        "serial_tokens_per_sec": best["serial_tokens_per_sec"],
+        "model": {"hidden": args.hidden, "layers": args.layers,
+                  "vocab": args.vocab, "new": args.new,
+                  "prompt_len": args.prompt_len},
+        "sweep": sweep,
+    }
+    print(json.dumps(record), flush=True)
+    # every sweep point already asserted (a) token parity against the
+    # solo-generate oracle for every request and (b) exactly two traced
+    # executables — reaching here IS the smoke gate; the >= 2x
+    # acceptance ratio is read off the banked full-size sweep
+    # (perf_results/bench_serving_cpu.log), where the model is big
+    # enough for weight streaming, not dispatch, to dominate
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
