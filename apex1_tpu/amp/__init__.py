@@ -151,6 +151,9 @@ class Amp:
             raise ValueError("accum_steps must be >= 1")
         policy, scaler = self.policy, self.scaler
 
+        # graftlint: hot -- returned for the caller to jax.jit (the
+        # examples' `jax.jit(amp.make_train_step(...), donate...)`);
+        # the call graph can't see through the closure return
         def train_step(state: AmpState, *batch):
             ls = self._get_ls(state, loss_id)
 

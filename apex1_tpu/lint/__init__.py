@@ -1,0 +1,186 @@
+"""graftlint — AST static analysis for this repo's JAX hazard classes.
+
+The framework's invariants (no retraces after warmup, no host syncs on
+the decode chain, use-once PRNG keys, donation discipline, one jax
+spelling through the compat bridge) are exactly the properties JAX
+never enforces statically — they regress silently and cost a TPU
+session to rediscover. graftlint walks ``apex1_tpu/``, ``tools/`` and
+``examples/``, resolves imports well enough to know what is
+jit-reachable, and exits nonzero on any unsuppressed finding: a gate,
+not a style checker.
+
+Entry points::
+
+    from apex1_tpu.lint import lint_paths, lint_sources
+    res = lint_paths(["apex1_tpu", "tools", "examples"], root=REPO)
+    res.unsuppressed()        # -> [Finding]  (gate on this)
+    res.as_dict()             # -> the --json payload
+
+CLI: ``python tools/lint.py [--json] [--changed] [paths...]``.
+Rule catalogue + suppression grammar: ``docs/lint.md``.
+
+The lint machinery is stdlib ``ast`` only — no new deps, no jax, no
+device touch; the whole repo lints in ~1s. (``tools/lint.py`` loads
+this subpackage through a stub parent so even the CLI never pays the
+package ``__init__``'s jax import.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex1_tpu.lint.core import (Finding, ModuleSource, RULE_SLUGS,
+                                 apply_suppressions, canonical_rule,
+                                 unused_suppressions)
+from apex1_tpu.lint.project import Project, build_project  # noqa: F401
+from apex1_tpu.lint.rules import RULES
+
+__all__ = ["Finding", "LintResult", "RULES", "RULE_SLUGS",
+           "canonical_rule", "collect_files", "lint_files",
+           "lint_paths", "lint_sources", "module_name_for"]
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist",
+              ".claude"}
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    n_files: int
+    unused: List[Tuple[str, int, str]]   # (path, line, rules) — info only
+
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed()
+
+    def as_dict(self) -> dict:
+        per_rule: Dict[str, int] = {}
+        for f in self.unsuppressed():
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        return {
+            "tool": "graftlint",
+            "rules": {r.code: {"slug": r.slug, "summary": r.summary}
+                      for r in RULES},
+            "n_files": self.n_files,
+            "ok": self.ok,
+            "counts": {"unsuppressed": len(self.unsuppressed()),
+                       "suppressed": len(self.suppressed()),
+                       "per_rule": per_rule},
+            "findings": [f.as_dict() for f in self.findings],
+            "unused_suppressions": [
+                {"path": p, "line": ln, "rules": r}
+                for p, ln, r in self.unused],
+        }
+
+
+def module_name_for(path: str, root: Optional[str] = None) -> str:
+    """Dotted module name for a file: ``apex1_tpu/ops/rope.py`` ->
+    ``apex1_tpu.ops.rope``; unknown layouts get a best-effort name
+    (only the ``apex1_tpu``-package names carry semantics — the compat
+    rule's bridge exemptions and import-runs-__init__ logic)."""
+    p = os.path.abspath(path)
+    if root:
+        try:
+            rel = os.path.relpath(p, os.path.abspath(root))
+        except ValueError:
+            rel = os.path.basename(p)
+    else:
+        # find the package root by walking up from an apex1_tpu segment
+        parts = p.split(os.sep)
+        rel = os.sep.join(parts[parts.index("apex1_tpu"):]) \
+            if "apex1_tpu" in parts else os.path.basename(p)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    name = rel.replace(os.sep, ".")
+    if name.endswith(".__init__"):
+        name = name[:-len(".__init__")]
+    elif name == "__init__":
+        name = ""
+    return name
+
+
+def collect_files(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p) if root and not os.path.isabs(p) \
+            else p
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                files.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.join(dirpath, fn))
+    return files
+
+
+def _display_path(path: str, root: Optional[str]) -> str:
+    if not root:
+        return path
+    try:
+        rel = os.path.relpath(os.path.abspath(path),
+                              os.path.abspath(root))
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def lint_files(files: Sequence[str],
+               root: Optional[str] = None) -> LintResult:
+    named: Dict[str, Tuple[str, str]] = {}
+    unreadable: List[Finding] = []
+    for f in files:
+        disp = _display_path(f, root)
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            unreadable.append(Finding("APX001", disp, 1, 0,
+                                      f"cannot read file: {e}"))
+            continue
+        named[disp] = (module_name_for(f, root), text)
+    res = lint_sources(named)
+    res.findings.extend(unreadable)
+    return res
+
+
+def lint_paths(paths: Sequence[str],
+               root: Optional[str] = None) -> LintResult:
+    return lint_files(collect_files(paths, root), root)
+
+
+def lint_sources(named_sources: Dict[str, Tuple[str, str]]) -> LintResult:
+    """``{path: (modname, text)}`` -> LintResult. The in-memory entry
+    point the tests drive fixtures through."""
+    project = build_project(named_sources)
+    by_path: Dict[str, ModuleSource] = {m.path: m
+                                        for m in project.modules}
+    findings: List[Finding] = []
+    for mod in project.modules:
+        findings.extend(mod.errors)
+    for rule in RULES:
+        findings.extend(rule.check(project))
+    out: List[Finding] = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None:
+            apply_suppressions(mod, [f])
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    unused = []
+    for mod in project.modules:
+        for s in unused_suppressions(mod):
+            unused.append((mod.path, s.line, ",".join(s.rules)))
+    return LintResult(findings=out, n_files=len(project.modules),
+                      unused=unused)

@@ -1,0 +1,103 @@
+"""APX105 compat-spelling: newer-jax spellings that bypass the bridge.
+
+`apex1_tpu.__init__._install_jax_compat` is the ONLY reason
+``jax.shard_map`` / ``jax.set_mesh`` / ``jax.lax.pcast`` /
+``jax.lax.axis_size`` work on the 0.4.x verify image — the exact
+failure class that cost 126 tests before PR 1 added the bridge. The
+invariants this rule holds:
+
+- **bridged spellings need the bridge installed**: a module OUTSIDE
+  the ``apex1_tpu`` package (tools/, examples/) that uses a bridged
+  spelling must import ``apex1_tpu`` somewhere — package modules get
+  the bridge for free via ``__init__``. AttributeError otherwise, but
+  only on the old image, which is why it ships.
+- **``jax.typeof`` is NEVER bridged**: it has no 0.4.x equivalent and
+  the bridge deliberately does not fake one (a wrong vma is worse than
+  none). The sanctioned access is ``ops._common.out_struct`` (or a
+  local getattr guard). Flagged everywhere outside the two bridge
+  files.
+- **legacy spellings are banned too**: ``jax.experimental.shard_map``
+  imports and ``check_rep=`` kwargs pin the OLD api, bypassing the
+  bridge's check_vma translation — one spelling (``jax.shard_map``)
+  everywhere, the bridge makes it true.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex1_tpu.lint.core import Finding, ModuleSource
+from apex1_tpu.lint.project import Project
+
+#: modules that ARE the bridge — exempt from every sub-check
+BRIDGE_MODULES = {"apex1_tpu", "apex1_tpu.ops._common"}
+
+_BRIDGED = {"jax.shard_map", "jax.set_mesh", "jax.lax.pcast",
+            "jax.lax.axis_size"}
+_NEVER_BRIDGED = {"jax.typeof"}
+
+
+def _has_bridge(mod: ModuleSource) -> bool:
+    if mod.modname == "apex1_tpu" or mod.modname.startswith("apex1_tpu."):
+        return True  # importing any submodule runs the package __init__
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            if any(al.name.split(".")[0] == "apex1_tpu"
+                   for al in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "apex1_tpu":
+                return True
+    return False
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if mod.tree is None or mod.modname in BRIDGE_MODULES:
+            continue
+        bridged_ok = _has_bridge(mod)
+        seen = set()  # (line, col): nested Attribute chains collide
+
+        def emit(line, col, msg):
+            if (line, col) not in seen:
+                seen.add((line, col))
+                findings.append(Finding("APX105", mod.path, line, col,
+                                        msg))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.startswith("jax.experimental.shard_map"):
+                    emit(node.lineno, node.col_offset,
+                         "legacy 'jax.experimental.shard_map' import — "
+                         "use the unified jax.shard_map spelling (the "
+                         "compat bridge makes it work on 0.4.x)")
+                continue
+            if isinstance(node, ast.Attribute):
+                dotted = project.resolve_dotted(mod, node)
+                if dotted is None:
+                    continue
+                if dotted in _NEVER_BRIDGED:
+                    emit(node.lineno, node.col_offset,
+                         f"{dotted} has NO 0.4.x fallback and is not "
+                         f"bridged — use ops._common.out_struct or a "
+                         f"getattr guard")
+                elif dotted in _BRIDGED and not bridged_ok:
+                    emit(node.lineno, node.col_offset,
+                         f"{dotted} is a bridged spelling but this "
+                         f"module never imports apex1_tpu — "
+                         f"AttributeError on jax 0.4.x (the bridge "
+                         f"installs it)")
+                elif dotted.startswith("jax.experimental.shard_map"):
+                    emit(node.lineno, node.col_offset,
+                         "legacy jax.experimental.shard_map spelling — "
+                         "use jax.shard_map (bridged)")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "check_rep":
+                        emit(kw.value.lineno, kw.value.col_offset,
+                             "check_rep= is the legacy spelling of "
+                             "check_vma= — the bridge translates "
+                             "check_vma, spell it that way")
+    return findings

@@ -1,0 +1,200 @@
+"""APX104 donation: a donated buffer read after the donating call.
+
+``donate_argnums`` hands the argument's buffer to XLA for in-place
+reuse; the python reference left behind is POISON — reading it raises
+on strict backends and silently serves stale/garbage memory on others
+(and on CPU jax skips donation entirely, so the bug ships invisibly:
+correct on the dev box, corrupt on the TPU). The repo's own
+`utils/debug.py` lists this as hazard #1.
+
+Mechanics: the project index records every ``jax.jit(...,
+donate_argnums=...)`` site. A module pre-pass binds each donating
+wrapper to the names it's assigned to (``g = jax.jit(f, donate...)``,
+``self._decode = jax.jit(decode, ...)``, or the decorated function's
+own name). Each function is then scanned statement-by-statement: a
+call through a donating binding marks the argument expressions at the
+donated positions dead; a later READ of a dead name (before
+reassignment) is a finding. Reads and rebinds inside one statement
+resolve in call order (reads first, then donation, then the
+assignment targets), so the engine's canonical
+``nxt, ..., self.kv.cache, ... = self._decode(..., self.kv.cache, ...)``
+— donate + rebind in one statement — is correctly clean.
+
+Branches merge conservatively: a buffer must be donated on ALL paths
+to stay dead (no false positives from one-armed donation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import (FunctionInfo, JitSite, Project,
+                                    own_body_walk)
+
+
+def _expr_str(node: ast.AST) -> Optional[str]:
+    """Stable string for a Name or dotted-Name chain; None otherwise."""
+    parts = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _donating_bindings(project: Project,
+                       mod) -> Dict[str, JitSite]:
+    """name ('g', 'self._decode', 'f') -> donating JitSite, module-wide.
+
+    Coarse on purpose: `self._x` bindings are matched by spelling, not
+    per-class dataflow — two classes in one module sharing an attribute
+    name would alias. That trade buys the common engine pattern without
+    a type system."""
+    bindings: Dict[str, JitSite] = {}
+    for site in project.jit_sites:
+        if site.mod is not mod or not site.donate_argnums:
+            continue
+        if site.target is not None and site.call in getattr(
+                site.target.node, "decorator_list", []):
+            bindings[site.target.name] = site
+    for info in list(project.functions.values()):
+        if info.mod is not mod:
+            continue
+        for n in own_body_walk(info.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            site = project.jit_site_by_call.get(id(n.value))
+            if site is None or not site.donate_argnums:
+                continue
+            for tgt in n.targets:
+                name = _expr_str(tgt)
+                if name:
+                    bindings[name] = site
+                    site.bound_names.append(name)
+    return bindings
+
+
+class _FnChecker:
+    def __init__(self, project: Project, info: FunctionInfo,
+                 bindings: Dict[str, JitSite]):
+        self.project = project
+        self.info = info
+        self.bindings = bindings
+        self.findings: List[Finding] = []
+        self._seen: Set[tuple] = set()
+
+    def run(self) -> List[Finding]:
+        node = self.info.node
+        if isinstance(node, ast.Lambda):
+            return []
+        self._block(list(getattr(node, "body", [])), {})
+        return self.findings
+
+    # dead: expr string -> line where it was donated
+    def _block(self, stmts, dead: Dict[str, int]) -> Dict[str, int]:
+        for stmt in stmts:
+            dead = self._stmt(stmt, dead)
+        return dead
+
+    def _stmt(self, stmt, dead: Dict[str, int]) -> Dict[str, int]:
+        if isinstance(stmt, ast.If):
+            a = self._block(stmt.body, dict(dead))
+            b = self._block(stmt.orelse, dict(dead))
+            return {k: v for k, v in a.items() if k in b}
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = stmt.iter if isinstance(
+                stmt, (ast.For, ast.AsyncFor)) else stmt.test
+            self._check_reads(head, dead)
+            once = self._block(stmt.body, dict(dead))
+            end = self._block(stmt.body, dict(once))  # loop-carried
+            end = self._block(stmt.orelse, end)
+            return {k: v for k, v in dead.items() if k in end}
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(item.context_expr, dead)
+            return self._block(stmt.body, dead)
+        if isinstance(stmt, ast.Try):
+            out = self._block(stmt.body, dict(dead))
+            for h in stmt.handlers:
+                hb = self._block(h.body, dict(dead))
+                out = {k: v for k, v in out.items() if k in hb}
+            out = self._block(stmt.orelse, out)
+            return self._block(stmt.finalbody, out)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return dead
+        # simple statement: reads, then donations, then rebinds
+        self._check_reads(stmt, dead)
+        for call, site in self._donating_calls(stmt):
+            for i in site.donate_argnums:
+                if i < len(call.args):
+                    name = _expr_str(call.args[i])
+                    if name:
+                        dead[name] = call.lineno
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._rebind(tgt, dead)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            self._rebind(stmt.target, dead)
+        return dead
+
+    def _donating_calls(self, stmt):
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                name = _expr_str(n.func)
+                if name in self.bindings:
+                    yield n, self.bindings[name]
+
+    def _rebind(self, tgt: ast.AST, dead: Dict[str, int]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._rebind(e, dead)
+            return
+        name = _expr_str(tgt)
+        if name is None:
+            return
+        # rebinding x revives x AND x.anything
+        for k in [k for k in dead
+                  if k == name or k.startswith(name + ".")]:
+            del dead[k]
+
+    def _check_reads(self, node: ast.AST, dead: Dict[str, int]) -> None:
+        if not dead:
+            return
+        for n in ast.walk(node):
+            if not isinstance(n, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(n, "ctx", None), ast.Load):
+                continue
+            name = _expr_str(n)
+            if name is None or name not in dead:
+                continue
+            pos = (n.lineno, n.col_offset)
+            if pos in self._seen:
+                continue
+            self._seen.add(pos)
+            self.findings.append(Finding(
+                "APX104", self.info.mod.path, n.lineno, n.col_offset,
+                f"'{name}' was donated (donate_argnums) at line "
+                f"{dead[name]} and read afterwards in "
+                f"'{self.info.qualname}' — the buffer may be "
+                f"invalidated on TPU (CPU runs hide this)"))
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    per_mod_bindings = {}
+    for mod in project.modules:
+        if mod.tree is not None:
+            per_mod_bindings[id(mod)] = _donating_bindings(project, mod)
+    for info in project.functions.values():
+        bindings = per_mod_bindings.get(id(info.mod), {})
+        if bindings:
+            findings.extend(
+                _FnChecker(project, info, bindings).run())
+    return findings

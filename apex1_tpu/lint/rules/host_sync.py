@@ -1,0 +1,60 @@
+"""APX101 host-sync: host synchronization inside a traced function.
+
+Inside a jit/scan/shard_map body (or anything those bodies call — the
+"hot" set), a host-synchronizing call either crashes at trace time
+(``.item()`` on a tracer raises ConcretizationTypeError) or — worse —
+silently works during warmup because the value is still concrete, then
+stalls the dispatch chain in production (the serving engine's
+async-dispatch contract: the host must never block on a step's
+outputs). The flagged set:
+
+- ``x.item()``             — concretizes; the classic accidental sync
+- ``np.asarray(x)`` / ``np.array(x)`` — pulls a device array to host
+- ``jax.device_get(x)``    — explicit fetch
+- ``jax.block_until_ready`` / ``x.block_until_ready()`` — explicit sync
+
+Host-side code (engine loops, metrics drains, tools) is untouched:
+the rule fires only on functions the reachability pass marked hot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import Project, own_body_walk
+
+_SYNC_CALLS = {
+    "numpy.asarray": "np.asarray pulls the value to host",
+    "numpy.array": "np.array pulls the value to host",
+    "jax.device_get": "jax.device_get is a host fetch",
+    "jax.block_until_ready": "block_until_ready stalls dispatch",
+}
+
+_SYNC_METHODS = {
+    "item": ".item() concretizes (host sync; breaks under tracing)",
+    "block_until_ready": ".block_until_ready() stalls dispatch",
+    "tolist": ".tolist() concretizes (host sync; breaks under tracing)",
+}
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in project.hot_functions():
+        for node in own_body_walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            dotted = project.resolve_dotted(info.mod, node.func)
+            if dotted in _SYNC_CALLS:
+                msg = _SYNC_CALLS[dotted]
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _SYNC_METHODS):
+                msg = _SYNC_METHODS[node.func.attr]
+            if msg:
+                findings.append(Finding(
+                    "APX101", info.mod.path, node.lineno, node.col_offset,
+                    f"{msg} — inside traced function "
+                    f"'{info.qualname}' (jit-reachable)"))
+    return findings

@@ -1,0 +1,220 @@
+"""APX103 prng-reuse: the same key consumed by two samplers.
+
+JAX keys are use-once: two consumers of one key draw CORRELATED (often
+identical) streams — the classic "my dropout masks repeat every step"
+bug, invisible in loss curves until convergence quietly degrades. The
+rule runs a straight-line abstract interpretation over every function:
+
+- a name becomes a KEY when assigned from ``jax.random.{PRNGKey,key,
+  split,fold_in,clone,wrap_key_data}``, aliased/subscripted from a key,
+  or when a parameter is key-named (``key``/``rng``/``*_key``/…);
+- a key is CONSUMED when passed to a ``jax.random`` sampler, to
+  ``split`` (splitting an already-used key correlates the children
+  with the earlier draw), or as a bare argument to any other call (the
+  callee presumably draws from it);
+- ``fold_in(key, salt)`` does NOT consume — deriving many streams from
+  one base key with distinct salts is the sanctioned pattern;
+- assignment to a name clears its consumed state (``rng, sub =
+  split(rng)`` is the idiomatic refresh).
+
+A consumed key consumed again -> finding. Branches are analyzed
+independently and merged conservatively (a key must be consumed on ALL
+paths to stay consumed); loop bodies get a second pass seeded with the
+first pass's exit state so loop-carried reuse (``for i: x =
+normal(key)``) is caught and labeled as such.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import FunctionInfo, Project
+
+_KEY_PARAM = re.compile(r"^(key|keys|rng|prng|rngs)$|(_key|_rng|_keys)$")
+
+_MAKERS = {"PRNGKey", "key", "wrap_key_data", "clone"}
+_NONCONSUMING = {"fold_in", "key_data", "key_impl"}
+
+
+@dataclasses.dataclass
+class _State:
+    keys: Set[str]
+    consumed: Dict[str, int]  # name -> line of consuming call
+
+    def copy(self) -> "_State":
+        return _State(set(self.keys), dict(self.consumed))
+
+    def merge(self, other: "_State") -> "_State":
+        # keys: union (being a key is monotone); consumed: intersection
+        # (only flag reuse that happens on every path)
+        consumed = {n: ln for n, ln in self.consumed.items()
+                    if n in other.consumed}
+        return _State(self.keys | other.keys, consumed)
+
+
+class _FnChecker:
+    def __init__(self, project: Project, info: FunctionInfo):
+        self.project = project
+        self.info = info
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[int, int]] = set()  # (line, col) dedupe
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        node = self.info.node
+        if isinstance(node, ast.Lambda):
+            return []
+        state = _State(keys={p for p in self.info.params
+                             if _KEY_PARAM.search(p)}, consumed={})
+        self._block(list(getattr(node, "body", [])), state,
+                    loop_pass=False)
+        return self.findings
+
+    # -- interpretation ---------------------------------------------------
+
+    def _block(self, stmts: List[ast.stmt], state: _State,
+               loop_pass: bool) -> _State:
+        for stmt in stmts:
+            state = self._stmt(stmt, state, loop_pass)
+        return state
+
+    def _stmt(self, stmt: ast.stmt, state: _State,
+              loop_pass: bool) -> _State:
+        if isinstance(stmt, ast.If):
+            a = self._block(stmt.body, state.copy(), loop_pass)
+            b = self._block(stmt.orelse, state.copy(), loop_pass)
+            return a.merge(b)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval_calls(stmt.iter, state, loop_pass)
+            self._rebind_target(stmt.target, None, state)
+            once = self._block(stmt.body, state.copy(), loop_pass)
+            # second pass: catches reuse carried around the back edge
+            end = self._block(stmt.body, once.copy(), True)
+            end = self._block(stmt.orelse, end, loop_pass)
+            return state.merge(end)
+        if isinstance(stmt, ast.While):
+            self._eval_calls(stmt.test, state, loop_pass)
+            once = self._block(stmt.body, state.copy(), loop_pass)
+            end = self._block(stmt.body, once.copy(), True)
+            end = self._block(stmt.orelse, end, loop_pass)
+            return state.merge(end)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval_calls(item.context_expr, state, loop_pass)
+            return self._block(stmt.body, state, loop_pass)
+        if isinstance(stmt, ast.Try):
+            body = self._block(stmt.body, state.copy(), loop_pass)
+            merged = body
+            for h in stmt.handlers:
+                merged = merged.merge(
+                    self._block(h.body, state.copy(), loop_pass))
+            merged = self._block(stmt.orelse, merged, loop_pass)
+            return self._block(stmt.finalbody, merged, loop_pass)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state  # separate scope, checked on its own
+        # simple statement: evaluate calls, then rebind targets
+        self._eval_calls(stmt, state, loop_pass)
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._rebind_target(tgt, stmt.value, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._rebind_target(stmt.target, stmt.value, state)
+        elif isinstance(stmt, ast.AugAssign):
+            self._rebind_target(stmt.target, None, state)
+        return state
+
+    # -- calls ------------------------------------------------------------
+
+    def _eval_calls(self, node: ast.AST, state: _State,
+                    loop_pass: bool) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call):
+                self._call(n, state, loop_pass)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _call(self, call: ast.Call, state: _State,
+              loop_pass: bool) -> None:
+        dotted = self.project.resolve_dotted(self.info.mod, call.func)
+        if dotted and dotted.startswith("jax.random."):
+            fn = dotted[len("jax.random."):]
+            if fn in _MAKERS or fn in _NONCONSUMING:
+                return
+            # split and every sampler consume their key argument
+            key_arg = call.args[0] if call.args else None
+            if isinstance(key_arg, ast.Name):
+                self._consume(key_arg.id, call, state, loop_pass,
+                              via=f"jax.random.{fn}")
+                state.keys.add(key_arg.id)
+            return
+        # any other call: a bare key argument escapes into the callee
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in state.keys:
+                self._consume(arg.id, call, state, loop_pass,
+                              via=ast.unparse(call.func))
+
+    def _consume(self, name: str, call: ast.Call, state: _State,
+                 loop_pass: bool, via: str) -> None:
+        prev = state.consumed.get(name)
+        if name in state.keys and prev is not None:
+            pos = (call.lineno, call.col_offset)
+            if pos not in self._seen:
+                self._seen.add(pos)
+                carried = " (loop-carried)" if loop_pass else ""
+                self.findings.append(Finding(
+                    "APX103", self.info.mod.path, call.lineno,
+                    call.col_offset,
+                    f"PRNG key '{name}' already consumed at line "
+                    f"{prev} is used again by {via} in "
+                    f"'{self.info.qualname}'{carried} — split or "
+                    f"fold_in first"))
+        state.consumed[name] = call.lineno
+        if prev is not None:
+            state.consumed[name] = prev  # keep the FIRST consumption
+
+    # -- assignment -------------------------------------------------------
+
+    def _rebind_target(self, tgt: ast.AST, value: Optional[ast.AST],
+                       state: _State) -> None:
+        names = [n.id for n in ast.walk(tgt) if isinstance(n, ast.Name)]
+        is_key = value is not None and self._is_key_expr(value, state)
+        for nm in names:
+            state.consumed.pop(nm, None)
+            if is_key:
+                state.keys.add(nm)
+            elif value is not None:
+                state.keys.discard(nm)
+
+    def _is_key_expr(self, value: ast.AST, state: _State) -> bool:
+        if isinstance(value, ast.Call):
+            dotted = self.project.resolve_dotted(self.info.mod,
+                                                 value.func)
+            if dotted and dotted.startswith("jax.random."):
+                fn = dotted[len("jax.random."):]
+                return fn in _MAKERS | {"split", "fold_in"}
+            return False
+        if isinstance(value, ast.Name):
+            return value.id in state.keys
+        if isinstance(value, ast.Subscript):
+            return (isinstance(value.value, ast.Name)
+                    and value.value.id in state.keys)
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return any(self._is_key_expr(e, state) for e in value.elts)
+        return False
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in project.functions.values():
+        findings.extend(_FnChecker(project, info).run())
+    return findings

@@ -1,0 +1,294 @@
+"""APX102 retrace: things that silently recompile (or bake in trace
+garbage) on every call.
+
+Four sub-checks:
+
+a. **static annotation sanity** — ``static_argnums`` out of range /
+   ``static_argnames`` naming a nonexistent parameter (jit raises late,
+   at first call, with an unhelpful message), and a static-marked
+   parameter whose default is a mutable literal (unhashable ->
+   TypeError at dispatch; hashable-but-mutated -> a retrace per call).
+b. **trace-time clocks** — ``time.time()`` / ``perf_counter()`` /
+   ``datetime.now()`` inside a traced body bake the TRACE time into
+   the executable as a constant: not a retrace, a silent wrong-answer.
+c. **trace-time f-strings** — an f-string inside a traced body renders
+   the TRACER's repr, not the runtime value. Allowed inside ``raise``
+   and ``assert`` (trace-time error text is exactly what you want
+   there).
+d. **python branch on a traced value** — ``if``/``while`` on a value
+   that flows from a ``jnp``/``jax.lax``/``jax.random`` call raises
+   TracerBoolConversionError under jit, or — when the function is only
+   SOMETIMES jitted — forks one retrace per observed truth value.
+   ``x.shape``/``x.ndim``/``x.dtype`` accesses are static and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import (FunctionInfo, Project, own_body_walk)
+
+_CLOCKS = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+#: calls whose results are traced arrays (prefix match)
+_TRACED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.",
+                    "jax.scipy.")
+
+#: jax.lax.* calls that return PYTHON statics at trace time (axis_size
+#: is psum of a literal — an int, branching on it is idiomatic)
+_STATIC_CALLS = {"jax.lax.axis_size", "jax.numpy.shape",
+                 "jax.numpy.ndim", "jax.numpy.result_type"}
+
+#: attribute accesses on an array that are STATIC under tracing
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_static_annotations(project, findings)
+    for info in project.hot_functions():
+        _check_clocks(project, info, findings)
+        _check_fstrings(project, info, findings)
+        _check_traced_branch(project, info, findings)
+    return findings
+
+
+# ---- a: static_argnums / static_argnames sanity -------------------------
+
+def _check_static_annotations(project: Project,
+                              findings: List[Finding]) -> None:
+    for site in project.jit_sites:
+        if site.target is None:
+            continue
+        params = site.target.params
+        has_varargs = bool(getattr(site.target.node, "args", None)
+                           and site.target.node.args.vararg)
+        line, col = site.call.lineno, site.call.col_offset
+        path = site.mod.path
+        if site.static_argnums:
+            for i in site.static_argnums:
+                if i >= len(params) and not has_varargs:
+                    findings.append(Finding(
+                        "APX102", path, line, col,
+                        f"static_argnums={i} is out of range for "
+                        f"'{site.target.qualname}' "
+                        f"({len(params)} parameters) — jit will fail "
+                        f"at first call"))
+                elif i < len(params):
+                    _check_static_default(site, params[i], findings)
+        if site.static_argnames:
+            for name in site.static_argnames:
+                if name not in params:
+                    findings.append(Finding(
+                        "APX102", path, line, col,
+                        f"static_argnames={name!r} does not name a "
+                        f"parameter of '{site.target.qualname}'"))
+                else:
+                    _check_static_default(site, name, findings)
+
+
+def _check_static_default(site, pname: str,
+                          findings: List[Finding]) -> None:
+    node = site.target.node
+    a = getattr(node, "args", None)
+    if a is None:
+        return
+    pos = a.posonlyargs + a.args
+    defaults = a.defaults
+    # defaults align to the TAIL of the positional params
+    offset = len(pos) - len(defaults)
+    for idx, p in enumerate(pos):
+        if p.arg != pname or idx < offset:
+            continue
+        d = defaults[idx - offset]
+        if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            findings.append(Finding(
+                "APX102", site.mod.path, d.lineno, d.col_offset,
+                f"static parameter {pname!r} of "
+                f"'{site.target.qualname}' has a mutable default — "
+                f"unhashable under jit (and a retrace per mutation "
+                f"if made hashable)"))
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == pname and isinstance(
+                d, (ast.List, ast.Dict, ast.Set)):
+            findings.append(Finding(
+                "APX102", site.mod.path, d.lineno, d.col_offset,
+                f"static parameter {pname!r} of "
+                f"'{site.target.qualname}' has a mutable default — "
+                f"unhashable under jit"))
+
+
+# ---- b: trace-time clocks ----------------------------------------------
+
+def _check_clocks(project: Project, info: FunctionInfo,
+                  findings: List[Finding]) -> None:
+    for node in own_body_walk(info.node):
+        if isinstance(node, ast.Call):
+            dotted = project.resolve_dotted(info.mod, node.func)
+            if dotted in _CLOCKS:
+                findings.append(Finding(
+                    "APX102", info.mod.path, node.lineno,
+                    node.col_offset,
+                    f"{dotted}() inside traced function "
+                    f"'{info.qualname}' is evaluated ONCE at trace "
+                    f"time and baked into the executable"))
+
+
+# ---- c: f-strings at trace time ----------------------------------------
+
+def _check_fstrings(project: Project, info: FunctionInfo,
+                    findings: List[Finding]) -> None:
+    """Flag f-strings that interpolate a possibly-traced name (a
+    parameter or a jnp/lax/random-derived local) outside raise/assert/
+    warnings.warn — those three legitimately render at trace time, on
+    the static path, as their whole point."""
+    maybe_traced = set(info.params) | _traced_locals(project, info)
+    if not maybe_traced:
+        return
+
+    def interpolates_traced(js: ast.JoinedStr) -> Optional[str]:
+        for v in js.values:
+            if not isinstance(v, ast.FormattedValue):
+                continue
+            for n in ast.walk(v.value):
+                if isinstance(n, ast.Name) and n.id in maybe_traced:
+                    return n.id
+        return None
+
+    def is_warn_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Name, ast.Attribute))
+                and (node.func.id if isinstance(node.func, ast.Name)
+                     else node.func.attr).endswith("warn"))
+
+    def visit(node: ast.AST, exempt: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if (isinstance(child, (ast.Raise, ast.Assert))
+                    or is_warn_call(child)):
+                visit(child, True)
+                continue
+            if isinstance(child, ast.JoinedStr) and not exempt:
+                name = interpolates_traced(child)
+                if name is not None:
+                    findings.append(Finding(
+                        "APX102", info.mod.path, child.lineno,
+                        child.col_offset,
+                        f"f-string interpolates possibly-traced "
+                        f"'{name}' in '{info.qualname}' — renders at "
+                        f"TRACE time (a tracer repr, not the runtime "
+                        f"value); ok only inside raise/assert/warn"))
+                    continue
+            visit(child, exempt)
+
+    if isinstance(info.node, ast.Lambda):
+        return  # a lambda body holds no raise/assert statements
+    for stmt in getattr(info.node, "body", []):
+        visit(stmt, isinstance(stmt, (ast.Raise, ast.Assert)))
+
+
+# ---- d: python branch on a traced value --------------------------------
+
+def _traced_locals(project: Project, info: FunctionInfo) -> Set[str]:
+    """Names assigned (anywhere in the function) from jnp/lax/random
+    calls, plus one propagation round through BinOp/compare chains."""
+    traced: Set[str] = set()
+    for _ in range(2):
+        for node in own_body_walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _is_traced_expr(project, info, node.value, traced):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+    return traced
+
+
+def _is_traced_expr(project: Project, info: FunctionInfo, expr: ast.AST,
+                    traced: Set[str]) -> bool:
+    if isinstance(expr, ast.Call):
+        dotted = project.resolve_dotted(info.mod, expr.func)
+        if (dotted and dotted.startswith(_TRACED_PREFIXES)
+                and dotted not in _STATIC_CALLS):
+            # shape/dtype queries stay python-static
+            return not (isinstance(expr.func, ast.Attribute)
+                        and expr.func.attr in _STATIC_ATTRS)
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in traced
+    if isinstance(expr, ast.BinOp):
+        return (_is_traced_expr(project, info, expr.left, traced)
+                or _is_traced_expr(project, info, expr.right, traced))
+    if isinstance(expr, ast.UnaryOp):
+        return _is_traced_expr(project, info, expr.operand, traced)
+    if isinstance(expr, ast.Compare):
+        return any(_is_traced_expr(project, info, e, traced)
+                   for e in [expr.left] + list(expr.comparators))
+    return False
+
+
+def _check_traced_branch(project: Project, info: FunctionInfo,
+                         findings: List[Finding]) -> None:
+    traced = _traced_locals(project, info)
+    if not traced:
+        return
+    for node in own_body_walk(info.node):
+        test = None
+        kind = None
+        if isinstance(node, ast.If):
+            test, kind = node.test, "if"
+        elif isinstance(node, ast.While):
+            test, kind = node.test, "while"
+        elif isinstance(node, ast.IfExp):
+            test, kind = node.test, "conditional expression"
+        if test is None:
+            continue
+        name = _traced_name_in_test(test, traced)
+        if name is not None:
+            findings.append(Finding(
+                "APX102", info.mod.path, test.lineno, test.col_offset,
+                f"python {kind} on traced value '{name}' in "
+                f"'{info.qualname}' — TracerBoolConversionError under "
+                f"jit, or one retrace per truth value; use jnp.where/"
+                f"lax.cond (or lift the value to static_argnums)"))
+
+
+def _traced_name_in_test(test: ast.AST, traced: Set[str]):
+    parents: Dict[int, ast.AST] = {}
+    comp_bound: Set[str] = set()  # comprehension targets shadow locals
+    for node in ast.walk(test):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                             ast.SetComp, ast.DictComp)):
+            for gen in node.generators:
+                comp_bound.update(n.id for n in ast.walk(gen.target)
+                                  if isinstance(n, ast.Name))
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced
+                and node.id not in comp_bound):
+            continue
+        # x.shape / x.ndim / len(x) / isinstance(x, ...) are static
+        par = parents.get(id(node))
+        if isinstance(par, ast.Attribute) and par.attr in _STATIC_ATTRS:
+            continue
+        if (isinstance(par, ast.Call) and isinstance(par.func, ast.Name)
+                and par.func.id in ("len", "isinstance", "getattr",
+                                    "hasattr", "type")):
+            continue
+        if isinstance(par, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in par.ops):
+            continue  # `x is (not) None` is a static identity check
+        return node.id
+    return None

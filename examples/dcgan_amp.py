@@ -101,8 +101,11 @@ def main():
 
     z0 = jnp.zeros((args.batch, 1, 1, args.zdim), jnp.float32)
     img0 = jnp.zeros((args.batch, 32, 32, 3), jnp.float32)
-    gvars = jax.jit(gen.init)(key, z0)
-    dvars = jax.jit(disc.init)(key, img0)
+    # distinct init keys: the same key for both nets would correlate
+    # G's and D's initial weights (graftlint APX103 caught this)
+    key_g, key_d = jax.random.split(key)
+    gvars = jax.jit(gen.init)(key_g, z0)
+    dvars = jax.jit(disc.init)(key_d, img0)
 
     # one Amp per (model, optimizer) pair — ≙ amp.initialize([netD, netG],
     # [optD, optG], num_losses=3); each keeps its own loss-scale state

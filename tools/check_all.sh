@@ -16,6 +16,8 @@
 # see the pytest.ini note).
 set -e
 cd "$(dirname "$0")/.."
+echo "== graftlint (static JAX-hazard gate; docs/lint.md) =="
+python tools/lint.py
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q
