@@ -33,6 +33,7 @@ fp16-in/fp32-accumulate kernels.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -52,49 +53,67 @@ def _block(size: int, requested: int) -> int:
     return min(requested, max(16, ((size + 15) // 16) * 16))
 
 
-def _auto_blocks(D, block_q, block_k):
-    """Default block sizes. Small tiles (128×128) make the grid huge and
-    the per-step MXU work tiny — grid/DMA overheads then dominate (round-1
-    v5e profile attributed ~5× to the 128×128 grid on GPT-2 shapes,
-    BASELINE.md "Round 1 measurements"; raw trace not retained, the block
-    sweep in tools/bench_kernels.py re-measures). Defaults target a ≤1 MiB
-    fp32 score tile
-    (512×512) and shrink with the padded head dim so q/k/v blocks +
-    accumulators + double-buffered operands stay inside the generation's
-    VMEM budget (`core.capability.vmem_budget` — the runtime analog of the
-    reference's per-sm kernel specialization in csrc/fmha)."""
-    import os
+def _env_block(name):
+    """Documented MANUAL override (``APEX1_ATTN_BLOCK_Q/K``) — for pinning
+    a block size on hardware without code edits. Read at TRACE time, so
+    the jit cache does NOT key on it: changing the env mid-process serves
+    stale executables. For sweeps, pass explicit ``block_q/block_k``
+    instead (static args — N candidates compile N executables in one
+    process; ``tools/tune_kernels.py`` drives this)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if val <= 0 or val % 16:
+        raise ValueError(f"{name} must be a positive multiple of 16 "
+                         f"(TPU sublane tiling), got {val}")
+    return val
 
+
+def _auto_blocks(D, block_q, block_k, dtype=jnp.bfloat16, seq=128):
+    """Resolve block sizes with the documented precedence (docs/ops.md):
+
+        explicit argument > APEX1_ATTN_BLOCK_Q/K env override
+        > tuning-table winner (`apex1_tpu.tuning`, keyed on generation
+          x dtype x padded head dim x the power-of-two bucket of the
+          key sequence length — block preference shifts with grid size,
+          so a 1k-seq winner never governs a 16k program)
+        > analytic heuristic.
+
+    The heuristic: small tiles (128×128) make the grid huge and the
+    per-step MXU work tiny — grid/DMA overheads then dominate (round-1
+    v5e profile attributed ~5× to the 128×128 grid on GPT-2 shapes,
+    BASELINE.md "Round 1 measurements"). Defaults target a ≤1 MiB fp32
+    score tile (512×512) and shrink with the padded head dim so q/k/v
+    blocks + accumulators + double-buffered operands stay inside the
+    generation's VMEM budget (`core.capability.vmem_budget` — the
+    runtime analog of the reference's per-sm kernel specialization in
+    csrc/fmha). 512 block_k keeps the fp32 score tile at 1 MiB (bq=512);
+    the step from 1024 halves peak usage for one extra grid level."""
     from apex1_tpu.core.capability import vmem_budget
 
-    def env_block(name):
-        # read at TRACE time: a sweep must use a fresh process (or clear
-        # the jit cache) per candidate — jit caches don't key on env vars
-        raw = os.environ.get(name, "").strip()
-        if not raw:
-            return None
-        try:
-            val = int(raw)
-        except ValueError:
-            raise ValueError(f"{name}={raw!r} is not an integer") from None
-        if val <= 0 or val % 16:
-            raise ValueError(f"{name} must be a positive multiple of 16 "
-                             f"(TPU sublane tiling), got {val}")
-        return val
-
     Dp = max(_LANES, ((D + _LANES - 1) // _LANES) * _LANES)
+    # env consulted ONLY for unresolved blocks: explicit arguments stay
+    # immune to a stale/malformed pin in the environment (the sweep
+    # driver passes explicit candidates and must not die on one)
+    env_q = _env_block("APEX1_ATTN_BLOCK_Q") if block_q is None else None
+    env_k = _env_block("APEX1_ATTN_BLOCK_K") if block_k is None else None
+    tuned = {}
+    if (block_q is None and env_q is None) or \
+            (block_k is None and env_k is None):
+        from apex1_tpu import tuning
+        tuned = tuning.lookup(
+            "flash_attention",
+            {"Dp": Dp, "Sb": tuning.seq_bucket(seq)}, dtype) or {}
     small_vmem = vmem_budget() < 12 * 2**20
+    default = 256 if (Dp > 512 or small_vmem) else 512
     if block_q is None:
-        block_q = env_block("APEX1_ATTN_BLOCK_Q") or (
-            256 if (Dp > 512 or small_vmem) else 512)
+        block_q = env_q or tuned.get("block_q") or default
     if block_k is None:
-        # 512 keeps the fp32 score tile at 1 MiB (bq=512): comfortably
-        # inside VMEM with double-buffered operands on every generation;
-        # the step from 1024 halves peak usage for one extra grid level.
-        # APEX1_ATTN_BLOCK_Q/K override for hardware sweeps without code
-        # edits (tools/bench_kernels.py measures the candidates).
-        block_k = env_block("APEX1_ATTN_BLOCK_K") or (
-            256 if (Dp > 512 or small_vmem) else 512)
+        block_k = env_k or tuned.get("block_k") or default
     return block_q, block_k
 
 
@@ -765,6 +784,14 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
     (≙ fmha's cu_seqlens varlen batches).
     ``q_offset``/``k_offset``: traced global-position offsets for the
     causal mask (used by ring/context parallelism; 0 for plain use).
+    ``block_q``/``block_k``: static kernel tile sizes. ``None`` (the
+    default) resolves via `apex1_tpu.tuning`: env override
+    (``APEX1_ATTN_BLOCK_Q/K``) > persisted tuning-table winner for this
+    (generation, dtype, padded head dim) > analytic heuristic. Explicit
+    values are honored verbatim — they are static arguments, so an
+    in-process sweep of N candidates (``tools/tune_kernels.py``)
+    compiles exactly N executables with no jit-cache
+    cross-contamination.
     ``return_lse``: also return the fp32 logsumexp (B, H, Sq) — needed to
     merge partial-attention results (ring attention).
     ``bias``: additive logit bias (1|B, 1|H, Sq, Sk) — T5-style relative
@@ -779,7 +806,8 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                          f"Hkv={k.shape[1]}")
     scale = (1.0 / float(np.sqrt(q.shape[-1]))
              if sm_scale is None else float(sm_scale))
-    block_q, block_k = _auto_blocks(q.shape[3], block_q, block_k)
+    block_q, block_k = _auto_blocks(q.shape[3], block_q, block_k, q.dtype,
+                                    k.shape[2])
     has_segs, qseg, kseg = _norm_segments(segment_ids, q.shape[2],
                                           k.shape[2])
     if bias is not None:

@@ -35,7 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex1_tpu.ops._common import (as_rows, interpret_mode, out_struct,
-                                   pad_to, row_block, use_pallas)
+                                   pad_to, use_pallas)
+from apex1_tpu.tuning import tuned_row_block
 
 
 # --------------------------------------------------------------------------
@@ -161,10 +162,12 @@ def _pallas_bwd(x2, gamma2, mean, rstd, dy2, true_h, rms, with_beta, br):
 # custom_vjp plumbing
 # --------------------------------------------------------------------------
 
-def _prep(x, gamma, beta):
+def _prep(x, gamma, beta, block_rows=None):
     x2, shape = as_rows(x)
     h = x2.shape[-1]
-    br = row_block(h, rows=x2.shape[0])  # computed ONCE; launchers take it
+    # computed ONCE; launchers take it. None = table > heuristic.
+    br = tuned_row_block("layer_norm", h, rows=x2.shape[0],
+                         dtype=x.dtype, requested=block_rows)
     x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
     g2 = pad_to(gamma.reshape(1, -1), 1, 128)[0]
@@ -172,21 +175,21 @@ def _prep(x, gamma, beta):
     return x2p, g2, b2, shape, h, rows, br
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fused_norm(x, gamma, beta, eps, rms):
-    return _fused_norm_fwd(x, gamma, beta, eps, rms)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_norm(x, gamma, beta, eps, rms, block_rows):
+    return _fused_norm_fwd(x, gamma, beta, eps, rms, block_rows)[0]
 
 
-def _fused_norm_fwd(x, gamma, beta, eps, rms):
-    x2p, g2, b2, shape, h, rows, br = _prep(x, gamma, beta)
+def _fused_norm_fwd(x, gamma, beta, eps, rms, block_rows):
+    x2p, g2, b2, shape, h, rows, br = _prep(x, gamma, beta, block_rows)
     y, mean, rstd = _pallas_fwd(x2p, g2, b2, eps, h, rms, br)
     y = y[:rows, :h].reshape(shape)
     return y, (x, gamma, beta, mean, rstd)
 
 
-def _fused_norm_bwd(eps, rms, res, dy):
+def _fused_norm_bwd(eps, rms, block_rows, res, dy):
     x, gamma, beta, mean, rstd = res
-    x2p, g2, _, shape, h, rows, br = _prep(x, gamma, beta)
+    x2p, g2, _, shape, h, rows, br = _prep(x, gamma, beta, block_rows)
     dy2, _ = as_rows(dy)
     dy2p, _ = pad_to(dy2, 0, br)
     dy2p, _ = pad_to(dy2p, 1, 128)
@@ -224,18 +227,22 @@ def _xla_norm(x, gamma, beta, eps, rms):
 # public API
 # --------------------------------------------------------------------------
 
-def layer_norm(x, gamma, beta, *, eps: float = 1e-5):
+def layer_norm(x, gamma, beta, *, eps: float = 1e-5,
+               block_rows: int | None = None):
     """Fused LayerNorm over the last axis. bf16/fp16 ``x`` with fp32 ``γ/β``
-    is the reference "MixedFused" path; output keeps ``x.dtype``."""
+    is the reference "MixedFused" path; output keeps ``x.dtype``.
+    ``block_rows``: static rows-per-grid-step; ``None`` resolves tuning
+    table > heuristic (`apex1_tpu.tuning.tuned_row_block`)."""
     if use_pallas():
-        return _fused_norm(x, gamma, beta, eps, False)
+        return _fused_norm(x, gamma, beta, eps, False, block_rows)
     return _xla_norm(x, gamma, beta, eps, False)
 
 
-def rms_norm(x, gamma, *, eps: float = 1e-6):
+def rms_norm(x, gamma, *, eps: float = 1e-6,
+             block_rows: int | None = None):
     """Fused RMSNorm (``FusedRMSNorm`` — stock torch lacked it)."""
     if use_pallas():
-        return _fused_norm(x, gamma, None, eps, True)
+        return _fused_norm(x, gamma, None, eps, True, block_rows)
     return _xla_norm(x, gamma, None, eps, True)
 
 

@@ -172,13 +172,25 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, off_ref, lse_ref, dl_ref,
         dw_ref[...] = dw_acc[...].astype(dw_ref.dtype)
 
 
-def _auto_blocks(Hp, block_t, block_v):
-    """Shrink default blocks so the fp32 accumulators (dx_acc (bt, Hp),
-    dw_acc (bv, Hp)) + operand blocks stay within ~a quarter of the
-    generation's VMEM budget (`core.capability.vmem_budget`) at large
-    hidden sizes (Llama-3 8B: H=4096; 70B: 8192). Explicitly requested
-    blocks are honored as-is."""
+def _auto_blocks(Hp, block_t, block_v, dtype=jnp.bfloat16):
+    """Resolve (block_t, block_v) with the documented precedence
+    (docs/ops.md): explicit argument > tuning-table winner
+    (`apex1_tpu.tuning`, keyed on generation x dtype x padded hidden)
+    > the analytic heuristic below.
+
+    The heuristic shrinks default blocks so the fp32 accumulators
+    (dx_acc (bt, Hp), dw_acc (bv, Hp)) + operand blocks stay within ~a
+    quarter of the generation's VMEM budget
+    (`core.capability.vmem_budget`) at large hidden sizes (Llama-3 8B:
+    H=4096; 70B: 8192). Explicitly requested blocks are honored
+    as-is."""
     from apex1_tpu.core.capability import vmem_budget
+    req_t, req_v = block_t, block_v  # caller-explicit (for the OOM warn)
+    if block_t is None or block_v is None:
+        from apex1_tpu import tuning
+        tuned = tuning.lookup("linear_xent", {"Hp": Hp}, dtype) or {}
+        block_t = block_t if block_t is not None else tuned.get("block_t")
+        block_v = block_v if block_v is not None else tuned.get("block_v")
     acc_budget = vmem_budget() // 4
     # BOTH fp32 accumulators (dx (bt, Hp) + dw (bv, Hp)) share the frame
     # with double-buffered operand tiles; bound their SUM, with the 3/4
@@ -192,15 +204,16 @@ def _auto_blocks(Hp, block_t, block_v):
         512, max(16, cap_total - bt))
     if bt + bv > cap_total:
         # only reachable when at least one block is EXPLICIT — auto
-        # sizing stays within cap_total. Warn (not clamp: the caller may
-        # know their generation better than the capability table) so a
-        # hardware OOM is attributable to the request, not to mis-sized
-        # defaults.
+        # sizing stays within cap_total and tuning-table entries are
+        # VMEM-validated against the same accumulator bound before the
+        # lookup serves them. Warn (not clamp: the caller may know their
+        # generation better than the capability table) so a hardware OOM
+        # is attributable to the request, not to mis-sized defaults.
         import warnings
         desc = " + ".join(
             f"{name}={val} ({'requested' if req is not None else 'auto'})"
-            for name, val, req in (("block_t", bt, block_t),
-                                   ("block_v", bv, block_v)))
+            for name, val, req in (("block_t", bt, req_t),
+                                   ("block_v", bv, req_v)))
         warnings.warn(
             f"linear_cross_entropy: {desc} exceed the AOT-verified VMEM "
             f"headroom ({cap_total} rows at Hp={Hp}) for this TPU "
@@ -213,7 +226,7 @@ def _prep(x2, weight, t2, block_t, block_v):
     T, H = x2.shape
     V = weight.shape[0]
     Hp = ((H + _LANES - 1) // _LANES) * _LANES
-    block_t, block_v = _auto_blocks(Hp, block_t, block_v)
+    block_t, block_v = _auto_blocks(Hp, block_t, block_v, x2.dtype)
     bt, bv = _blk(T, block_t), _blk(V, block_v)
     xp, _ = pad_to(x2, 0, bt)
     xp, _ = pad_to(xp, 1, _LANES)

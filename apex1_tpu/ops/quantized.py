@@ -137,16 +137,31 @@ def _aligned_for_kernel(T, N, K):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def int8_matmul(x, wq, scale, block_n: int = 256, block_k: int = 512):
+def int8_matmul(x, wq, scale, block_n: int | None = None,
+                block_k: int | None = None):
     """``y = x @ (wq * scale[:, None]).T`` — (T, K) @ (K, N) -> (T, N).
 
     ``x`` bf16/fp32 activations, ``wq`` int8 (N, K), ``scale`` fp32 (N,)
     (from :func:`quantize_int8`). fp32 accumulation; output fp32 (cast at
     the call site). Differentiable in ``x`` only — weight cotangents are
     zero (decode-time weights are frozen; quantization is not trained
-    through).
+    through). ``block_n``/``block_k``: static Pallas tile requests
+    (divisor-fitted to N/K); ``None`` resolves tuning-table winner for
+    this (generation, N, K) > the (256, 512) defaults.
     """
     return _int8_matmul_fwd(x, wq, scale, block_n, block_k)[0]
+
+
+def _resolve_blocks(N, K, block_n, block_k):
+    """Explicit request > tuning table (keyed on the weight dims — both
+    128-aligned by `_aligned_for_kernel`) > the (256, 512) defaults."""
+    if block_n is None or block_k is None:
+        from apex1_tpu import tuning
+        tuned = tuning.lookup("int8_matmul", {"N": N, "K": K},
+                              "int8") or {}
+        block_n = block_n if block_n is not None else tuned.get("block_n")
+        block_k = block_k if block_k is not None else tuned.get("block_k")
+    return block_n or 256, block_k or 512
 
 
 def _int8_matmul_fwd(x, wq, scale, block_n, block_k):
@@ -155,6 +170,7 @@ def _int8_matmul_fwd(x, wq, scale, block_n, block_k):
     N = wq.shape[0]
     x2 = x.reshape(-1, K)
     if use_pallas() and _aligned_for_kernel(x2.shape[0], N, K):
+        block_n, block_k = _resolve_blocks(N, K, block_n, block_k)
         x8 = x2
         if x8.shape[0] % 8:  # sublane-pad the (tiny) row dim
             pad = 8 - x8.shape[0] % 8

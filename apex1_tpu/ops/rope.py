@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (interpret_mode, out_struct, row_block,
-                                   use_pallas)
+from apex1_tpu.ops._common import interpret_mode, out_struct, use_pallas
+from apex1_tpu.tuning import tuned_row_block
 
 
 def rope_tables(positions, head_dim: int, *, base: float = 10000.0,
@@ -48,9 +48,11 @@ def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, o1_ref, o2_ref):
     o2_ref[...] = (x2 * c + x1 * s).astype(o2_ref.dtype)
 
 
-def _pallas_rope(x1, x2, cos_r, sin_r):
+def _pallas_rope(x1, x2, cos_r, sin_r, block_rows=None):
     rows, half = x1.shape
-    br = row_block(half, rows=rows)  # 4 ins + 2 outs double-buffered
+    # 4 ins + 2 outs double-buffered; None = table > heuristic
+    br = tuned_row_block("rope", half, rows=rows, dtype=x1.dtype,
+                         requested=block_rows)
     row = pl.BlockSpec((br, half), lambda i: (i, 0),
                        memory_space=pltpu.VMEM)
     return pl.pallas_call(
@@ -89,7 +91,7 @@ def _infer_seq_axis(x, seq_len: int) -> int:
         f"the cos/sin table length {seq_len}; pass seq_axis explicitly")
 
 
-def _apply(x, cos, sin, interleaved, seq_axis):
+def _apply(x, cos, sin, interleaved, seq_axis, block_rows=None):
     """Shared fwd path; bwd = fwd with −sin (rotation transpose)."""
     shape = x.shape
     half = shape[-1] // 2
@@ -109,7 +111,7 @@ def _apply(x, cos, sin, interleaved, seq_axis):
                          x1.shape).reshape(-1, half)
     if use_pallas() and half % 128 == 0:
         o1, o2 = _pallas_rope(x1.reshape(-1, half), x2.reshape(-1, half),
-                              c, s)
+                              c, s, block_rows)
         o1 = o1.reshape(x1.shape)
         o2 = o2.reshape(x2.shape)
     else:
@@ -122,31 +124,36 @@ def _apply(x, cos, sin, interleaved, seq_axis):
     return _merge(o1, o2, interleaved).reshape(shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _rope(x, cos, sin, interleaved, seq_axis):
-    return _apply(x, cos, sin, interleaved, seq_axis)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _rope(x, cos, sin, interleaved, seq_axis, block_rows):
+    return _apply(x, cos, sin, interleaved, seq_axis, block_rows)
 
 
-def _rope_fwd(x, cos, sin, interleaved, seq_axis):
-    return _apply(x, cos, sin, interleaved, seq_axis), (cos, sin)
+def _rope_fwd(x, cos, sin, interleaved, seq_axis, block_rows):
+    return _apply(x, cos, sin, interleaved, seq_axis, block_rows), \
+        (cos, sin)
 
 
-def _rope_bwd(interleaved, seq_axis, res, dy):
+def _rope_bwd(interleaved, seq_axis, block_rows, res, dy):
     cos, sin = res
-    return _apply(dy, cos, -sin, interleaved, seq_axis), None, None
+    return _apply(dy, cos, -sin, interleaved, seq_axis, block_rows), \
+        None, None
 
 
 _rope.defvjp(_rope_fwd, _rope_bwd)
 
 
 def apply_rotary_pos_emb(x, cos, sin, *, interleaved: bool = False,
-                         seq_axis: int | None = None):
+                         seq_axis: int | None = None,
+                         block_rows: int | None = None):
     """Apply RoPE. ``x``: (..., seq, heads, head_dim) or (..., seq,
     head_dim); ``cos/sin``: (seq, head_dim/2) from `rope_tables`, or
     (B, seq, head_dim/2) per-row tables for packed/varlen batches
     (positions restarting per segment — the reference's thd variant).
     The sequence axis is inferred from the table length (prefer -3, then
-    -2); pass ``seq_axis`` when ambiguous."""
+    -2); pass ``seq_axis`` when ambiguous. ``block_rows``: static
+    rows-per-grid-step; ``None`` resolves tuning table > heuristic
+    (`apex1_tpu.tuning.tuned_row_block`)."""
     if x.shape[-1] % 2:
         raise ValueError("head_dim must be even for RoPE")
     if cos.ndim == 3 and cos.shape[0] != x.shape[0]:
@@ -158,4 +165,4 @@ def apply_rotary_pos_emb(x, cos, sin, *, interleaved: bool = False,
         seq_axis = _infer_seq_axis(x, seq_len)
     else:
         seq_axis = seq_axis % x.ndim
-    return _rope(x, cos, sin, interleaved, seq_axis)
+    return _rope(x, cos, sin, interleaved, seq_axis, block_rows)

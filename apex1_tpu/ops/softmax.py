@@ -29,7 +29,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
-                                   pad_to, row_block, use_pallas)
+                                   pad_to, use_pallas)
+from apex1_tpu.tuning import tuned_row_block
 
 
 def _fwd_kernel(x_ref, mask_ref, y_ref, *, scale, causal, true_k):
@@ -132,15 +133,16 @@ def _mask4d(mask, x_shape4):
     return m
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _fused_softmax(x, mask, scale, causal):
-    return _fused_softmax_fwd(x, mask, scale, causal)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _fused_softmax(x, mask, scale, causal, block_rows):
+    return _fused_softmax_fwd(x, mask, scale, causal, block_rows)[0]
 
 
-def _fused_softmax_fwd(x, mask, scale, causal):
+def _fused_softmax_fwd(x, mask, scale, causal, block_rows):
     x4, shape = _as4d(x)
     true_k = x4.shape[-1]
-    bq = row_block(x4.shape[3], rows=x4.shape[2])
+    bq = tuned_row_block("fused_softmax", x4.shape[3], rows=x4.shape[2],
+                         dtype=x.dtype, requested=block_rows)
     x4p, sq = pad_to(x4, 2, bq)
     x4p, _ = pad_to(x4p, 3, 128)
     if mask is not None:
@@ -156,10 +158,11 @@ def _fused_softmax_fwd(x, mask, scale, causal):
     return y, y
 
 
-def _fused_softmax_bwd(scale, causal, y, dy):
+def _fused_softmax_bwd(scale, causal, block_rows, y, dy):
     y2 = y.reshape(-1, y.shape[-1])
     true_k = y2.shape[1]
-    bq = row_block(y2.shape[1], rows=y2.shape[0])
+    bq = tuned_row_block("fused_softmax", y2.shape[1], rows=y2.shape[0],
+                         dtype=y.dtype, requested=block_rows)
     y2p, rows = pad_to(y2, 0, bq)
     y2p, _ = pad_to(y2p, 1, 128)
     dy2 = dy.reshape(-1, dy.shape[-1])
@@ -185,23 +188,26 @@ def _xla_softmax(x, mask, scale, causal):
     return jax.nn.softmax(x32, axis=-1).astype(x.dtype)
 
 
-def scaled_masked_softmax(x, mask=None, *, scale: float = 1.0):
+def scaled_masked_softmax(x, mask=None, *, scale: float = 1.0,
+                          block_rows: int | None = None):
     """``scaled_masked_softmax_cuda`` equivalent.
 
     ``x``: (..., sq, sk) attention scores; ``mask``: additive mask
     broadcastable to ``x`` (use large negative values for masked positions,
     e.g. ``ops.NEG_INF``) — broadcast dims stay size-1 all the way into the
-    kernel.
+    kernel. ``block_rows``: static rows-per-grid-step; ``None`` resolves
+    tuning table > heuristic (`apex1_tpu.tuning.tuned_row_block`).
     """
     if use_pallas():
-        return _fused_softmax(x, mask, float(scale), False)
+        return _fused_softmax(x, mask, float(scale), False, block_rows)
     return _xla_softmax(x, mask, scale, False)
 
 
-def scaled_upper_triang_masked_softmax(x, *, scale: float = 1.0):
+def scaled_upper_triang_masked_softmax(x, *, scale: float = 1.0,
+                                       block_rows: int | None = None):
     """``scaled_upper_triang_masked_softmax_cuda`` equivalent (causal)."""
     if use_pallas():
-        return _fused_softmax(x, None, float(scale), True)
+        return _fused_softmax(x, None, float(scale), True, block_rows)
     return _xla_softmax(x, None, scale, True)
 
 

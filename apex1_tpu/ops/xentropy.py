@@ -28,7 +28,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
-                                   pad_to, row_block, use_pallas)
+                                   pad_to, use_pallas)
+from apex1_tpu.tuning import tuned_row_block
 
 
 
@@ -77,18 +78,21 @@ def _specs(k, br):
     return row, stat
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _fused_xent(logits, labels, smoothing, padding_idx, num_classes):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _fused_xent(logits, labels, smoothing, padding_idx, num_classes,
+                block_rows):
     return _fused_xent_fwd(logits, labels, smoothing, padding_idx,
-                           num_classes)[0]
+                           num_classes, block_rows)[0]
 
 
-def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes):
+def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes,
+                    block_rows):
     shape = logits.shape
     k = shape[-1] if num_classes is None else num_classes
     x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
-    br = row_block(x2.shape[1], rows=x2.shape[0])
+    br = tuned_row_block("xentropy", x2.shape[1], rows=x2.shape[0],
+                         dtype=logits.dtype, requested=block_rows)
     x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
     t2p, _ = pad_to(t2, 0, br, value=-1)
@@ -107,14 +111,16 @@ def _fused_xent_fwd(logits, labels, smoothing, padding_idx, num_classes):
     return loss, (logits, labels, lse)
 
 
-def _fused_xent_bwd(smoothing, padding_idx, num_classes, res, dloss):
+def _fused_xent_bwd(smoothing, padding_idx, num_classes, block_rows, res,
+                    dloss):
     logits, labels, lse = res
     shape = logits.shape
     k = shape[-1] if num_classes is None else num_classes
     x2 = logits.reshape(-1, shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
     d2 = dloss.reshape(-1, 1).astype(jnp.float32)
-    br = row_block(x2.shape[1], rows=x2.shape[0])
+    br = tuned_row_block("xentropy", x2.shape[1], rows=x2.shape[0],
+                         dtype=logits.dtype, requested=block_rows)
     x2p, rows = pad_to(x2, 0, br)
     x2p, _ = pad_to(x2p, 1, 128)
     t2p, _ = pad_to(t2, 0, br, value=-1)
@@ -153,7 +159,8 @@ def _xla_xent(logits, labels, smoothing, padding_idx, num_classes=None):
 
 def softmax_cross_entropy_loss(logits, labels, *, smoothing: float = 0.0,
                                padding_idx: int | None = None,
-                               num_classes: int | None = None):
+                               num_classes: int | None = None,
+                               block_rows: int | None = None):
     """``apex.contrib.xentropy.SoftmaxCrossEntropyLoss.apply(logits, labels,
     smoothing, padding_idx, half_to_float)`` equivalent.
 
@@ -162,6 +169,8 @@ def softmax_cross_entropy_loss(logits, labels, *, smoothing: float = 0.0,
     ``num_classes``: treat only the first N logit columns as real classes —
     lets callers keep Megatron-style lane-padded vocab logits (the extra
     columns are masked in-kernel, no slice copy; their grads are zero).
+    ``block_rows``: static rows-per-grid-step; ``None`` resolves tuning
+    table > heuristic (`apex1_tpu.tuning.tuned_row_block`).
     """
     if num_classes is not None and not (
             0 < num_classes <= logits.shape[-1]):
@@ -169,7 +178,7 @@ def softmax_cross_entropy_loss(logits, labels, *, smoothing: float = 0.0,
                          f"(0, {logits.shape[-1]}]")
     if use_pallas():
         return _fused_xent(logits, labels, float(smoothing), padding_idx,
-                           num_classes)
+                           num_classes, block_rows)
     return _xla_xent(logits, labels, smoothing, padding_idx, num_classes)
 
 

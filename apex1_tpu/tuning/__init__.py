@@ -1,0 +1,85 @@
+"""Shape-keyed kernel autotuning — persisted tables + in-process sweeps.
+
+The reference answers per-hardware kernel specialization with per-SM
+builds (``csrc/fmha`` compiles one kernel per compute capability); the
+TPU-native answer is DATA: measured block-size winners keyed on
+
+    kernel x TPU generation x dtype x padded dims
+
+persisted under ``perf_results/tuning/`` and consulted by every Pallas
+entry point at trace time. Selection precedence at each op:
+
+    explicit block argument            (the sweep mechanism)
+    > documented env override          (``APEX1_ATTN_BLOCK_Q/K`` only)
+    > tuning-table winner              (this package)
+    > analytic heuristic               (``_auto_blocks`` / ``row_block``)
+
+With no tables on disk every op reproduces the analytic heuristic's
+choices bit-for-bit (pinned by ``tests/test_tuning.py``).
+
+Because block sizes are static kernel arguments, a sweep of N candidates
+runs in ONE process — the jit cache keys on the block values, so each
+candidate compiles exactly one executable and candidates never
+cross-contaminate (the old env-var overrides were read at trace time,
+which forced a fresh process and a cold compile of everything per
+candidate). ``tools/tune_kernels.py`` is the sweep driver; it measures
+on the live backend, records winners here, and persists them.
+
+Caveat for same-process consumers: a lookup resolved during an earlier
+trace is baked into that executable — after recording new winners, call
+``jax.clear_caches()`` (the sweep driver does) before re-tracing ops
+that consult the table without explicit blocks.
+"""
+
+from __future__ import annotations
+
+from apex1_tpu.tuning.registry import SPECS, KernelSpec  # noqa: F401
+from apex1_tpu.tuning.table import (canonical_dtype,  # noqa: F401
+                                    canonical_generation, clear_cache,
+                                    default_tuning_dir, load_problems,
+                                    lookup, make_key, parse_key, record,
+                                    save, validate_tables)
+
+
+def padded_lanes(lanes: int) -> int:
+    """Last-dim size padded to the 128-lane multiple the kernels see."""
+    return max(128, ((lanes + 127) // 128) * 128)
+
+
+def seq_bucket(seq: int) -> int:
+    """Power-of-two bucket (>= 128) for sequence-keyed tuning dims.
+    Optimal flash blocks depend strongly on sequence length (grid size,
+    causal-skip share, VMEM reuse), so winners are keyed to the bucket
+    they were MEASURED at — a 1k-seq winner never silently governs a
+    16k-seq program; unmeasured buckets fall through to the heuristic."""
+    b = 128
+    while b < seq:
+        b *= 2
+    return b
+
+
+def tuned_row_block(kernel: str, lanes: int, *, rows: int | None = None,
+                    dtype=None, requested: int | None = None) -> int:
+    """Rows-per-grid-step for the row-wise kernels (softmax, layer/rms
+    norm, rope, xentropy): explicit ``requested`` > tuning table
+    (keyed on the PADDED lane count) > ``ops._common.row_block``.
+
+    Tuned values get the same actual-row-count clamp as the heuristic so
+    a winner swept at production scale never pads a tiny input up to
+    dead work; explicit requests are honored verbatim (the sweep driver
+    owns them).
+    """
+    # lazy: the ops modules import this one at module scope (the reverse
+    # edge would be a cycle)
+    from apex1_tpu.ops._common import row_block
+
+    if requested is not None:
+        return int(requested)
+    tuned = lookup(kernel, {"lanes": padded_lanes(lanes)},
+                   "float32" if dtype is None else dtype)
+    if tuned is not None:
+        br = tuned["block_rows"]
+        if rows is not None:
+            br = min(br, max(8, ((rows + 7) // 8) * 8))
+        return max(8, br)
+    return row_block(lanes, rows=rows)

@@ -1,0 +1,124 @@
+"""Tunable-kernel registry — the schema half of the tuning layer.
+
+One :class:`KernelSpec` per Pallas entry point declares
+
+- which **block parameters** the kernel takes as static arguments
+  (``block_q``/``block_k``, ``block_rows``, ...);
+- which **padded dims** key its tuning-table entries (the dims that
+  actually change the block-planning problem — padded lane/head sizes,
+  never raw batch counts);
+- the parameter **alignment** the TPU sublane tiling demands; and
+- a **VMEM cost model**: a coarse, monotone-in-blocks upper bound on the
+  kernel's VMEM frame (double-buffered operand blocks + fp32 scratch +
+  live score tiles). Table entries whose recorded blocks exceed the
+  recorded generation's ``core.capability.vmem_budget`` under this model
+  are rejected at lookup time — a stale entry swept on a bigger chip can
+  never push a smaller chip into a Mosaic VMEM OOM; the analytic
+  heuristics (``ops/attention._auto_blocks``, ``ops/_common.row_block``,
+  ``ops/linear_xent._auto_blocks``) take over instead.
+
+The models are GATING models, not performance models: generous enough
+that every block shape the analytic heuristics produce passes, tight
+enough that the shapes AOT analysis showed OOMing do not. Measured
+preference between valid candidates comes from ``tools/tune_kernels.py``.
+
+Adding a tunable kernel (see docs/ops.md "Block-size tuning"):
+
+1. thread the block sizes as explicit static arguments through the op's
+   public entry point (``None`` = consult the table);
+2. add a :class:`KernelSpec` here with the padded-dims key and a VMEM
+   model;
+3. add a sweep case to ``tools/tune_kernels.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping
+
+# fp32 scratch/statistics lanes — every row-stat scratch buffer is
+# (rows, 128) fp32 regardless of input dtype
+_LANES = 128
+_DB = 2  # Pallas double-buffers every blocked operand
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """Declarative description of one tunable Pallas kernel."""
+
+    name: str
+    params: tuple[str, ...]       # block parameters, in canonical order
+    dims: tuple[str, ...]         # padded dims that key table entries
+    align: int                    # every block must be a multiple of this
+    # (blocks, dims, esize, budget_bytes) -> (fits, estimated_bytes)
+    check: Callable[[Mapping[str, int], Mapping[str, int], int, int],
+                    tuple[bool, int]]
+
+
+def _flash_check(blocks, dims, es, budget):
+    """Flash attention frame: q/k/v/o blocks (double-buffered, input
+    dtype), fp32 (acc, m, l) scratch, and the live fp32 score + exp
+    tiles (bq, bk) the MXU step materializes in vregs/VMEM."""
+    bq, bk = blocks["block_q"], blocks["block_k"]
+    dp = dims["Dp"]
+    est = (_DB * es * (bq * dp + 2 * bk * dp)      # q, k, v in
+           + _DB * es * bq * dp                    # o out
+           + 4 * (bq * dp + 2 * bq * _LANES)       # acc, m, l scratch
+           + 2 * 4 * bq * bk)                      # s and e tiles
+    return est <= budget, est
+
+
+def _row_check(n_passes):
+    """Row-wise kernels (softmax/LN/xentropy/rope): ``n_passes`` row-block
+    operands of (br, lanes_p), double-buffered, priced fp32 (compute is
+    fp32 even for bf16 inputs)."""
+    def check(blocks, dims, _es, budget):
+        br = blocks["block_rows"]
+        est = n_passes * _DB * br * dims["lanes"] * 4
+        return est <= budget, est
+    return check
+
+
+def _linear_xent_check(blocks, dims, es, budget):
+    """Fused LM-head CE: the binding constraint is the AOT-established
+    accumulator bound (``ops/linear_xent._auto_blocks``): the fp32
+    dx (bt, Hp) + dw (bv, Hp) accumulators must fit 3/4 of a quarter of
+    the VMEM budget; the double-buffered operand blocks and the live
+    (bt, bv) logit tile are additionally bounded by the full budget."""
+    bt, bv = blocks["block_t"], blocks["block_v"]
+    hp = dims["Hp"]
+    acc = 4 * (bt + bv) * hp
+    est = (acc + _DB * es * (bt + bv) * hp + 2 * 4 * bt * bv)
+    ok = est <= budget and acc <= (budget // 4) * 3 // 4
+    return ok, est
+
+
+def _int8_check(blocks, dims, _es, budget):
+    """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
+    ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
+    (double-buffered), fp32 out block + scales."""
+    bn, bk = blocks["block_n"], blocks["block_k"]
+    t = 1024
+    est = (_DB * (t * bk * 2 + bn * bk * 1 + bn * 4) + t * bn * 4)
+    return est <= budget, est
+
+
+SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
+    # Sb: power-of-two seq bucket (tuning.seq_bucket) — block preference
+    # varies with seq length, so winners never cross shape classes
+    KernelSpec("flash_attention", ("block_q", "block_k"), ("Dp", "Sb"),
+               16, _flash_check),
+    KernelSpec("fused_softmax", ("block_rows",), ("lanes",), 8,
+               _row_check(3)),                     # y, dy, dx row blocks
+    KernelSpec("layer_norm", ("block_rows",), ("lanes",), 8,
+               _row_check(5)),                     # x, dy, dx + dg/db acc
+    KernelSpec("rope", ("block_rows",), ("lanes",), 8,
+               _row_check(6)),                     # x1, x2, cos, sin, o1, o2
+    KernelSpec("xentropy", ("block_rows",), ("lanes",), 8,
+               _row_check(2)),                     # x in, dx out (stats
+                                                   # are (br, 1) noise)
+    KernelSpec("linear_xent", ("block_t", "block_v"), ("Hp",), 16,
+               _linear_xent_check),
+    KernelSpec("int8_matmul", ("block_n", "block_k"), ("N", "K"), 128,
+               _int8_check),
+)}

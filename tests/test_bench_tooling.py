@@ -11,6 +11,7 @@ assessment).
 
 import importlib.util
 import json
+import os
 import pathlib
 
 import pytest
@@ -151,3 +152,81 @@ class TestMeasuredVsPredicted:
         assert gpt2 and "(no result)" in gpt2[0]
         bert = [l for l in text.splitlines() if l.startswith("| bert ")]
         assert bert and "(no result)" in bert[0]
+
+
+class TestRooflineRatio:
+    """bench.py's roofline surface: `predicted` + `roofline_ratio` ride
+    every record with a real value (incl. the best_banked pointer), from
+    the newest banked predicted_*.json priced at the current chip."""
+
+    def _predictions(self, tmp_path, flops=197e12, nbytes=819e9,
+                     units=16384):
+        res = tmp_path / "perf_results"
+        res.mkdir(exist_ok=True)
+        (res / "predicted_r5.json").write_text(json.dumps({
+            "steps": [{"name": "gpt2", "units_per_step": units,
+                       "flops": flops, "bytes": nbytes}]}))
+        return str(res)
+
+    def test_predicted_rate_roofline_math(self, bench_mod, tmp_path):
+        res = self._predictions(tmp_path)
+        # off-TPU capability defaults to the v5e row (197 TF, 819 GB/s):
+        # t_pred = max(1.0, 1.0) = 1 s -> units/sec == units_per_step
+        assert bench_mod._predicted_rate("gpt2", res) == \
+            pytest.approx(16384.0)
+
+    def test_attach_ratio(self, bench_mod, tmp_path):
+        res = self._predictions(tmp_path)
+        rec = bench_mod._attach_roofline(
+            {"metric": "m [tpu]", "value": 8192.0}, "gpt2", res)
+        assert rec["predicted"] == pytest.approx(16384.0)
+        assert rec["roofline_ratio"] == pytest.approx(0.5)
+
+    def test_no_ratio_on_zero_value_or_missing_table(self, bench_mod,
+                                                     tmp_path):
+        res = self._predictions(tmp_path)
+        rec = bench_mod._attach_roofline({"value": 0.0}, "gpt2", res)
+        assert "roofline_ratio" not in rec and "predicted" not in rec
+        # unknown config / empty results dir: record passes through
+        assert bench_mod._attach_roofline(
+            {"value": 5.0}, "nope", res) == {"value": 5.0}
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert bench_mod._predicted_rate("gpt2", str(empty)) is None
+
+
+    def test_no_ratio_on_cpu_smoke_records(self, bench_mod, tmp_path):
+        # cpu smoke runs measure tiny auto-shrunk shapes — a ratio vs
+        # the accelerator-shape prediction would be noise
+        res = self._predictions(tmp_path)
+        rec = bench_mod._attach_roofline(
+            {"metric": "m [cpu]", "value": 9.0}, "gpt2", res)
+        assert "roofline_ratio" not in rec
+
+
+    def test_newest_prediction_table_by_mtime(self, bench_mod,
+                                              tmp_path):
+        # lexicographic order would pick r9 over r10; mtime must win
+        res = tmp_path / "perf_results"
+        res.mkdir()
+        old = res / "predicted_r9.json"
+        new = res / "predicted_r10.json"
+        old.write_text(json.dumps({"steps": [
+            {"name": "gpt2", "units_per_step": 1,
+             "flops": 197e12, "bytes": 1.0}]}))
+        new.write_text(json.dumps({"steps": [
+            {"name": "gpt2", "units_per_step": 2,
+             "flops": 197e12, "bytes": 1.0}]}))
+        os.utime(old, (1_000_000, 1_000_000))
+        os.utime(new, (2_000_000, 2_000_000))
+        assert bench_mod._predicted_rate("gpt2", str(res)) == \
+            pytest.approx(2.0)
+
+    def test_garbage_prediction_file_never_raises(self, bench_mod,
+                                                  tmp_path):
+        res = tmp_path / "perf_results"
+        res.mkdir()
+        (res / "predicted_r9.json").write_text("{broken")
+        rec = bench_mod._attach_roofline({"value": 7.0}, "gpt2",
+                                         str(res))
+        assert rec == {"value": 7.0}

@@ -1,0 +1,350 @@
+"""Tests for the shape-keyed kernel autotuner (`apex1_tpu.tuning`).
+
+Covers the acceptance surface of the tuning layer:
+
+- table lookup / miss / fallback to the analytic heuristics;
+- key normalization (padded dims, dtype spellings, capability
+  generation scoping);
+- VMEM-budget validity: over-budget or misaligned entries are rejected
+  at lookup AND flagged by the strict `validate_tables` gate;
+- round-trip persistence (record -> save -> reload -> lookup);
+- the EMPTY-TABLE bit-for-bit pin: with no tables, every op resolves
+  exactly the legacy heuristic blocks (the "today's choices" contract);
+- precedence: explicit arg > APEX1_ATTN_BLOCK_* env > table > heuristic;
+- the trace-counter proof that an in-process two-candidate sweep
+  compiles exactly two executables with no jit-cache
+  cross-contamination (the property that makes `tools/tune_kernels.py`
+  fit a hardware window);
+- the sweep driver itself on the CPU backend (interpret-mode plumbing).
+"""
+
+import functools
+import importlib.util
+import json
+import os
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu import tuning
+from apex1_tpu.ops._common import force_impl, row_block
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture()
+def tables_dir(tmp_path, monkeypatch):
+    """Point the tuning layer at an isolated (initially EMPTY) dir."""
+    monkeypatch.setenv("APEX1_TUNING_DIR", str(tmp_path))
+    tuning.clear_cache()
+    yield tmp_path
+    tuning.clear_cache()
+
+
+# --------------------------------------------------------------------------
+# table core: lookup / miss / persistence / keys
+# --------------------------------------------------------------------------
+
+class TestTable:
+    def test_miss_on_empty_dir(self, tables_dir):
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             "bfloat16") is None
+
+    def test_record_lookup_roundtrip_persistence(self, tables_dir):
+        blocks = {"block_q": 256, "block_k": 512}
+        key, entry = tuning.record("flash_attention", {"Dp": 128},
+                                   jnp.bfloat16, blocks, time_ms=1.25)
+        assert key == "v5e|bfloat16|Dp=128"
+        assert entry["timing"] == "interpret"  # swept off-TPU
+        # in-memory visibility before any save
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             "bfloat16") == blocks
+        path = tuning.save("flash_attention")
+        tuning.clear_cache()  # force a reload from disk
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             jnp.bfloat16) == blocks
+        doc = json.loads(pathlib.Path(path).read_text())
+        assert doc["schema"] == 1 and doc["kernel"] == "flash_attention"
+        assert doc["entries"][key]["blocks"] == blocks
+
+    def test_save_merges_with_entries_on_disk(self, tables_dir):
+        tuning.record("layer_norm", {"lanes": 768}, "bfloat16",
+                      {"block_rows": 128})
+        tuning.save("layer_norm")
+        tuning.clear_cache()
+        tuning.record("layer_norm", {"lanes": 2048}, "bfloat16",
+                      {"block_rows": 64})
+        tuning.save("layer_norm")
+        tuning.clear_cache()
+        assert tuning.lookup("layer_norm", {"lanes": 768},
+                             "bfloat16") == {"block_rows": 128}
+        assert tuning.lookup("layer_norm", {"lanes": 2048},
+                             "bfloat16") == {"block_rows": 64}
+
+    def test_key_normalization(self, tables_dir):
+        # dims sorted by name; dtype spellings canonicalized; off-TPU
+        # generation defaults to the v5e planning row
+        k1 = tuning.make_key({"N": 2048, "K": 1024}, "int8")
+        assert k1 == "v5e|int8|K=1024,N=2048"
+        assert tuning.make_key({"Dp": 128}, jnp.bfloat16) == \
+            tuning.make_key({"Dp": 128}, np.dtype("bfloat16")) == \
+            tuning.make_key({"Dp": 128}, "bfloat16")
+        # round trip
+        gen, dt, dims = tuning.parse_key(k1)
+        assert (gen, dt, dims) == ("v5e", "int8",
+                                   {"K": 1024, "N": 2048})
+        # different dtype / dims / generation -> different keys
+        assert tuning.make_key({"Dp": 128}, "float32") != \
+            tuning.make_key({"Dp": 128}, "bfloat16")
+        assert tuning.make_key({"Dp": 256}, "bfloat16") != \
+            tuning.make_key({"Dp": 128}, "bfloat16")
+        assert tuning.make_key({"Dp": 128}, "bfloat16", "v5p") != \
+            tuning.make_key({"Dp": 128}, "bfloat16")
+
+    def test_generation_scoping(self, tables_dir):
+        tuning.record("flash_attention", {"Dp": 128}, "bfloat16",
+                      {"block_q": 1024, "block_k": 512},
+                      generation="v5p")
+        # v5p winner must not leak to the (default) v5e lookup
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             "bfloat16") is None
+        assert tuning.lookup("flash_attention", {"Dp": 128}, "bfloat16",
+                             generation="v5p") == \
+            {"block_q": 1024, "block_k": 512}
+
+    def test_corrupt_file_is_a_miss_not_a_crash(self, tables_dir):
+        (tables_dir / "flash_attention.json").write_text("{not json")
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             "bfloat16") is None
+        assert any("flash_attention" in p for p in tuning.load_problems())
+
+
+# --------------------------------------------------------------------------
+# VMEM-budget validity
+# --------------------------------------------------------------------------
+
+class TestVmemValidity:
+    def test_over_budget_entry_rejected_at_lookup(self, tables_dir):
+        # (4096, 4096) fp32 score tiles alone are ~128 MiB — far over
+        # any generation's budget; the entry must be a miss and the op
+        # must fall back to the heuristic
+        tuning.record("flash_attention", {"Dp": 128, "Sb": 128},
+                      "bfloat16", {"block_q": 4096, "block_k": 4096})
+        assert tuning.lookup("flash_attention", {"Dp": 128, "Sb": 128},
+                             "bfloat16") is None
+        from apex1_tpu.ops.attention import _auto_blocks
+        assert _auto_blocks(64, None, None, jnp.bfloat16) == (512, 512)
+
+    def test_linear_xent_accumulator_bound(self, tables_dir):
+        # the AOT-established bound: fp32 dx+dw accumulators must fit
+        # 3/4 of a quarter of VMEM — (512, 1024) at Hp=768 exceeds it
+        tuning.record("linear_xent", {"Hp": 768}, "bfloat16",
+                      {"block_t": 512, "block_v": 1024})
+        assert tuning.lookup("linear_xent", {"Hp": 768},
+                             "bfloat16") is None
+        tuning.record("linear_xent", {"Hp": 768}, "bfloat16",
+                      {"block_t": 512, "block_v": 512})
+        assert tuning.lookup("linear_xent", {"Hp": 768}, "bfloat16") == \
+            {"block_t": 512, "block_v": 512}
+
+    def test_misaligned_blocks_rejected(self, tables_dir):
+        tuning.record("flash_attention", {"Dp": 128}, "bfloat16",
+                      {"block_q": 100, "block_k": 512})  # 100 % 16 != 0
+        assert tuning.lookup("flash_attention", {"Dp": 128},
+                             "bfloat16") is None
+
+    def test_validate_tables_flags_bad_entries(self, tables_dir):
+        # over-budget entry, written to disk
+        tuning.record("flash_attention", {"Dp": 128, "Sb": 4096},
+                      "bfloat16", {"block_q": 4096, "block_k": 4096})
+        tuning.save("flash_attention")
+        # unknown kernel file + corrupt file + bad key
+        (tables_dir / "warp_speed.json").write_text(
+            '{"schema": 1, "kernel": "warp_speed", "entries": {}}')
+        (tables_dir / "layer_norm.json").write_text("{not json")
+        (tables_dir / "rope.json").write_text(json.dumps(
+            {"schema": 1, "kernel": "rope",
+             "entries": {"garbage-key": {"blocks": {"block_rows": 64}}}}))
+        problems = tuning.validate_tables(str(tables_dir))
+        assert len(problems) == 4
+        joined = "\n".join(problems)
+        for frag in ("flash_attention", "warp_speed", "layer_norm",
+                     "rope"):
+            assert frag in joined
+
+    def test_validate_tables_clean(self, tables_dir):
+        tuning.record("xentropy", {"lanes": 50432}, "float32",
+                      {"block_rows": 8})
+        tuning.save("xentropy")
+        assert tuning.validate_tables(str(tables_dir)) == []
+        assert tuning.validate_tables(str(tables_dir / "nope")) == []
+
+
+# --------------------------------------------------------------------------
+# empty-table bit-for-bit pins + precedence
+# --------------------------------------------------------------------------
+
+class TestResolution:
+    def test_empty_table_reproduces_heuristics(self, tables_dir):
+        """With NO tables, every op's resolver must return exactly the
+        legacy analytic choices (the acceptance pin)."""
+        from apex1_tpu.ops import attention, linear_xent, quantized
+
+        # flash attention: 512x512 default; 256 at Dp > 512
+        assert attention._auto_blocks(64, None, None) == (512, 512)
+        assert attention._auto_blocks(128, None, None) == (512, 512)
+        assert attention._auto_blocks(640, None, None) == (256, 256)
+        # row kernels delegate to ops._common.row_block unchanged
+        for lanes, rows in ((768, 8192), (1024, 1024), (50432, 8184),
+                            (128, 32)):
+            for kern in ("fused_softmax", "layer_norm", "rope",
+                         "xentropy"):
+                assert tuning.tuned_row_block(kern, lanes, rows=rows) \
+                    == row_block(lanes, rows=rows)
+        # pin the absolute values too (heuristic drift would silently
+        # retarget every kernel)
+        assert row_block(1024, rows=1024) == 256
+        assert row_block(50432, rows=8184) == 8
+        # fused LM-head CE
+        assert linear_xent._auto_blocks(768, None, None) == (256, 512)
+        assert linear_xent._auto_blocks(4096, None, None) == (64, 128)
+        # int8 decode GEMM
+        assert quantized._resolve_blocks(2048, 2048, None, None) == \
+            (256, 512)
+
+    def test_table_feeds_attention_and_linear_xent(self, tables_dir):
+        from apex1_tpu.ops import attention, linear_xent
+
+        tuning.record("flash_attention", {"Dp": 128, "Sb": 128},
+                      "bfloat16", {"block_q": 256, "block_k": 128})
+        tuning.record("linear_xent", {"Hp": 768}, "bfloat16",
+                      {"block_t": 128, "block_v": 256})
+        assert attention._auto_blocks(64, None, None, jnp.bfloat16) == \
+            (256, 128)
+        # dtype scoping: fp32 lookups miss the bf16 entry
+        assert attention._auto_blocks(64, None, None, jnp.float32) == \
+            (512, 512)
+        # SEQ scoping: the 128-bucket winner must not govern other
+        # buckets (a 1k winner never silently drives a 16k program)
+        assert attention._auto_blocks(64, None, None, jnp.bfloat16,
+                                      seq=16384) == (512, 512)
+        assert tuning.seq_bucket(16384) == 16384
+        assert tuning.seq_bucket(1025) == 2048
+        assert tuning.seq_bucket(64) == 128
+        assert linear_xent._auto_blocks(768, None, None, jnp.bfloat16) \
+            == (128, 256)
+        # explicit args always win
+        assert attention._auto_blocks(64, 512, None, jnp.bfloat16) == \
+            (512, 128)
+
+    def test_env_beats_table_explicit_beats_env(self, tables_dir,
+                                                monkeypatch):
+        from apex1_tpu.ops import attention
+
+        tuning.record("flash_attention", {"Dp": 128, "Sb": 128},
+                      "bfloat16", {"block_q": 256, "block_k": 256})
+        monkeypatch.setenv("APEX1_ATTN_BLOCK_Q", "128")
+        assert attention._auto_blocks(64, None, None, jnp.bfloat16) == \
+            (128, 256)   # env wins q; table still fills k
+        assert attention._auto_blocks(64, 512, None, jnp.bfloat16) == \
+            (512, 256)   # explicit beats env
+        monkeypatch.setenv("APEX1_ATTN_BLOCK_Q", "100")
+        with pytest.raises(ValueError, match="multiple of 16"):
+            attention._auto_blocks(64, None, None, jnp.bfloat16)
+
+
+    def test_explicit_blocks_immune_to_malformed_env(self, tables_dir,
+                                                     monkeypatch):
+        # a stale/typoed pin must not break explicit-block callers (the
+        # sweep driver passes explicit candidates)
+        from apex1_tpu.ops import attention
+
+        monkeypatch.setenv("APEX1_ATTN_BLOCK_Q", "not-a-number")
+        monkeypatch.setenv("APEX1_ATTN_BLOCK_K", "100")
+        assert attention._auto_blocks(64, 256, 128, jnp.bfloat16) == \
+            (256, 128)
+        with pytest.raises(ValueError):
+            attention._auto_blocks(64, None, 128, jnp.bfloat16)
+
+    def test_tuned_row_block_clamps_to_rows(self, tables_dir):
+        tuning.record("layer_norm", {"lanes": 768}, "bfloat16",
+                      {"block_rows": 512})
+        # production-scale winner must not pad a 20-row input to 512
+        assert tuning.tuned_row_block("layer_norm", 768, rows=20,
+                                      dtype="bfloat16") == 24
+        assert tuning.tuned_row_block("layer_norm", 768, rows=8192,
+                                      dtype="bfloat16") == 512
+        # explicit request is honored verbatim
+        assert tuning.tuned_row_block("layer_norm", 768, rows=20,
+                                      dtype="bfloat16",
+                                      requested=64) == 64
+
+
+# --------------------------------------------------------------------------
+# the in-process sweep property
+# --------------------------------------------------------------------------
+
+class TestInProcessSweep:
+    def test_two_candidate_sweep_compiles_exactly_two(self, tables_dir,
+                                                      rng):
+        """A two-candidate block sweep traces exactly twice (one
+        executable per candidate) and repeated calls hit the jit cache
+        with NO cross-contamination — the property that lets a full
+        sweep fit one process/window."""
+        from apex1_tpu.ops.attention import flash_attention
+
+        q = jnp.asarray(rng.normal(size=(1, 2, 128, 64)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(1, 2, 128, 64)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(1, 2, 128, 64)), jnp.bfloat16)
+        traces = []
+
+        @functools.partial(jax.jit, static_argnames=("bq", "bk"))
+        def run(q, k, v, bq, bk):
+            traces.append((bq, bk))  # trace-time counter
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=bq, block_k=bk)
+
+        with force_impl("pallas"):
+            a1 = np.asarray(run(q, k, v, 16, 16))
+            b1 = np.asarray(run(q, k, v, 32, 32))
+            # back to candidate 1: must be a cache hit serving candidate
+            # 1's executable, not candidate 2's
+            a2 = np.asarray(run(q, k, v, 16, 16))
+            b2 = np.asarray(run(q, k, v, 32, 32))
+        assert traces == [(16, 16), (32, 32)]
+        np.testing.assert_array_equal(a1, a2)
+        np.testing.assert_array_equal(b1, b2)
+        # both candidates computed the same attention (parity across
+        # blocks), so the two executables are distinguishable only by
+        # the trace counter — which is the point
+        np.testing.assert_allclose(a1.astype(np.float32),
+                                   b1.astype(np.float32),
+                                   rtol=0.05, atol=0.05)
+
+    def test_sweep_driver_attention_cpu(self, tables_dir):
+        """The acceptance flow: a >=2-candidate in-process sweep on the
+        cpu backend writes a winner a fresh lookup returns."""
+        spec = importlib.util.spec_from_file_location(
+            "_tune_for_test", _REPO / "tools" / "tune_kernels.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        lines = []
+        winners, problems = mod.sweep_one(
+            "attention", iters=1, say=lambda *a: lines.append(
+                " ".join(str(x) for x in a)))
+        assert problems == []
+        assert len(winners) == 1   # cpu: one tiny shape case
+        assert set(winners[0]) == {"block_q", "block_k"}
+        text = "\n".join(lines)
+        assert text.count(" ms fwd+bwd") >= 2   # >= 2 candidates timed
+        assert "WINNER" in text and "lookup verified" in text
+        # the winner persisted (keyed to its swept seq bucket) and a
+        # cold lookup serves it
+        tuning.clear_cache()
+        assert tuning.lookup("flash_attention", {"Dp": 128, "Sb": 256},
+                             "bfloat16") == winners[0]
+        assert (tables_dir / "flash_attention.json").exists()

@@ -18,6 +18,8 @@ set -e
 cd "$(dirname "$0")/.."
 echo "== graftlint (static JAX-hazard gate; docs/lint.md) =="
 python tools/lint.py
+echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
+python tools/tune_kernels.py --validate
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q

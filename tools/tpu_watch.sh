@@ -11,11 +11,15 @@
 #
 #   1. bench_gpt2        headline tokens/sec/chip + MFU      (~5 min)
 #   2. hw_numerics bias  the single unbanked kernel check    (~2 min)
-#   3. llama_block / bert_large / llama_longctx              (~15 min)
-#   4. remaining configs (bert, resnet, t5, gpt2 B=24)       (~15 min)
-#   5. per-op profile + cond-elision probe                   (~10 min)
-#   6. kernel A/B sweeps (the measure-first debts)           (~2x40 min)
-#   7. full hw_numerics re-sweep                             (~20 min)
+#   3. llama_block / bert_large                              (~10 min)
+#   4. tune_kernels --kernel attention: the in-process flash
+#      block sweep — the 0.36x-roofline localizer for
+#      llama_longctx (VERDICT r5) — runs BEFORE its re-bench
+#      so the re-bench rides any folded-in winner             (~10 min)
+#   5. llama_longctx re-bench + remaining configs            (~20 min)
+#   6. per-op profile + cond-elision probe                   (~10 min)
+#   7. kernel A/B sweeps + remaining tune_kernels sweeps     (~2x40 min)
+#   8. full hw_numerics re-sweep                             (~20 min)
 #
 # Every phase tees its log to perf_results/ AS IT RUNS (stdbuf line
 # buffered), so a tunnel that dies mid-phase still leaves the lines that
@@ -115,6 +119,10 @@ run hw_num_new       600 python tools/hw_numerics.py --only bias,int8 \
                          --timeout 480 "${CPUQ[@]}"
 run bench_llama_blk 1800 python bench.py --config llama_block --timeout 1500
 run bench_bert_lg   1500 python bench.py --config bert_large --timeout 1200
+# the flash block sweep (in-process, winners persisted to
+# perf_results/tuning/) runs AHEAD of the llama_longctx re-bench: the
+# 16k config measured 0.36x its roofline and the sweep is the localizer
+run tune_attention  1800 python tools/tune_kernels.py --kernel attention
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
 run bench_bert      1200 python bench.py --config bert --timeout 1000
 run bench_resnet    1200 python bench.py --config resnet --timeout 1000
@@ -126,6 +134,7 @@ run profile_gpt2    1200 python tools/profile_step.py --config gpt2 --top 40
 run cond_elision     900 python tools/cond_elision_probe.py
 run kern_all        4800 python tools/bench_kernels.py all "${TINY[@]}"
 run kern_all_llama  4800 python tools/bench_kernels.py all --llama "${TINY[@]}"
+run tune_all        4800 python tools/tune_kernels.py --kernel all
 run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
 

@@ -1,0 +1,446 @@
+"""In-process block-size sweep driver for the Pallas kernels.
+
+Measures N block-size candidates per kernel **in one process** — block
+sizes are static kernel arguments (`apex1_tpu.tuning` threading), so the
+jit cache keys on them and each candidate compiles exactly one
+executable. This replaces the old ``APEX1_ATTN_BLOCK_*`` env-var sweeps,
+which were read at trace time and forced a fresh process (a cold compile
+of everything) per candidate — the reason the kernel A/B sweeps never
+fit an 18-minute tunnel window.
+
+Per kernel the driver:
+
+1. filters candidates through the `apex1_tpu.tuning.registry` VMEM
+   model (dropped candidates are LOGGED, never silently skipped);
+2. times each survivor fwd(+bwd) on the live backend with the loop in
+   one dispatch (tunnel dispatch latency hidden; interpret mode on CPU
+   — plumbing-valid, timing-meaningless, marked ``timing:
+   "interpret"`` in the table so real TPUs never serve it);
+3. records the winner in the shape-keyed tuning table, persists it
+   under ``perf_results/tuning/`` (override: ``APEX1_TUNING_DIR``),
+   clears the jit cache (earlier traces baked the OLD table values),
+   and verifies a fresh lookup returns the winner.
+
+Output is tee'd to ``perf_results/tune_<kernel>_<backend>.log`` so a
+tunnel death mid-sweep still banks every line that printed.
+
+``--validate`` runs the strict table check instead (every in-repo table
+parses; every entry passes the VMEM-budget model for its recorded
+capability) — the ``== tuning tables ==`` step of tools/check_all.sh.
+
+Usage:
+    python tools/tune_kernels.py --kernel attention [--backend cpu]
+    python tools/tune_kernels.py --kernel all --iters 20
+    python tools/tune_kernels.py --validate
+"""
+
+import argparse
+import dataclasses
+import functools
+import os
+import sys
+from typing import Callable, Sequence
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, _REPO)
+sys.path.insert(0, _TOOLS)   # for bench_kernels (shared timeit)
+
+
+@dataclasses.dataclass
+class Case:
+    """One kernel sweep: candidates (dicts of block params) + a factory
+    returning (timed_fn, args) for a candidate."""
+    kernel: str                   # registry name (keys the table)
+    dims: dict                    # padded dims for the table key
+    dtype: str                    # canonical dtype for the table key
+    candidates: Sequence[dict]
+    make: Callable                # blocks -> (fn, args)
+    grad: bool                    # fwd+bwd (training path) vs fwd-only
+
+
+def _grad_of_sum(f, argnums):
+    import jax
+    import jax.numpy as jnp
+
+    def g(*args):
+        return jax.grad(lambda *a: jnp.sum(
+            jax.tree.leaves(f(*a))[0].astype(jnp.float32)),
+            argnums=argnums)(*args)
+    return g
+
+
+# --------------------------------------------------------------------------
+# sweep cases — shapes auto-shrink on CPU (interpret mode validates the
+# plumbing; tpu shapes mirror tools/bench_kernels.py so winners line up
+# with the banked A/B numbers)
+# --------------------------------------------------------------------------
+
+def _attention_case(B, Hq, Hkv, S, D, cands):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.attention import flash_attention
+    from apex1_tpu.tuning import padded_lanes, seq_bucket
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, Hq, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
+
+    def make(blocks):
+        f = functools.partial(flash_attention, causal=True,
+                              block_q=blocks["block_q"],
+                              block_k=blocks["block_k"])
+        return _grad_of_sum(f, (0, 1, 2)), (q, k, v)
+
+    return Case("flash_attention",
+                {"Dp": padded_lanes(D), "Sb": seq_bucket(S)}, "bfloat16",
+                [dict(block_q=bq, block_k=bk) for bq, bk in cands
+                 if bq <= S and bk <= S],
+                make, grad=True)
+
+
+def case_attention(tiny):
+    if tiny:
+        return _attention_case(1, 2, 2, 256, 64,
+                               [(128, 128), (256, 256)])
+    cands = [(256, 256), (256, 512), (512, 512), (512, 1024),
+             (1024, 1024)]
+    # one sweep per SEQ BUCKET the benches actually run: winners are
+    # seq-keyed, so the gpt2-shape sweep cannot govern the 16k GQA
+    # config (llama_longctx — the 0.36x-roofline localizer target)
+    return [_attention_case(8, 12, 12, 1024, 64, cands),
+            _attention_case(1, 32, 4, 16384, 64, cands)]
+
+
+def case_linear_xent(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.linear_xent import linear_cross_entropy
+    from apex1_tpu.tuning import padded_lanes
+
+    T, H, V = (256, 128, 512) if tiny else (8184, 768, 50432)
+    cands = ([(64, 128), (128, 128)] if tiny else
+             [(256, 512), (512, 512), (256, 768), (512, 1024),
+              (1024, 1024)])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, H)) * 0.02, jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.02, jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, V - 100, (T,)), jnp.int32)
+
+    def make(blocks):
+        def f(x, w):
+            return linear_cross_entropy(x, w, t, num_classes=V - 100,
+                                        block_t=blocks["block_t"],
+                                        block_v=blocks["block_v"])
+        return _grad_of_sum(f, (0, 1)), (x, w)
+
+    return Case("linear_xent", {"Hp": padded_lanes(H)}, "bfloat16",
+                [dict(block_t=bt, block_v=bv) for bt, bv in cands],
+                make, grad=True)
+
+
+def _row_case(kernel, tiny, build, tiny_cands=(32, 64),
+              cands=(64, 128, 256, 336, 512)):
+    from apex1_tpu.tuning import padded_lanes
+
+    fn_factory, lanes, dtype = build(tiny)
+    brs = tiny_cands if tiny else cands
+    return Case(kernel, {"lanes": padded_lanes(lanes)}, dtype,
+                [dict(block_rows=br) for br in brs], fn_factory,
+                grad=True)
+
+
+def case_softmax(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import scaled_upper_triang_masked_softmax
+
+    def build(tiny):
+        B, H, S = (1, 2, 128) if tiny else (8, 12, 1024)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(B, H, S, S)), jnp.float32)
+
+        def make(blocks):
+            def f(x):
+                return scaled_upper_triang_masked_softmax(
+                    x, scale=0.125, block_rows=blocks["block_rows"])
+            return _grad_of_sum(f, 0), (x,)
+
+        return make, S, "float32"
+
+    return _row_case("fused_softmax", tiny, build)
+
+
+def case_layer_norm(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import layer_norm
+
+    def build(tiny):
+        R, H = (256, 128) if tiny else (8192, 768)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(R, H)), jnp.bfloat16)
+        g = jnp.ones((H,), jnp.float32)
+        b = jnp.zeros((H,), jnp.float32)
+
+        def make(blocks):
+            def f(x):
+                return layer_norm(x, g, b,
+                                  block_rows=blocks["block_rows"])
+            return _grad_of_sum(f, 0), (x,)
+
+        return make, H, "bfloat16"
+
+    return _row_case("layer_norm", tiny, build)
+
+
+def case_rope(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import apply_rotary_pos_emb, rope_tables
+
+    def build(tiny):
+        # head_dim 256: the rope kernel's lane gate needs half % 128 == 0
+        B, S, H, D = (1, 64, 2, 256) if tiny else (1, 4096, 16, 256)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+        cos, sin = rope_tables(jnp.arange(S), D)
+
+        def make(blocks):
+            def f(x):
+                return apply_rotary_pos_emb(
+                    x, cos, sin, block_rows=blocks["block_rows"])
+            return _grad_of_sum(f, 0), (x,)
+
+        return make, D // 2, "bfloat16"
+
+    return _row_case("rope", tiny, build)
+
+
+def case_xentropy(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import softmax_cross_entropy_loss
+
+    def build(tiny):
+        T, V = (256, 512) if tiny else (8184, 50432)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(T, V)), jnp.float32)
+        t = jnp.asarray(rng.integers(0, V - 100, (T,)), jnp.int32)
+
+        def make(blocks):
+            def f(x):
+                return softmax_cross_entropy_loss(
+                    x, t, num_classes=V - 100,
+                    block_rows=blocks["block_rows"])
+            return _grad_of_sum(f, 0), (x,)
+
+        return make, V, "float32"
+
+    return _row_case("xentropy", tiny, build,
+                     tiny_cands=(32, 64), cands=(8, 16, 32))
+
+
+def case_int8(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import int8_matmul, quantize_int8
+
+    T, N, K = (8, 256, 256) if tiny else (8, 2048, 2048)
+    cands = ([(128, 128), (256, 128)] if tiny else
+             [(256, 512), (512, 512), (256, 1024), (512, 256)])
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(N, K)) * 0.02, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(T, K)), jnp.bfloat16)
+    wq, s = quantize_int8(w)
+
+    def make(blocks):
+        def f(x):
+            return int8_matmul(x, wq, s, blocks["block_n"],
+                               blocks["block_k"])
+        return f, (x,)   # decode path: fwd-only is the product shape
+
+    return Case("int8_matmul", {"N": N, "K": K}, "int8",
+                [dict(block_n=bn, block_k=bk) for bn, bk in cands],
+                make, grad=False)
+
+
+CASES = {
+    "attention": case_attention,
+    "linear_xent": case_linear_xent,
+    "softmax": case_softmax,
+    "layer_norm": case_layer_norm,
+    "rope": case_rope,
+    "xentropy": case_xentropy,
+    "int8": case_int8,
+}
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+class _Tee:
+    """print() to stdout AND the banked log, line-buffered."""
+
+    def __init__(self, path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.f = open(path, "a", buffering=1)
+
+    def __call__(self, *parts):
+        line = " ".join(str(p) for p in parts)
+        print(line, flush=True)
+        self.f.write(line + "\n")
+
+
+def sweep_one(name, iters, say, write=True):
+    """Sweep one kernel (possibly several shape cases); returns
+    (winners, problems) — one winner blocks-dict per swept case."""
+    from apex1_tpu.ops._common import on_tpu
+
+    tiny = not on_tpu()
+    cases = CASES[name](tiny)
+    if isinstance(cases, Case):
+        cases = [cases]
+    winners, problems = [], []
+    for case in cases:
+        w, p = _sweep_case(case, iters, say, write)
+        if w is not None:
+            winners.append(w)
+        problems += p
+    return winners, problems
+
+
+def _sweep_case(case, iters, say, write):
+    import jax
+    import numpy as np
+
+    from apex1_tpu import tuning
+    from apex1_tpu.core.capability import vmem_budget
+    from apex1_tpu.ops._common import force_impl, on_tpu
+    from apex1_tpu.tuning.registry import SPECS
+
+    tiny = not on_tpu()
+    spec = SPECS[case.kernel]
+    budget = vmem_budget()
+    es = np.dtype(case.dtype).itemsize
+    say(f"== {case.kernel} dims={case.dims} dtype={case.dtype} "
+        f"backend={jax.default_backend()} "
+        f"{'(interpret-mode plumbing run)' if tiny else ''} ==")
+
+    runnable = []
+    for blocks in case.candidates:
+        ok, est = spec.check(blocks, case.dims, es, budget)
+        if ok:
+            runnable.append(blocks)
+        else:
+            say(f"  drop {blocks}: VMEM model {est / 2**20:.1f} MiB "
+                f"> budget {budget / 2**20:.0f} MiB")
+    if len(runnable) < 2:
+        say(f"  SKIP {case.kernel}: <2 runnable candidates")
+        return None, [f"{case.kernel}: <2 runnable candidates"]
+
+    # shared single-dispatch timing methodology (the eps-tap fori loop):
+    # lazy import so jax initializes only after --backend takes effect
+    from bench_kernels import timeit
+
+    results = []
+    for blocks in runnable:
+        fn, args = case.make(blocks)
+        try:
+            with force_impl("pallas"):
+                dt = timeit(fn, *args, iters=iters)
+            say(f"  {blocks}  {dt * 1e3:9.3f} ms "
+                f"{'fwd+bwd' if case.grad else 'fwd'}")
+            results.append((dt, blocks))
+        except Exception as e:
+            say(f"  {blocks}: {type(e).__name__}: {str(e)[:140]}")
+    if not results:
+        return None, [f"{case.kernel}: every candidate failed"]
+
+    dt, blocks = min(results, key=lambda r: r[0])
+    say(f"  WINNER {blocks}  {dt * 1e3:.3f} ms")
+    if not write:
+        return blocks, []
+    key, _entry = tuning.record(case.kernel, case.dims, case.dtype,
+                                blocks, time_ms=dt * 1e3)
+    path = tuning.save(case.kernel)
+    # earlier traces in THIS process baked the pre-sweep table values
+    # into their executables — drop them before anyone re-traces
+    jax.clear_caches()
+    tuning.clear_cache()
+    got = tuning.lookup(case.kernel, case.dims, case.dtype)
+    if got != blocks:
+        return blocks, [f"{case.kernel}: post-save lookup returned "
+                        f"{got}, expected {blocks}"]
+    say(f"  banked {key} -> {path} (lookup verified)")
+    return blocks, []
+
+
+def validate(say):
+    from apex1_tpu import tuning
+    d = tuning.default_tuning_dir()
+    problems = tuning.validate_tables(d)
+    n = len([f for f in (os.listdir(d) if os.path.isdir(d) else ())
+             if f.endswith(".json")])
+    say(f"tuning tables: {n} file(s) under {d}")
+    for p in problems:
+        say(f"  INVALID {p}")
+    say("tuning tables OK" if not problems
+        else f"{len(problems)} invalid entries/files")
+    return problems
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernel", default="attention",
+                    choices=sorted(CASES) + ["all"])
+    ap.add_argument("--backend", default=None,
+                    help="force a JAX platform (e.g. cpu) before init")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="timing loop length (default 20, 2 on cpu)")
+    ap.add_argument("--no-write", action="store_true",
+                    help="measure only; don't touch the tables")
+    ap.add_argument("--validate", action="store_true",
+                    help="strict table check (check_all.sh gate); no sweep")
+    args = ap.parse_args()
+
+    if args.validate:
+        # table validation is file parsing + arithmetic — skip backend
+        # init and cache setup (this runs on every check_all invocation)
+        problems = validate(print)
+        sys.exit(1 if problems else 0)
+
+    if args.backend:
+        os.environ["JAX_PLATFORMS"] = args.backend
+    from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                   honor_jax_platforms_env)
+    honor_jax_platforms_env()
+    enable_persistent_compilation_cache()
+
+    import jax
+    backend = jax.default_backend()
+    names = sorted(CASES) if args.kernel == "all" else [args.kernel]
+    iters = args.iters or (2 if backend == "cpu" else 20)
+    say = _Tee(os.path.join(_REPO, "perf_results",
+                            f"tune_{args.kernel}_{backend}.log"))
+    say(f"tune_kernels backend={backend} kernels={names} iters={iters}")
+    problems = []
+    for name in names:
+        _, probs = sweep_one(name, iters, say, write=not args.no_write)
+        problems += probs
+    say("SWEEP DONE" + (f" ({len(problems)} problems)" if problems
+                        else " — all winners banked"))
+    sys.exit(1 if problems else 0)
+
+
+if __name__ == "__main__":
+    main()
