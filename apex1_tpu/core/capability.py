@@ -38,23 +38,30 @@ class TpuCapability:
     ici_axes: int             # torus dimensionality (2 = 2D, 3 = 3D)
     native_fp8: bool          # fp8 matmul support
     sparsecore: bool          # embedding SparseCore present
+    ici_gbps: float = 0.0     # per-chip aggregate ICI bandwidth (GB/s,
+    #                           spec-sheet "interchip interconnect BW"
+    #                           converted from Gbit/s; /ici_axes/2 ≈ one
+    #                           link — the ring-neighbor transfer rate
+    #                           the overlap roofline comms term prices)
 
 
 _TABLE = {
     # Public spec-sheet numbers (cloud.google.com/tpu/docs system specs);
     # vmem_bytes is the conservative planning figure, not a spec claim.
+    # ici_gbps: spec "interchip interconnect BW" per chip, Gbit/s -> GB/s
+    # (v2 496 / v3 656 / v4 2400 / v5e 1600 / v5p 4800 / v6e 3584 Gbps).
     "v2": TpuCapability("v2", (128, 128), 16 * 2**20, 16 * 2**30, 600.0,
-                        45.0, 2, 2, False, False),
+                        45.0, 2, 2, False, False, 62.0),
     "v3": TpuCapability("v3", (128, 128), 16 * 2**20, 32 * 2**30, 900.0,
-                        123.0, 2, 2, False, False),
+                        123.0, 2, 2, False, False, 82.0),
     "v4": TpuCapability("v4", (128, 128), 32 * 2**20, 32 * 2**30, 1200.0,
-                        275.0, 1, 3, False, True),
+                        275.0, 1, 3, False, True, 300.0),
     "v5e": TpuCapability("v5e", (128, 128), 32 * 2**20, 16 * 2**30, 819.0,
-                         197.0, 1, 2, False, False),
+                         197.0, 1, 2, False, False, 200.0),
     "v5p": TpuCapability("v5p", (128, 128), 64 * 2**20, 95 * 2**30, 2765.0,
-                         459.0, 1, 3, False, True),
+                         459.0, 1, 3, False, True, 600.0),
     "v6e": TpuCapability("v6e", (256, 256), 64 * 2**20, 32 * 2**30, 1640.0,
-                         918.0, 1, 2, False, True),
+                         918.0, 1, 2, False, True, 448.0),
 }
 
 _KIND_PATTERNS = [
@@ -132,3 +139,16 @@ def vmem_budget(generation: str | None = None) -> int:
     """VMEM bytes the Pallas block planners should assume (leaves headroom
     for Mosaic's own double buffering)."""
     return get_capability(generation).vmem_bytes // 2
+
+
+def ici_link_gbps(generation: str | None = None) -> float:
+    """Conservative per-neighbor ICI rate (GB/s): the aggregate per-chip
+    spec figure split across the torus's ``2 * ici_axes`` links. This is
+    the rate a ring ppermute hop (ONE neighbor transfer) sees — the
+    denominator of the roofline comms term (`tools/predict_perf.py`,
+    bench.py's ``ici_exposed_bytes`` pricing). 0.0 when the generation
+    row carries no ICI figure."""
+    cap = get_capability(generation)
+    if not cap.ici_gbps:
+        return 0.0
+    return cap.ici_gbps / (2 * cap.ici_axes)

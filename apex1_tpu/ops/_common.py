@@ -98,6 +98,15 @@ def out_struct(shape, dtype, *like):
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
+def vary(x, axis_name):
+    """Mark ``x`` as device-varying over ``axis_name`` (ring/scan carry
+    typing under ``check_vma``; the 0.4.x compat bridge makes pcast the
+    identity there). The sibling of `out_struct`'s vma declaration —
+    keep the shim HERE so a jax-compat fix lands once, not per caller
+    (parallel.ring_attention, tensor_parallel.mappings)."""
+    return jax.lax.pcast(x, axis_name, to="varying")
+
+
 def pad_to(x: jnp.ndarray, axis: int, multiple: int, value=0.0):
     """Pad ``axis`` up to a multiple; returns (padded, original_size).
 

@@ -543,7 +543,12 @@ def _flash_fwd(q, k, v, qseg, kseg, q_off, k_off,
 
 
 def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
-                    bias=None):
+                    bias=None, cast=True):
+    """``cast=False`` returns dk/dv in their native fp32 kernel output
+    dtype (dq is q.dtype either way — the dq kernel's out_shape): the
+    ring backward accumulates per-shard dk/dv across the ring and a
+    round-trip through k.dtype before that fp32 sum would discard the
+    very precision the kernels paid for."""
     q, k, v, qseg, kseg, q_off, k_off, out, lse_p = res
     dout, dlse = cts
     qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
@@ -679,7 +684,9 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
         dbias = dbias_p[:, :, :g["Sq"], :g["Sk"]]
 
     f0 = lambda x: np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
-    grads = (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+    if cast:
+        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
+    grads = (dq.astype(q.dtype), dk, dv,
              f0(qseg), f0(kseg), f0(q_off), f0(k_off))
     return grads, dbias
 

@@ -8,6 +8,8 @@ from apex1_tpu.parallel.sync_batchnorm import (  # noqa: F401
 from apex1_tpu.parallel.distributed_optimizer import (  # noqa: F401
     distributed_fused_adam, distributed_fused_lamb, fsdp_param_specs,
     shard_opt_state_specs)
-from apex1_tpu.parallel.halo import halo_exchange, spatial_conv2d  # noqa: F401
-from apex1_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from apex1_tpu.parallel.halo import (  # noqa: F401
+    exchange_overlap, halo_exchange, spatial_conv2d)
+from apex1_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_serial)
 from apex1_tpu.parallel.ulysses import ulysses_attention  # noqa: F401

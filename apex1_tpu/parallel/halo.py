@@ -15,6 +15,14 @@ boundaries zero-fill (conv SAME-padding semantics at the global edge).
 boundary slices; `spatial_conv2d` shows the full pattern: exchange →
 conv 'VALID' on the extended shard ≙ global conv 'SAME' on the unsplit
 tensor (asserted in tests).
+
+`exchange_overlap` is the communication-overlap entry (the reference's
+``HaloExchangerPeer`` issues its peer copies on a side stream for the
+same reason): both directional ppermutes are issued BEFORE the
+caller-supplied interior compute runs, and since that compute has no
+data dependence on the in-flight halos, XLA's async collectives hide
+the neighbor transfers behind it — the same prefetch shape as the
+double-buffered `parallel.ring_attention`.
 """
 
 from __future__ import annotations
@@ -23,12 +31,11 @@ import jax
 import jax.numpy as jnp
 
 
-def halo_exchange(x, axis_name: str, *, halo: int, dim: int = 1,
-                  periodic: bool = False):
-    """Extend local shard ``x`` with ``halo`` boundary slices from both
-    spatial neighbors along sharded dimension ``dim``."""
-    if halo <= 0:
-        return x
+def _boundary_transfers(x, axis_name: str, *, halo: int, dim: int,
+                        periodic: bool):
+    """Issue the two directional halo ppermutes; returns the incoming
+    ``(from_prev, from_next)`` boundary slices (zero-masked at the global
+    edges unless ``periodic``)."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
@@ -50,7 +57,44 @@ def halo_exchange(x, axis_name: str, *, halo: int, dim: int = 1,
         from_prev = jnp.where(idx == 0, zero, from_prev)
         from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
                               from_next)
+    return from_prev, from_next
+
+
+def halo_exchange(x, axis_name: str, *, halo: int, dim: int = 1,
+                  periodic: bool = False):
+    """Extend local shard ``x`` with ``halo`` boundary slices from both
+    spatial neighbors along sharded dimension ``dim``."""
+    if halo <= 0:
+        return x
+    from_prev, from_next = _boundary_transfers(
+        x, axis_name, halo=halo, dim=dim, periodic=periodic)
     return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+def exchange_overlap(x, interior_fn, axis_name: str, *, halo: int,
+                     dim: int = 1, periodic: bool = False):
+    """Halo exchange with the neighbor transfers overlapped by
+    ``interior_fn``.
+
+    Issues both directional ppermutes FIRST, then runs
+    ``interior_fn(x)`` — compute that depends only on the local shard
+    (the interior rows of a conv, a pointwise prologue, statistics…) —
+    while the halos are in flight, and only then concatenates the
+    extended shard. Returns ``(extended, interior)`` where ``extended``
+    is exactly ``halo_exchange(x, ...)`` and ``interior`` is exactly
+    ``interior_fn(x)`` — the overlap changes scheduling, not values
+    (pinned by tests; the ordering property itself is checkable with
+    `apex1_tpu.testing.hlo_probe` on loops built from this pattern).
+    """
+    if halo <= 0:
+        return x, interior_fn(x)
+    from_prev, from_next = _boundary_transfers(
+        x, axis_name, halo=halo, dim=dim, periodic=periodic)
+    # interior compute has no data dependence on the in-flight halos —
+    # XLA schedules it between the permute start/done pair
+    interior = interior_fn(x)
+    return (jnp.concatenate([from_prev, x, from_next], axis=dim),
+            interior)
 
 
 def spatial_conv2d(x, kernel, axis_name: str, *, dim: int = 1):

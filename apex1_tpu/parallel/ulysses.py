@@ -13,8 +13,16 @@ Trade-offs vs ring attention (both provided so configs can pick):
   attention locally — simple, exact, great when heads ≥ devices;
   requires Hq and Hkv divisible by the axis size.
 - Ring: n−1 neighbor ppermutes of K/V, attention stays seq-local —
-  scales to more devices than heads and overlaps transfer with compute,
-  at the cost of the lse-merge machinery.
+  scales to more devices than heads and overlaps transfer with compute:
+  the double-buffered `parallel.ring_attention` schedule issues each
+  shard's ppermute before the previous shard's attend, forward and
+  backward (the overlap is pinned on optimized HLO by
+  `testing.hlo_probe`, not just claimed here), at the cost of the
+  lse-merge machinery.
+
+When head counts do NOT divide the axis size, ``fallback="ring"``
+routes the call through that overlapped ring instead of raising — one
+config knob serves both regimes.
 """
 
 from __future__ import annotations
@@ -29,7 +37,8 @@ from apex1_tpu.ops.attention import flash_attention
 def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
                       sm_scale: float | None = None, segment_ids=None,
                       block_q: int | None = None,
-                      block_k: int | None = None):
+                      block_k: int | None = None,
+                      fallback: str = "error"):
     """Attention over a sequence sharded on ``axis_name`` via head
     scatter / sequence gather all-to-alls. Call inside ``shard_map``.
 
@@ -37,7 +46,15 @@ def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
     and Hkv divisible by the axis size. ``segment_ids``: local (B,
     S_local) shard (all-gathered internally — after the first a2a every
     device sees the full sequence). Returns the local output shard.
+
+    ``fallback``: what to do when the head counts do not divide the
+    axis size — ``"error"`` (default) raises; ``"ring"`` routes through
+    the overlapped double-buffered `parallel.ring_attention` carry
+    (same semantics, no head-divisibility requirement).
     """
+    if fallback not in ("error", "ring"):
+        raise ValueError(f"fallback must be 'error' or 'ring', got "
+                         f"{fallback!r}")
     n = jax.lax.axis_size(axis_name)
     if n == 1:
         return flash_attention(q, k, v, causal=causal,
@@ -49,9 +66,16 @@ def ulysses_attention(q, k, v, axis_name=AXIS_CP, *, causal: bool = False,
     # just to be thrown away (review r5)
     hkv_eff = n if (Hkv % n and n % Hkv == 0) else Hkv
     if Hq % n or hkv_eff % n:
+        if fallback == "ring":
+            from apex1_tpu.parallel.ring_attention import ring_attention
+            return ring_attention(q, k, v, axis_name, causal=causal,
+                                  sm_scale=sm_scale,
+                                  segment_ids=segment_ids,
+                                  block_q=block_q, block_k=block_k)
         raise ValueError(
             f"ulysses needs head counts divisible by the axis size: "
-            f"Hq={Hq}, Hkv={Hkv}, n={n} (use ring_attention otherwise)")
+            f"Hq={Hq}, Hkv={Hkv}, n={n} (use ring_attention or "
+            f"fallback='ring' otherwise)")
     if Hkv % n:
         # GQA with fewer KV heads than devices: materialize the group
         # repeat (exactly how GQA attention is defined) so KV heads

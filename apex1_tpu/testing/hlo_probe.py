@@ -1,0 +1,375 @@
+"""HLO-text probes that pin the communication/compute OVERLAP property.
+
+The overlap layer (`parallel.ring_attention`'s double-buffered carry,
+`parallel.halo.exchange_overlap`, the decomposed collective matmuls in
+`transformer.tensor_parallel.mappings`) claims that each loop step's
+ppermute is issued so the step's compute has no data dependence on it —
+letting XLA hide the ICI transfer behind the MXU work. A docstring
+claim rots; this module makes it a PINNED property of the optimized
+executable text, checked two ways depending on what the backend emits:
+
+- **async mode** (TPU, incl. the tunnel-free AOT topology client that
+  `tools/aot_check.py` uses): XLA converts collectives to
+  ``collective-permute-start``/``-done`` pairs and the printed
+  instruction order of a compiled executable is the post-scheduling
+  order. A loop body passes when some start is scheduled BEFORE the
+  body's first compute op and its matching done AFTER the last one —
+  i.e. the transfer brackets the dots. The serialized rotate→attend
+  loop fails: its done must precede the dots that consume it.
+- **dependence mode** (CPU virtual mesh — the tier-1 harness — where
+  XLA keeps synchronous ``collective-permute``): instruction order
+  proves nothing, but the DATA DEPENDENCE that forces serialization is
+  visible: a body passes when no compute op is a (transitive, in-body)
+  consumer of any collective-permute's result. The serialized loop
+  fails because its dots consume this step's permute.
+
+"Compute ops" are dots/convolutions, fusions whose fused computation
+contains one, and Pallas kernels (``tpu_custom_call`` custom-calls).
+
+Entry points: `optimized_hlo` (compile and return executable text),
+`check_collective_overlap` (returns a report), and
+`assert_collective_overlap` (raises on failure — the test/gate form).
+``python -m apex1_tpu.testing.hlo_probe`` runs the CPU self-check that
+`tools/check_all.sh` wires in: the overlapped ring (fwd AND bwd) must
+PASS and the retained `ring_attention_serial` loop must FAIL.
+
+STANDING-RISK NOTE (the gate topology, VERDICT r5 Weak #7): on the CPU
+harness the Pallas ring/ulysses path only ever EXECUTES in interpret
+mode under ``check_vma=False`` — tier-1 therefore proves ring
+*numerics* on the XLA-composite path, while the Mosaic lowering of the
+shipped TPU configuration is guarded ONLY by the AOT compile gate
+(``tools/aot_check.py`` collectives section, which also runs the async
+form of this probe). Keep that gate in ``check_all.sh``; it is the real
+guard for the TPU ring path, not the pytest suite. See
+docs/parallel.md "Communication overlap layer".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_COMPUTE_OPCODES = ("dot", "convolution")
+
+
+@dataclasses.dataclass
+class Instruction:
+    name: str
+    opcode: str
+    operands: list
+    line: str
+
+
+@dataclasses.dataclass
+class BodyReport:
+    """Verdict for one while-loop body."""
+
+    body: str
+    mode: str            # "async" | "dependence"
+    ok: bool
+    n_permutes: int
+    n_compute: int
+    detail: str
+
+
+@dataclasses.dataclass
+class ProbeReport:
+    """Aggregate verdict: every applicable loop body must pass."""
+
+    mode: str
+    ok: bool
+    bodies: list
+    detail: str
+
+
+def optimized_hlo(fn, *args):
+    """Optimized-executable HLO text of ``jit(fn)`` on ``args`` (arrays
+    or ShapeDtypeStructs)."""
+    import jax
+
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def _skip_balanced(s, i):
+    """Index just past the balanced-paren group starting at ``s[i]``."""
+    depth = 0
+    while i < len(s):
+        if s[i] == "(":
+            depth += 1
+        elif s[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    return i
+
+
+def _parse_instruction(line):
+    ls = line.strip()
+    if " = " not in ls:
+        return None
+    lhs, rhs = ls.split(" = ", 1)
+    name = lhs.replace("ROOT", "").strip().lstrip("%")
+    # skip the result type: a balanced (..) tuple type or one
+    # space-free token, then the opcode runs up to the operand paren
+    rhs = rhs.strip()
+    if rhs.startswith("("):
+        rhs = rhs[_skip_balanced(rhs, 0):].strip()
+    else:
+        parts = rhs.split(" ", 1)
+        rhs = parts[1].strip() if len(parts) > 1 else ""
+    m = re.match(r"([a-zA-Z][\w\-]*)\(", rhs)
+    if not m:
+        return None
+    opcode = m.group(1)
+    operands = re.findall(r"%([\w.\-]+)", rhs)
+    return Instruction(name=name, opcode=opcode, operands=operands,
+                       line=ls)
+
+
+def parse_computations(hlo_text):
+    """{computation name: [Instruction, ...]} for an HLO module dump."""
+    comps = {}
+    cur = None
+    for line in hlo_text.splitlines():
+        ls = line.strip()
+        if ls.endswith("{") and "->" in ls and "=" not in ls.split("(")[0]:
+            name = ls.split("(")[0].replace("ENTRY", "").strip()
+            cur = name.lstrip("%")
+            comps[cur] = []
+            continue
+        if ls == "}":
+            cur = None
+            continue
+        if cur is not None:
+            instr = _parse_instruction(line)
+            if instr is not None:
+                comps[cur].append(instr)
+    return comps
+
+
+def _while_bodies(comps):
+    """Names of computations used as while-loop bodies."""
+    bodies = set()
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.opcode == "while":
+                m = re.search(r"body=%?([\w.\-]+)", ins.line)
+                if m:
+                    bodies.add(m.group(1))
+    return bodies
+
+
+def _direct_compute(ins):
+    if ins.opcode in _COMPUTE_OPCODES:
+        return True
+    return ins.opcode == "custom-call" and "tpu_custom_call" in ins.line
+
+
+def _called_computations(ins, comps):
+    """Computation names an instruction references (fusion ``calls=``,
+    conditional branches, nested while bodies, reducers, …): every
+    %-reference that names a computation rather than an instruction."""
+    return [ref for ref in ins.operands if ref in comps]
+
+
+def _computation_has_compute(name, comps, cache):
+    if name in cache:
+        return cache[name]
+    cache[name] = False  # cycle guard
+    result = False
+    for ins in comps.get(name, []):
+        if _direct_compute(ins):
+            result = True
+            break
+        if any(_computation_has_compute(c, comps, cache)
+               for c in _called_computations(ins, comps)):
+            result = True
+            break
+    cache[name] = result
+    return result
+
+
+def _is_compute(ins, comps, cache):
+    """Directly a dot/convolution/Pallas call, or an op (fusion,
+    conditional, nested call…) whose called computations contain one —
+    the ring's attend sits under the causal ``lax.cond``, so the
+    conditional IS the compute op at loop-body level."""
+    if _direct_compute(ins):
+        return True
+    return any(_computation_has_compute(c, comps, cache)
+               for c in _called_computations(ins, comps))
+
+
+def _check_body_async(body, instrs, compute_idx):
+    """Scheduled-order check: some start strictly before the first
+    compute op whose matching done lands after the last one."""
+    starts = {ins.name: i for i, ins in enumerate(instrs)
+              if ins.opcode == "collective-permute-start"}
+    first, last = min(compute_idx), max(compute_idx)
+    n_pairs = 0
+    for i, ins in enumerate(instrs):
+        if ins.opcode != "collective-permute-done":
+            continue
+        for op in ins.operands:
+            if op in starts:
+                n_pairs += 1
+                if starts[op] < first and i > last:
+                    return BodyReport(
+                        body=body, mode="async", ok=True,
+                        n_permutes=len(starts), n_compute=len(compute_idx),
+                        detail=f"start@{starts[op]} < compute[{first}.."
+                               f"{last}] < done@{i}")
+    return BodyReport(
+        body=body, mode="async", ok=False, n_permutes=len(starts),
+        n_compute=len(compute_idx),
+        detail=f"no start/done pair brackets the compute ops "
+               f"[{first}..{last}] ({n_pairs} pairs inspected) — the "
+               f"transfers are serialized against the dots")
+
+
+def _check_body_dependence(body, instrs, compute_idx, comps):
+    """Data-dependence check: no compute op may (transitively, within
+    the body) consume a collective-permute result."""
+    permute_idx = [i for i, ins in enumerate(instrs)
+                   if ins.opcode in ("collective-permute",
+                                     "collective-permute-start")]
+    by_name = {ins.name: i for i, ins in enumerate(instrs)}
+    consumers = {i: set() for i in range(len(instrs))}
+    for i, ins in enumerate(instrs):
+        for op in ins.operands:
+            j = by_name.get(op)
+            if j is not None:
+                consumers[j].add(i)
+    compute = set(compute_idx)
+    for p in permute_idx:
+        seen, stack = set(), [p]
+        while stack:
+            cur = stack.pop()
+            for nxt in consumers[cur]:
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                if nxt in compute:
+                    return BodyReport(
+                        body=body, mode="dependence", ok=False,
+                        n_permutes=len(permute_idx),
+                        n_compute=len(compute_idx),
+                        detail=f"compute op '{instrs[nxt].name}' consumes "
+                               f"'{instrs[p].name}' — the dots wait on "
+                               f"this step's transfer")
+                stack.append(nxt)
+    return BodyReport(
+        body=body, mode="dependence", ok=True,
+        n_permutes=len(permute_idx), n_compute=len(compute_idx),
+        detail="no compute op depends on an in-body collective-permute")
+
+
+def check_collective_overlap(hlo_text):
+    """Probe every while-loop body that carries both collective-permutes
+    and compute ops. Returns a `ProbeReport`; ``ok`` iff at least one
+    such body exists and ALL of them exhibit the overlap property."""
+    comps = parse_computations(hlo_text)
+    mode = ("async" if "collective-permute-start" in hlo_text
+            else "dependence")
+    reports = []
+    cache = {}
+    for body in sorted(_while_bodies(comps)):
+        instrs = comps.get(body, [])
+        has_permute = any(ins.opcode.startswith("collective-permute")
+                          for ins in instrs)
+        compute_idx = [i for i, ins in enumerate(instrs)
+                       if _is_compute(ins, comps, cache)]
+        if not has_permute or not compute_idx:
+            continue
+        if mode == "async":
+            reports.append(_check_body_async(body, instrs, compute_idx))
+        else:
+            reports.append(_check_body_dependence(body, instrs,
+                                                  compute_idx, comps))
+    if not reports:
+        return ProbeReport(
+            mode=mode, ok=False, bodies=[],
+            detail="no while-loop body with both collective-permutes and "
+                   "compute ops found — nothing to probe (wrong program, "
+                   "or the loop was fully unrolled)")
+    ok = all(r.ok for r in reports)
+    detail = "; ".join(f"{r.body}: {'OK' if r.ok else 'FAIL'} "
+                       f"({r.n_permutes} permutes, {r.n_compute} compute) "
+                       f"{r.detail}" for r in reports)
+    return ProbeReport(mode=mode, ok=ok, bodies=reports, detail=detail)
+
+
+def assert_collective_overlap(hlo_text, *, expect_mode=None):
+    """Raise ``AssertionError`` unless every applicable loop body in
+    ``hlo_text`` overlaps its transfers with compute. ``expect_mode``
+    optionally pins which probe mode must apply ("async" on TPU
+    executables — the start-before-dots/done-after property the
+    acceptance gate names; "dependence" on CPU)."""
+    rep = check_collective_overlap(hlo_text)
+    if expect_mode is not None and rep.mode != expect_mode:
+        raise AssertionError(
+            f"hlo_probe ran in {rep.mode!r} mode, expected "
+            f"{expect_mode!r} (wrong backend for this gate?)")
+    if not rep.ok:
+        raise AssertionError(f"collective overlap probe FAILED "
+                             f"[{rep.mode}]: {rep.detail}")
+    return rep
+
+
+def _self_check():
+    """CPU-mesh gate (check_all.sh): compile the overlapped ring fwd AND
+    bwd on the 8-device virtual mesh and require the probe to PASS;
+    compile the retained serialized ring and require it to FAIL (the
+    probe must be falsifiable, not vacuous)."""
+    from apex1_tpu.testing import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex1_tpu.core.mesh import make_mesh
+    from apex1_tpu.parallel.ring_attention import (ring_attention,
+                                                   ring_attention_serial)
+
+    mesh = make_mesh(cp=4, dp=1, devices=jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    B, H, S, D = 1, 2, 128, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+               for _ in range(3))
+    spec = P(None, None, "cp", None)
+
+    def smap(fn):
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec)
+
+    ring = smap(lambda q, k, v: ring_attention(q, k, v, "cp", causal=True))
+    rep = assert_collective_overlap(optimized_hlo(ring, q, k, v),
+                                    expect_mode="dependence")
+    print(f"  OK   ring fwd overlapped      [{rep.mode}] "
+          f"{len(rep.bodies)} loop body(ies)")
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    rep = assert_collective_overlap(
+        optimized_hlo(jax.grad(ring_loss, argnums=(0, 1, 2)), q, k, v),
+        expect_mode="dependence")
+    print(f"  OK   ring fwd+bwd overlapped  [{rep.mode}] "
+          f"{len(rep.bodies)} loop body(ies)")
+
+    serial = smap(lambda q, k, v: ring_attention_serial(q, k, v, "cp",
+                                                        causal=True))
+    srep = check_collective_overlap(optimized_hlo(serial, q, k, v))
+    if srep.ok or not srep.bodies:
+        raise AssertionError(
+            "negative control failed: the serialized ring must FAIL the "
+            f"overlap probe, got ok={srep.ok} bodies={len(srep.bodies)}")
+    print("  OK   serialized ring FAILS the probe (negative control)")
+    print("hlo_probe self-check PASSED")
+
+
+if __name__ == "__main__":
+    _self_check()

@@ -8,6 +8,8 @@ from apex1_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     scatter_to_sequence_parallel_region,
     gather_from_sequence_parallel_region,
     reduce_scatter_to_sequence_parallel_region,
+    all_gather_matmul,
+    matmul_reduce_scatter,
 )
 from apex1_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
     ColumnParallelLinear,

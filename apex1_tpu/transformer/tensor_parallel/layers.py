@@ -158,19 +158,29 @@ class VocabParallelEmbedding(nn.Module):
 def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
                            gather_output=False,
                            sequence_parallel_enabled=False,
-                           axis_name=AXIS_TP):
+                           axis_name=AXIS_TP, overlap=False):
     """x: replicated (or seq-sharded under SP); kernel_shard: (in, out/tp).
 
     Reference fwd: ``copy_to_tensor_model_parallel_region`` (identity fwd /
     psum bwd) then local matmul; under SP, all-gather along seq instead.
+
+    ``overlap`` (opt-in, sequence-parallel path only): decompose the
+    seq all-gather into the chunk-pipelined
+    `mappings.all_gather_matmul` ring so each ICI transfer hides behind
+    a partial dot (fwd and bwd). Off by default — the legacy monolithic
+    collective path is bit-for-bit untouched when ``overlap=False``.
     """
-    if sequence_parallel_enabled:
-        x = mp.gather_from_sequence_parallel_region(
-            x, axis_name, 0, True)
+    if sequence_parallel_enabled and overlap:
+        y = mp.all_gather_matmul(x, kernel_shard, axis_name, 0)
+        y = y.astype(x.dtype)
     else:
-        x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
-    y = jnp.dot(x, kernel_shard, preferred_element_type=jnp.float32)
-    y = y.astype(x.dtype)
+        if sequence_parallel_enabled:
+            x = mp.gather_from_sequence_parallel_region(
+                x, axis_name, 0, True)
+        else:
+            x = mp.copy_to_tensor_model_parallel_region(x, axis_name)
+        y = jnp.dot(x, kernel_shard, preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype)
     if bias_shard is not None:
         y = y + bias_shard
     if gather_output:
@@ -181,18 +191,31 @@ def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
 def row_parallel_linear(x_parallel, kernel_shard, bias=None, *,
                         input_is_parallel=True,
                         sequence_parallel_enabled=False,
-                        axis_name=AXIS_TP):
-    """x_parallel: (..., in/tp); kernel_shard: (in/tp, out)."""
+                        axis_name=AXIS_TP, overlap=False):
+    """x_parallel: (..., in/tp); kernel_shard: (in/tp, out).
+
+    ``overlap`` (opt-in, sequence-parallel path only): decompose the
+    seq reduce-scatter into the chunk-pipelined
+    `mappings.matmul_reduce_scatter` ring (transfers hidden behind the
+    per-chunk partial dots, fwd and bwd). Off by default — legacy path
+    bit-for-bit untouched when ``overlap=False``.
+    """
     if not input_is_parallel:
         x_parallel = mp.scatter_to_tensor_model_parallel_region(
             x_parallel, axis_name)
-    y = jnp.dot(x_parallel, kernel_shard,
-                preferred_element_type=jnp.float32)
-    y = y.astype(x_parallel.dtype)
-    if sequence_parallel_enabled:
-        y = mp.reduce_scatter_to_sequence_parallel_region(y, axis_name, 0)
+    if sequence_parallel_enabled and overlap:
+        y = mp.matmul_reduce_scatter(x_parallel, kernel_shard,
+                                     axis_name, 0)
+        y = y.astype(x_parallel.dtype)
     else:
-        y = mp.reduce_from_tensor_model_parallel_region(y, axis_name)
+        y = jnp.dot(x_parallel, kernel_shard,
+                    preferred_element_type=jnp.float32)
+        y = y.astype(x_parallel.dtype)
+        if sequence_parallel_enabled:
+            y = mp.reduce_scatter_to_sequence_parallel_region(y, axis_name,
+                                                              0)
+        else:
+            y = mp.reduce_from_tensor_model_parallel_region(y, axis_name)
     if bias is not None:
         y = y + bias
     return y

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from apex1_tpu.core.mesh import AXIS_TP
+from apex1_tpu.ops._common import vary as _vary  # ring-carry vma typing
 
 
 def _axis_size(axis_name):
@@ -186,3 +187,179 @@ def _sp_rs_bwd(axis_name, seq_dim, _, g):
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)
+
+
+# -- decomposed collective matmuls (chunk-pipelined, transfers overlapped) ----
+#
+# The monolithic SP collectives above expose the whole transfer before
+# (all-gather) or after (reduce-scatter) the matmul. These variants
+# decompose the collective into n per-shard chunks ppermuted around the
+# tp ring, one chunk per step, with each transfer issued so the step's
+# partial dot has NO data dependence on it — XLA's async
+# collective-permute then hides the ICI time behind the MXU work (the
+# technique of arxiv 2305.06942's fused computation-collective ops and
+# the reference's DDP bucketed overlap, applied to Megatron-SP's
+# boundary collectives). `testing.hlo_probe` pins the overlap shape on
+# optimized HLO. Opt-in via ``overlap=`` on the layer entry points in
+# `tensor_parallel.layers`; the monolithic forms above stay the default.
+
+
+def _chunk(x, seq_dim, start, size):
+    return jax.lax.dynamic_slice_in_dim(x, start, size, axis=seq_dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def all_gather_matmul(x, w, axis_name=AXIS_TP, seq_dim=0):
+    """``all_gather(x, seq_dim) @ w`` with the gather decomposed into a
+    ppermute ring: each of the n steps multiplies the currently-held
+    chunk while the NEXT chunk is already in flight (prologue + n−2
+    in-loop transfers = n−1 permutes, all overlapped).
+
+    ``x``: the local sequence chunk (S/n, …, in); ``w``: (in, out_shard).
+    Returns the full-sequence product (S, …, out_shard) in fp32 (the
+    chunk dots accumulate with ``preferred_element_type=float32``; cast
+    at the call site like the monolithic path does).
+    """
+    return _agm_loop(x, w, axis_name, seq_dim)
+
+
+def _agm_loop(x, w, axis_name, seq_dim):
+    n = _axis_size(axis_name)
+    chunk = x.shape[seq_dim]
+
+    def dot(c):
+        return jnp.dot(c, w, preferred_element_type=jnp.float32)
+
+    if n == 1:
+        return dot(x)
+    idx = _axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out_shape = list(x.shape)
+    out_shape[seq_dim] = chunk * n
+    out_shape[-1] = w.shape[-1]
+    y = _vary(jnp.zeros(tuple(out_shape), jnp.float32), axis_name)
+
+    def place(y, part, src):
+        return jax.lax.dynamic_update_slice_in_dim(
+            y, part, src * chunk, axis=seq_dim)
+
+    # prologue: issue the transfer for step 1, then dot the local chunk
+    # — the dot has no dependence on the in-flight chunk
+    cur = jax.lax.ppermute(x, axis_name, perm)
+    y = place(y, dot(x), idx)
+
+    def step(carry, t):
+        cur, y = carry
+        nxt = jax.lax.ppermute(cur, axis_name, perm)   # chunk t+1
+        y = place(y, dot(cur), (idx - t) % n)          # chunk t
+        return (nxt, y), None
+
+    if n > 2:
+        (cur, y), _ = jax.lax.scan(step, (cur, y), jnp.arange(1, n - 1))
+    # epilogue: last chunk — nothing left to transfer
+    return place(y, dot(cur), (idx - (n - 1)) % n)
+
+
+def _agm_fwd(x, w, axis_name, seq_dim):
+    return _agm_loop(x, w, axis_name, seq_dim), (x, w)
+
+
+def _agm_bwd(axis_name, seq_dim, res, g):
+    x, w = res
+    # dx: reduce-scatter of g @ wᵀ — itself the decomposed overlapped
+    # form; dw: re-gather x (Megatron re-all-gathers in backward rather
+    # than saving the gathered activation) and contract the sequence
+    dx = matmul_reduce_scatter(g, jnp.swapaxes(w, 0, 1), axis_name,
+                               seq_dim)
+    gx = _all_gather_dim(x, axis_name, seq_dim)
+    dw = jnp.matmul(gx.reshape(-1, gx.shape[-1]).T,
+                    g.reshape(-1, g.shape[-1]),
+                    preferred_element_type=jnp.float32)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+all_gather_matmul.defvjp(_agm_fwd, _agm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_reduce_scatter(x, w, axis_name=AXIS_TP, seq_dim=0):
+    """``psum_scatter(x @ w, seq_dim)`` with the reduce-scatter
+    decomposed into a ppermute ring: a travelling per-chunk accumulator
+    hops toward its owner while each step's partial dot — independent
+    of the in-flight transfer (each hop ships ``acc + pend``, both scan
+    carries; the dot's result enters the carry as next step's ``pend``)
+    — overlaps it. n accumulator hops total (one zero-valued seed hop —
+    see the in-loop comment on why add-then-hop loses the overlap);
+    each rank's own partial is computed at the last step and folded in
+    after the loop, so per chunk the summation order matches a
+    monolithic ring reduce-scatter.
+
+    ``x``: full-sequence local operand (S, …, in_shard); ``w``:
+    (in_shard, out). Returns this rank's sequence chunk (S/n, …, out)
+    of the summed product, in fp32.
+    """
+    return _mrs_loop(x, w, axis_name, seq_dim)
+
+
+def _mrs_loop(x, w, axis_name, seq_dim):
+    n = _axis_size(axis_name)
+    S = x.shape[seq_dim]
+    if S % n:
+        raise ValueError(f"seq dim {seq_dim} size {S} not divisible by "
+                         f"tp size {n}")
+    chunk = S // n
+
+    def part(c):
+        rows = _chunk(x, seq_dim, c * chunk, chunk)
+        return jnp.dot(rows, w, preferred_element_type=jnp.float32)
+
+    if n == 1:
+        return part(0)
+    idx = _axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # travelling accumulator + one-step-delayed "pending" partial: the
+    # hop ships acc+pend — BOTH carry values — and this step's dot
+    # lands in the carry untouched. An add-then-hop accumulator reads
+    # nicer but XLA fuses the add INTO the dot (convolution_add
+    # fusion), making the fused compute consume the permute-done and
+    # serializing the transfer against the MXU work — observed on the
+    # v5e AOT probe; the hlo_probe gate in tools/aot_check.py keeps it
+    # from regressing. Cost: one zero-valued seed hop (n hops instead
+    # of n−1), fully overlapped.
+    shape = list(x.shape)
+    shape[seq_dim] = chunk
+    shape[-1] = w.shape[-1]
+    acc = _vary(jnp.zeros(tuple(shape), jnp.float32), axis_name)
+    pend = _vary(jnp.zeros(tuple(shape), jnp.float32), axis_name)
+
+    def step(carry, t):
+        acc, pend = carry
+        acc = jax.lax.ppermute(acc + pend, axis_name, perm)
+        # chunk order per chunk c: devices c+1, c+2, …, c−1, then the
+        # owner folds its own partial in after the loop — the same
+        # summation order as a monolithic psum_scatter ring
+        pend = part((idx - 1 - t) % n)
+        return (acc, pend), None
+
+    (acc, pend), _ = jax.lax.scan(step, (acc, pend), jnp.arange(0, n))
+    return acc + pend
+
+
+def _mrs_fwd(x, w, axis_name, seq_dim):
+    return _mrs_loop(x, w, axis_name, seq_dim), (x, w)
+
+
+def _mrs_bwd(axis_name, seq_dim, res, g):
+    x, w = res
+    # dx: all-gather(g) @ wᵀ — the decomposed overlapped form again;
+    # dw: xᵀ contracted with the re-gathered cotangent
+    dx = all_gather_matmul(g, jnp.swapaxes(w, 0, 1), axis_name, seq_dim)
+    gg = _all_gather_dim(g, axis_name, seq_dim)
+    dw = jnp.matmul(x.reshape(-1, x.shape[-1]).T,
+                    gg.reshape(-1, gg.shape[-1]),
+                    preferred_element_type=jnp.float32)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+matmul_reduce_scatter.defvjp(_mrs_fwd, _mrs_bwd)

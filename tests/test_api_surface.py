@@ -29,9 +29,13 @@ SURFACE = {
     "apex1_tpu.parallel.distributed_optimizer": [
         "distributed_fused_adam", "distributed_fused_lamb",
         "shard_opt_state_specs", "fsdp_param_specs"],
-    "apex1_tpu.parallel.ring_attention": ["ring_attention"],
+    "apex1_tpu.parallel.ring_attention": ["ring_attention",
+                                          "ring_attention_serial"],
     "apex1_tpu.parallel.ulysses": ["ulysses_attention"],
-    "apex1_tpu.parallel.halo": ["halo_exchange"],
+    "apex1_tpu.parallel.halo": ["halo_exchange", "exchange_overlap"],
+    "apex1_tpu.testing.hlo_probe": ["optimized_hlo",
+                                    "check_collective_overlap",
+                                    "assert_collective_overlap"],
     "apex1_tpu.contrib": [
         "fmha", "SelfMultiheadAttn", "EncdecMultiheadAttn",
         "SoftmaxCrossEntropyLoss", "clip_grad_norm_", "GroupBatchNorm2d",
@@ -64,6 +68,7 @@ SURFACE = {
         "scatter_to_sequence_parallel_region",
         "gather_from_sequence_parallel_region",
         "reduce_scatter_to_sequence_parallel_region",
+        "all_gather_matmul", "matmul_reduce_scatter",
         "VocabUtility", "divide", "split_tensor_along_last_dim"],
     "apex1_tpu.transformer.pipeline_parallel": [
         "get_forward_backward_func", "forward_backward_no_pipelining",

@@ -230,3 +230,56 @@ class TestRooflineRatio:
         rec = bench_mod._attach_roofline({"value": 7.0}, "gpt2",
                                          str(res))
         assert rec == {"value": 7.0}
+
+
+class TestCommsTerm:
+    """The roofline ICI comms term: exposed (non-overlapped) bytes ADD
+    transfer time to the prediction, so `roofline_ratio` prices the
+    overlap layer's win instead of crediting serialized collectives as
+    free; and the analytic comms table itself is well-formed."""
+
+    def test_predicted_rate_prices_exposed_ici_bytes(self, bench_mod,
+                                                     tmp_path):
+        res = tmp_path / "perf_results"
+        res.mkdir()
+        # off-TPU capability = v5e row: 197 TF, 819 GB/s, ici link
+        # 200/(2*2) = 50 GB/s. base t = 1 s; exposed 50 GB -> +1 s.
+        (res / "predicted_r9.json").write_text(json.dumps({"steps": [
+            {"name": "gpt2", "units_per_step": 16384,
+             "flops": 197e12, "bytes": 819e9,
+             "ici_exposed_bytes": 50e9}]}))
+        assert bench_mod._predicted_rate("gpt2", str(res)) == \
+            pytest.approx(16384.0 / 2.0)
+
+    def test_zero_ici_field_changes_nothing(self, bench_mod, tmp_path):
+        res = tmp_path / "perf_results"
+        res.mkdir()
+        (res / "predicted_r9.json").write_text(json.dumps({"steps": [
+            {"name": "gpt2", "units_per_step": 16384,
+             "flops": 197e12, "bytes": 819e9,
+             "ici_bytes": 0.0, "ici_exposed_bytes": 0.0}]}))
+        assert bench_mod._predicted_rate("gpt2", str(res)) == \
+            pytest.approx(16384.0)
+
+    def test_ici_link_rate(self):
+        from apex1_tpu.core.capability import ici_link_gbps
+        # v5e: 200 GB/s aggregate over a 2D torus's 4 links
+        assert ici_link_gbps("v5e") == pytest.approx(50.0)
+        assert ici_link_gbps("v5p") == pytest.approx(600.0 / 6.0)
+
+    def test_predict_comms_rows(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_pp_for_test", _REPO / "tools" / "predict_perf.py")
+        pp = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pp)
+        rows = pp.predict_comms()
+        assert len(rows) == 8  # {v5e,v5p} x {cp4,cp8} x {fwd,bwd}
+        for r in rows:
+            assert r["exposed_bytes_serial"] == r["ici_bytes"]
+            assert 0.0 <= r["exposed_bytes_overlap"] <= r["ici_bytes"]
+        # at the 16k shape the attend covers the hop: overlap hides all
+        v5e_fwd4 = next(r for r in rows if r["generation"] == "v5e"
+                        and r["cp"] == 4 and r["phase"] == "fwd")
+        assert v5e_fwd4["exposed_bytes_overlap"] == 0.0
+        assert v5e_fwd4["t_serial_ms"] > 0.1

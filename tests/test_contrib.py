@@ -15,7 +15,8 @@ from apex1_tpu.contrib import (GroupNorm, TransducerJoint, TransducerLoss,
                                focal_loss, group_norm, index_mul_2d,
                                transducer_joint, transducer_loss)
 from apex1_tpu.core.mesh import make_mesh
-from apex1_tpu.parallel.halo import halo_exchange, spatial_conv2d
+from apex1_tpu.parallel.halo import (exchange_overlap, halo_exchange,
+                                     spatial_conv2d)
 
 
 class TestFocalLoss:
@@ -202,6 +203,47 @@ class TestHaloExchange:
             mesh=mesh, in_specs=P(None, "cp"), out_specs=P(None, "cp")))
         out = fn(x)
         assert out.shape == (1, 8 + 2 * 4, 4, 2)  # +2 halo rows per shard
+
+    @pytest.mark.parametrize("periodic", [False, True])
+    def test_exchange_overlap_matches_exchange_plus_interior(
+            self, rng, devices, periodic):
+        """The overlap entry changes scheduling, not values: extended
+        shard == halo_exchange(x), interior == interior_fn(x)."""
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        x = jnp.asarray(rng.normal(size=(2, 16, 4, 3)), jnp.float32)
+
+        def interior_fn(x):
+            return jnp.tanh(x) * 2.0
+
+        def overlapped(x):
+            return exchange_overlap(x, interior_fn, "cp", halo=2, dim=1,
+                                    periodic=periodic)
+
+        def composite(x):
+            return (halo_exchange(x, "cp", halo=2, dim=1,
+                                  periodic=periodic), interior_fn(x))
+
+        specs = (P(None, "cp"), P(None, "cp"))
+        got = jax.jit(jax.shard_map(overlapped, mesh=mesh,
+                                    in_specs=P(None, "cp"),
+                                    out_specs=specs))(x)
+        want = jax.jit(jax.shard_map(composite, mesh=mesh,
+                                     in_specs=P(None, "cp"),
+                                     out_specs=specs))(x)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_exchange_overlap_zero_halo(self, rng, devices):
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        x = jnp.asarray(rng.normal(size=(1, 8, 2, 1)), jnp.float32)
+        ext, interior = jax.jit(jax.shard_map(
+            lambda x: exchange_overlap(x, lambda v: v + 1.0, "cp",
+                                       halo=0, dim=1),
+            mesh=mesh, in_specs=P(None, "cp"),
+            out_specs=(P(None, "cp"), P(None, "cp"))))(x)
+        np.testing.assert_array_equal(np.asarray(ext), np.asarray(x))
+        np.testing.assert_allclose(np.asarray(interior),
+                                   np.asarray(x) + 1.0)
 
 
 def test_network_to_half_dense_bias_goes_half():

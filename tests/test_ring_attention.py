@@ -87,6 +87,142 @@ def test_ring_gqa(rng, devices):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+class TestOverlappedSchedule:
+    """The double-buffered rewrite against its anchors: bit-for-bit
+    forward parity with the retained serialized schedule (same
+    attend/merge order — only the permutes' dataflow moved), and grad
+    parity with the global gold through BOTH backward paths (the
+    custom-VJP overlapped ring and XLA's transpose of the scan)."""
+
+    def test_fwd_bitwise_matches_serial(self, rng, devices):
+        from apex1_tpu.parallel.ring_attention import ring_attention_serial
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q, k, v = _mk(rng)
+        seg = jnp.sort(jnp.asarray(rng.integers(0, 3, size=(B, S)),
+                                   jnp.int32), axis=1)
+        spec = P(None, None, "cp", None)
+        segspec = P(None, "cp")
+
+        def mk(fn):
+            return jax.jit(jax.shard_map(
+                lambda q, k, v, s: fn(q, k, v, "cp", causal=True,
+                                      segment_ids=s),
+                mesh=mesh, in_specs=(spec,) * 3 + (segspec,),
+                out_specs=spec))
+
+        got = mk(ring_attention)(q, k, v, seg)
+        ser = mk(ring_attention_serial)(q, k, v, seg)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ser))
+
+    @pytest.mark.parametrize("use_custom_vjp", [True, False])
+    def test_grads_both_vjp_paths(self, rng, devices, use_custom_vjp):
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q, k, v = _mk(rng)
+        spec = P(None, None, "cp", None)
+        ring = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal=True,
+                                           use_custom_vjp=use_custom_vjp),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(ring(q, k, v))),
+            argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(
+                flash_attention(q, k, v, causal=True))),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    def test_gqa_grads_match_global(self, rng, devices):
+        """GQA through the custom backward: the per-shard dk/dv group
+        reduction must match the unsharded gold."""
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q = jnp.asarray(rng.normal(size=(B, 4, S, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, 2, S, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, 2, S, D)), jnp.float32)
+        spec = P(None, None, "cp", None)
+        ring = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(ring(q, k, v))),
+            argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(
+                flash_attention(q, k, v, causal=True))),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    def test_segment_grads_ride_the_bwd_ring(self, rng, devices):
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q, k, v = _mk(rng)
+        seg = jnp.sort(jnp.asarray(rng.integers(0, 3, size=(B, S)),
+                                   jnp.int32), axis=1)
+        spec = P(None, None, "cp", None)
+        ring = jax.shard_map(
+            lambda q, k, v, s: ring_attention(q, k, v, "cp", causal=True,
+                                              segment_ids=s),
+            mesh=mesh, in_specs=(spec,) * 3 + (P(None, "cp"),),
+            out_specs=spec)
+        got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(ring(q, k, v, seg))),
+            argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(flash_attention(
+                q, k, v, causal=True, segment_ids=seg))),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    def test_pallas_step_backward_interpret(self, rng, devices):
+        """Execute the PALLAS branch of the ring backward (interpret
+        mode on the CPU mesh): the CPU suite otherwise only runs
+        `_step_grads_xla`, while TPU training runs only
+        `_step_grads_pallas` — a wiring bug in its res/lse-padding/
+        dlse=0 handling must not ship numerics-untested."""
+        from apex1_tpu.ops import force_impl
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q = jnp.asarray(rng.normal(size=(1, 2, S, 16)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, S, 16)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 1, S, 16)), jnp.float32)
+        spec = P(None, None, "cp", None)
+
+        def local(q, k, v):
+            with force_impl("pallas"):
+                return ring_attention(q, k, v, "cp", causal=True)
+
+        ring = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec, check_vma=False)
+        got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(ring(q, k, v))),
+            argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(
+                flash_attention(q, k, v, causal=True))),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+    def test_ring_size_two(self, rng, devices):
+        """cp=2 exercises both peeled edges (empty scan bodies)."""
+        mesh = make_mesh(cp=2, dp=1, devices=devices[:2])
+        q, k, v = _mk(rng)
+        spec = P(None, None, "cp", None)
+        ring = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "cp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(ring(q, k, v))),
+            argnums=(0, 1, 2)))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(
+                flash_attention(q, k, v, causal=True))),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5)
+
+
 class TestUlysses:
     """All-to-all sequence parallelism (≙ DeepSpeed Ulysses; SURVEY §2.6
     [absent] in apex): head-scatter attention over cp must equal
@@ -152,6 +288,28 @@ class TestUlysses:
             jax.jit(jax.shard_map(
                 f, mesh=mesh, in_specs=(P(None, None, "cp"),),
                 out_specs=P(None, None, "cp"), check_vma=False))(q)
+
+    def test_ring_fallback_on_indivisible_heads(self, rng, devices):
+        """fallback='ring' routes head counts ulysses cannot shard
+        through the overlapped ring instead of raising — same numerics
+        as unsharded flash."""
+        from jax.sharding import PartitionSpec as P
+
+        from apex1_tpu.core.mesh import make_mesh
+        from apex1_tpu.parallel.ulysses import ulysses_attention
+        mesh = make_mesh(cp=4, dp=1, devices=devices[:4])
+        q = jnp.asarray(rng.normal(size=(1, 2, 32, 8)), jnp.float32)
+
+        def f(q):
+            return ulysses_attention(q, q, q, "cp", causal=True,
+                                     fallback="ring")
+
+        got = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, None, "cp"),),
+            out_specs=P(None, None, "cp"), check_vma=False))(q)
+        want = flash_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
 
     def test_llama_ulysses_cp(self, rng, devices):
         """Llama with cp_impl='ulysses': sharded forward == unsharded."""

@@ -299,3 +299,115 @@ class TestUtils:
         assert not np.array_equal(np.asarray(k_default), np.asarray(k_mp))
         with pytest.raises(RuntimeError):
             tr.add("default", 1)
+
+
+class TestDecomposedCollectiveMatmul:
+    """The chunk-pipelined overlap forms (`all_gather_matmul`,
+    `matmul_reduce_scatter`) against the monolithic collective+dot
+    composites they decompose — fwd and grads — plus the layer-level
+    `overlap=` plumbing (off = the untouched legacy path)."""
+
+    S, IN, OUT = 32, 16, 24
+
+    def _arrs(self, rng):
+        x = jnp.asarray(rng.normal(size=(self.S, self.IN)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(self.IN, self.OUT)), jnp.float32)
+        return x, w
+
+    def test_all_gather_matmul_matches_composite(self, mesh, rng):
+        x, w = self._arrs(rng)
+
+        def got(x, w):
+            return tp.all_gather_matmul(x, w, "tp", 0)
+
+        def want(x, w):
+            xg = jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+            return jnp.dot(xg, w, preferred_element_type=jnp.float32)
+
+        a = tp_shard_map(mesh, got, (P("tp", None), P(None, "tp")),
+                         P(None, "tp"))(x, w)
+        b = tp_shard_map(mesh, want, (P("tp", None), P(None, "tp")),
+                         P(None, "tp"))(x, w)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_matmul_reduce_scatter_matches_composite(self, mesh, rng):
+        x, w = self._arrs(rng)
+
+        def got(x, w):
+            return tp.matmul_reduce_scatter(x, w, "tp", 0)
+
+        def want(x, w):
+            y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+            return jax.lax.psum_scatter(y, "tp", scatter_dimension=0,
+                                        tiled=True)
+
+        a = tp_shard_map(mesh, got, (P(None, "tp"), P("tp", None)),
+                         P("tp", None))(x, w)
+        b = tp_shard_map(mesh, want, (P(None, "tp"), P("tp", None)),
+                         P("tp", None))(x, w)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("which", ["agm", "mrs"])
+    def test_grads_match_composite(self, mesh, rng, which):
+        x, w = self._arrs(rng)
+        if which == "agm":
+            in_specs = (P("tp", None), P(None, "tp"))
+
+            def dec(x, w):
+                return tp.all_gather_matmul(x, w, "tp", 0)
+
+            def ref(x, w):
+                xg = jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+                return jnp.dot(xg, w, preferred_element_type=jnp.float32)
+        else:
+            in_specs = (P(None, "tp"), P("tp", None))
+
+            def dec(x, w):
+                return tp.matmul_reduce_scatter(x, w, "tp", 0)
+
+            def ref(x, w):
+                y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+                return jax.lax.psum_scatter(y, "tp", scatter_dimension=0,
+                                            tiled=True)
+
+        def grads(f):
+            sm = tp_shard_map(mesh,
+                              lambda x, w: jnp.sum(f(x, w) ** 2),
+                              in_specs, P())
+            return jax.jit(jax.grad(lambda x, w: sm(x, w).sum(),
+                                    argnums=(0, 1)))(x, w)
+
+        for a, b in zip(grads(dec), grads(ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_layer_overlap_kwarg_parity(self, mesh, rng):
+        """Column/Row SP paths with overlap on == off (tolerance: the
+        chunked dots re-associate the sum); off IS the legacy code."""
+        x, w = self._arrs(rng)
+
+        def col(ov):
+            return tp_shard_map(
+                mesh,
+                lambda x, w: tp.column_parallel_linear(
+                    x, w, sequence_parallel_enabled=True, axis_name="tp",
+                    overlap=ov),
+                (P("tp", None), P(None, "tp")), P(None, "tp"))(x, w)
+
+        np.testing.assert_allclose(np.asarray(col(True)),
+                                   np.asarray(col(False)),
+                                   rtol=1e-5, atol=1e-5)
+
+        def row(ov):
+            return tp_shard_map(
+                mesh,
+                lambda x, w: tp.row_parallel_linear(
+                    x, w, sequence_parallel_enabled=True, axis_name="tp",
+                    overlap=ov),
+                (P(None, "tp"), P("tp", None)), P("tp", None))(x, w)
+
+        np.testing.assert_allclose(np.asarray(row(True)),
+                                   np.asarray(row(False)),
+                                   rtol=1e-5, atol=1e-5)

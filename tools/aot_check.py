@@ -322,6 +322,115 @@ def main():
         coll(f"ring attention cp={n} (S={S} global)", mk_attn("ring"))
         coll(f"ulysses attention cp={n} (S={S} global)", mk_attn("uly"))
 
+        # --- communication-overlap probes (apex1_tpu.testing.hlo_probe):
+        # the double-buffered ring's pinned property — every scan body
+        # issues collective-permute-start BEFORE the attention compute
+        # and consumes -done AFTER it — asserted on the OPTIMIZED v5e
+        # executable text, forward AND backward, with the retained
+        # serialized ring as the negative control (the probe must be
+        # falsifiable). This AOT gate is the REAL guard for the TPU
+        # ring path: on the CPU suite the Pallas ring only executes in
+        # interpret mode under check_vma=False (VERDICT r5 Weak #7) —
+        # see testing/hlo_probe.py STANDING-RISK NOTE.
+        from apex1_tpu.parallel.ring_attention import (ring_attention,
+                                                       ring_attention_serial)
+        from apex1_tpu.testing.hlo_probe import (assert_collective_overlap,
+                                                 check_collective_overlap)
+
+        def probe(name, build_fn, *, expect_fail=False):
+            nonlocal ok
+            try:
+                f, arrs = build_fn()
+                txt = jax.jit(f).lower(*arrs).compile().as_text()
+                if expect_fail:
+                    rep = check_collective_overlap(txt)
+                    if rep.ok or not rep.bodies:
+                        raise AssertionError(
+                            f"negative control must FAIL the probe, got "
+                            f"ok={rep.ok} bodies={len(rep.bodies)}")
+                    print(f"  OK   {name:48s} FAILS probe as required",
+                          flush=True)
+                else:
+                    rep = assert_collective_overlap(txt,
+                                                    expect_mode="async")
+                    det = "; ".join(b.detail for b in rep.bodies)
+                    print(f"  OK   {name:48s} {det[:70]}", flush=True)
+            except Exception as e:
+                ok = False
+                print(f"  FAIL {name}: {type(e).__name__}: "
+                      f"{str(e)[:300]}", flush=True)
+
+        Bp, Hp, Sp, Dp = 1, 4, 4096, 128
+        cp_spec = P(None, None, "cp")
+        psh = NamedSharding(cp_mesh, cp_spec)
+        parrs = [jax.ShapeDtypeStruct((Bp, Hp, Sp, Dp), jnp.bfloat16,
+                                      sharding=psh)] * 3
+
+        def ring_fwd_builder():
+            def local(q, k, v):
+                with force_impl("pallas"):
+                    return ring_attention(q, k, v, "cp", causal=True)
+            return jax.shard_map(local, mesh=cp_mesh,
+                                 in_specs=(cp_spec,) * 3,
+                                 out_specs=cp_spec), parrs
+
+        def ring_bwd_builder():
+            def local(q, k, v):
+                with force_impl("pallas"):
+                    return ring_attention(q, k, v, "cp", causal=True)
+            sm = jax.shard_map(local, mesh=cp_mesh,
+                               in_specs=(cp_spec,) * 3,
+                               out_specs=cp_spec)
+
+            def loss(q, k, v):
+                return jnp.sum(sm(q, k, v).astype(jnp.float32) ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2)), parrs
+
+        def ring_serial_builder():
+            def local(q, k, v):
+                with force_impl("pallas"):
+                    return ring_attention_serial(q, k, v, "cp",
+                                                 causal=True)
+            return jax.shard_map(local, mesh=cp_mesh,
+                                 in_specs=(cp_spec,) * 3,
+                                 out_specs=cp_spec), parrs
+
+        probe(f"overlap probe: ring fwd cp={n}", ring_fwd_builder)
+        probe(f"overlap probe: ring fwd+bwd cp={n}", ring_bwd_builder)
+        probe(f"overlap probe: serialized ring (negative)",
+              ring_serial_builder, expect_fail=True)
+
+        def tp_overlap_builder():
+            # chunk-pipelined decomposed collective matmuls (the
+            # overlap= path of Column/RowParallelLinear under SP)
+            from apex1_tpu.transformer.tensor_parallel import mappings
+            tp_mesh2 = make_mesh(tp=n, dp=1, devices=list(topo.devices))
+            S_l, hid, ffn = 2048, 1024, 4096
+
+            def local(x, w1, w2):
+                h = mappings.all_gather_matmul(x, w1, "tp", 0)
+                return mappings.matmul_reduce_scatter(
+                    h.astype(jnp.bfloat16), w2, "tp", 0)
+
+            f = jax.shard_map(
+                local, mesh=tp_mesh2,
+                in_specs=(P("tp"), P(None, "tp"), P("tp", None)),
+                out_specs=P("tp"), check_vma=False)
+            ns = lambda spec: NamedSharding(tp_mesh2, spec)
+            arrs = [
+                jax.ShapeDtypeStruct((S_l * n, hid), jnp.bfloat16,
+                                     sharding=ns(P("tp"))),
+                jax.ShapeDtypeStruct((hid, ffn), jnp.bfloat16,
+                                     sharding=ns(P(None, "tp"))),
+                jax.ShapeDtypeStruct((ffn, hid), jnp.bfloat16,
+                                     sharding=ns(P("tp", None))),
+            ]
+            return f, arrs
+
+        probe(f"overlap probe: decomposed TP matmuls tp={n}",
+              tp_overlap_builder)
+
         def moe_builder():
             ep_mesh = make_mesh(ep=n, dp=1, devices=list(topo.devices))
             cfg = MoEConfig(num_experts=2 * n, top_k=2,

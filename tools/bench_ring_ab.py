@@ -1,0 +1,180 @@
+"""A/B: serialized vs double-buffered ring attention at the 16k
+llama_longctx attention shape — the wall-clock form of the overlap win
+the hlo_probe pins structurally and predict_perf's comms term prices
+analytically (VERDICT r5 Weak #5: the exposed ppermute latency at 16k
+context is the largest unclaimed perf item; llama_longctx measured
+0.36x its roofline).
+
+Runs the SAME fwd+bwd attention step through
+`parallel.ring_attention_serial` (rotate→attend, every transfer
+exposed) and `parallel.ring_attention` (double-buffered, custom-VJP
+overlapped backward) over a cp ring and emits one JSON line with both
+timings. Queue entry ``ring_overlap_ab`` in tools/tpu_watch.sh runs it
+AHEAD of the llama_longctx re-bench.
+
+Device requirements: a cp ring needs >= 2 devices. On a single-chip
+window the tool emits a skip record (rc 0 — the queue must keep
+moving); on CPU (rehearsal) it builds the 8-device virtual mesh and
+auto-shrinks shapes, validating the command line end-to-end.
+
+Usage: python tools/bench_ring_ab.py [--cp N] [--iters K] [--seq S]
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(record):
+    print(json.dumps(record), flush=True)
+
+
+def _backend_is_cpu(timeout_s=120.0):
+    """Probe the default backend in a SUBPROCESS (the main process must
+    not initialize a backend before deciding whether to build the
+    8-device virtual CPU mesh — device-count flags only act before
+    first init). False on probe failure: a dead accelerator tunnel then
+    follows the accelerator path, whose init failure is the honest
+    error (tpu_watch only runs this entry after its tunnel probe)."""
+    import subprocess
+    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); "
+            "print('BACKEND=' + jax.default_backend())")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        return "BACKEND=cpu" in out.stdout
+    except Exception:
+        return False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cp", type=int, default=None,
+                    help="ring size (default: all available devices)")
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None,
+                    help="GLOBAL sequence length (default 16384 on "
+                         "accelerators, 512 on cpu)")
+    args = ap.parse_args()
+
+    import jax
+
+    # env pin wins when present; otherwise ask the backend itself (in a
+    # subprocess) so a plain CPU-only box rehearses on the virtual mesh
+    # instead of emitting a bogus single-device skip
+    plat = os.environ.get("JAX_PLATFORMS", "").strip()
+    on_cpu = plat == "cpu" if plat else _backend_is_cpu()
+    if on_cpu:
+        from apex1_tpu.testing import force_virtual_cpu_devices
+        force_virtual_cpu_devices(8)
+    else:
+        from apex1_tpu.testing import honor_jax_platforms_env
+        honor_jax_platforms_env()
+    from apex1_tpu.testing import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex1_tpu.core.mesh import make_mesh
+    from apex1_tpu.parallel.ring_attention import (ring_attention,
+                                                   ring_attention_serial)
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    n = args.cp or min(len(devices), 8)
+    if n < 2:
+        _emit({"metric": f"ring_overlap_ab [{backend}]", "value": 0.0,
+               "error": f"cp ring needs >= 2 devices, have "
+                        f"{len(devices)} — skipped (multichip window "
+                        f"required)"})
+        return
+    accel = backend not in ("cpu",)
+    # llama_longctx attention shape (B=1, Hq=32, Hkv=4, D=64, S=16k);
+    # cpu rehearsal auto-shrinks like bench.py configs do
+    if accel:
+        B, Hq, Hkv, D = 1, 32, 4, 64
+        S = args.seq or 16384
+        iters = args.iters or 8
+        dtype = jnp.bfloat16
+    else:
+        B, Hq, Hkv, D = 1, 4, 2, 16
+        S = args.seq or 512
+        iters = args.iters or 2
+        dtype = jnp.float32
+    mesh = make_mesh(cp=n, dp=1, devices=devices[:n])
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, Hq, S, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), dtype)
+    spec = P(None, None, "cp", None)
+
+    def timed(ring_fn, name):
+        sm = jax.shard_map(
+            lambda q, k, v: ring_fn(q, k, v, "cp", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=False)
+
+        def loss(q, k, v):
+            return jnp.sum(sm(q, k, v).astype(jnp.float32) ** 2)
+
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+
+        def many(q, k, v):
+            # fwd+bwd iters in ONE dispatch (bench.py methodology: the
+            # tunneled backend's dispatch latency must not pollute it);
+            # each iteration's q depends on the previous gradient so the
+            # loop body is NOT loop-invariant (XLA would hoist a single
+            # grad out and the timing would measure one step, not iters)
+            def one(q):
+                dq, dk, dv = grad(q, k, v)
+                return (q + (1e-6 * dq).astype(q.dtype),
+                        jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv))
+
+            def body(_, carry):
+                q, _acc = carry
+                return one(q)
+
+            return jax.lax.fori_loop(0, iters - 1, body, one(q))
+
+        compiled = jax.jit(many).lower(q, k, v).compile()
+        out = compiled(q, k, v)
+        jax.block_until_ready(out)              # warmup
+        t0 = time.perf_counter()
+        out = compiled(q, k, v)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        s = float(out[1])
+        if not math.isfinite(s):
+            raise RuntimeError(f"{name}: non-finite check value {s}")
+        return dt
+
+    try:
+        t_serial = timed(ring_attention_serial, "serial")
+        t_overlap = timed(ring_attention, "overlapped")
+        _emit({
+            "metric": f"ring_overlap_ab fwd+bwd cp={n} S={S} "
+                      f"[{backend}]",
+            "value": round(t_serial / t_overlap, 4),   # speedup
+            "unit": "x (serial/overlapped step time)",
+            "serial_ms": round(t_serial * 1e3, 3),
+            "overlapped_ms": round(t_overlap * 1e3, 3),
+            "shape": {"B": B, "Hq": Hq, "Hkv": Hkv, "S": S, "D": D,
+                      "cp": n, "iters": iters},
+        })
+    except Exception as e:
+        _emit({"metric": f"ring_overlap_ab [{backend}]", "value": 0.0,
+               "error": f"{type(e).__name__}: {str(e)[:300]}"})
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,8 @@ g.dryrun_multichip(8)
 EOF
 echo "== serving engine smoke (CPU, correctness + two-executable gate) =="
 python tools/bench_serving.py --smoke > /dev/null
-echo "== AOT Mosaic + HBM checks (v5e) =="
+echo "== hlo overlap probe (ring fwd+bwd vs serialized, CPU-compiled) =="
+python -m apex1_tpu.testing.hlo_probe
+echo "== AOT Mosaic + HBM checks (v5e; incl. async overlap probes) =="
 python tools/aot_check.py
 echo "ALL CHECKS PASSED"

@@ -92,12 +92,26 @@ def _cost(compiled):
     return flops, nbytes
 
 
-def _roofline(flops, nbytes, cap):
-    """Predicted seconds + binding side for one program on one chip."""
+def _roofline(flops, nbytes, cap, ici_exposed_bytes=0.0):
+    """Predicted seconds + binding side for one program on one chip.
+
+    ``ici_exposed_bytes``: ICI traffic NOT hidden behind compute — it
+    ADDS to the roofline time (an overlapped transfer costs nothing
+    here; an exposed one serializes). Priced at the conservative
+    per-neighbor link rate (`core.capability.ici_link_gbps`). 0 for
+    the single-chip bench rows."""
+    from apex1_tpu.core.capability import ici_link_gbps
+
     t_mxu = flops / (cap.bf16_tflops * 1e12)
     t_hbm = nbytes / (cap.hbm_gbps * 1e9)
     t = max(t_mxu, t_hbm)
     bound = "MXU" if t_mxu >= t_hbm else "HBM"
+    if ici_exposed_bytes:
+        link = ici_link_gbps(cap.generation)
+        t_ici = ici_exposed_bytes / (link * 1e9) if link else 0.0
+        t = t + t_ici
+        if t_ici > max(t_mxu, t_hbm):
+            bound = "ICI"
     mfu = flops / (t * cap.bf16_tflops * 1e12) if t > 0 else 0.0
     return t, bound, mfu
 
@@ -164,6 +178,11 @@ def predict_steps(topo, configs):
                 units_per_step=units_per_step, flops=flops, bytes=nbytes,
                 flops_pallas_visible=flops_vis,
                 mfu_correction=(flops / flops_vis if flops_vis else None),
+                # single-chip bench programs move no ICI bytes; the keys
+                # exist so multichip rows can carry the comms term
+                # bench.py::_predicted_rate prices (exposed = NOT hidden
+                # behind compute; see predict_comms)
+                ici_bytes=0.0, ici_exposed_bytes=0.0,
                 temp_gib=mem.temp_size_in_bytes / 2**30,
                 args_gib=mem.argument_size_in_bytes / 2**30))
             print(f"  OK   {name:14s} flops {flops:.3e} "
@@ -262,7 +281,71 @@ def predict_kernels(_topo):
     return rows
 
 
-def render(step_rows, kernel_rows):
+def predict_comms():
+    """Analytic ICI comms term for the ring-attention CP path at the
+    llama_longctx attention shape (the 16k config that measured 0.36x
+    its single-chip roofline): per ring step the K/V shard transfer
+    either serializes against the attend (the pre-overlap schedule) or
+    hides behind it (the double-buffered schedule, hlo_probe-pinned).
+    ``exposed_bytes`` is what `_roofline`'s comms term prices — the
+    overlapped rows carry only the residual the attend cannot cover,
+    so bench.py's `predicted`/`roofline_ratio` sees the win instead of
+    silently crediting serialized transfers as free.
+
+    Forward per visiting shard: K+V bf16 hops vs 4·B·Hq·S_l²·D·0.5
+    causal attend flops. Backward: K/V hops + fp32 dK/dV accumulator
+    hops (the travelling-accumulator schedule pays one extra seed hop,
+    n instead of n−1 — see parallel/ring_attention.py) vs the ~2.5x
+    fwd per-shard backward compute.
+    """
+    from apex1_tpu.core.capability import get_capability, ici_link_gbps
+
+    B, Hq, Hkv, S, D = 1, 32, 4, 16384, 64
+    rows = []
+    for gen in ("v5e", "v5p"):
+        cap = get_capability(gen)
+        link = ici_link_gbps(gen)
+        if not link:
+            # capability row carries no ICI figure — nothing to price
+            print(f"  SKIP ring comms {gen}: no ici_gbps in capability "
+                  f"row", flush=True)
+            continue
+        for n in (4, 8):
+            S_l = S // n
+            kv_hop = 2 * B * Hkv * S_l * D * 2          # K+V bf16
+            dkv_hop = 2 * B * Hkv * S_l * D * 4         # dK+dV fp32
+            att = 4 * B * Hq * S_l * S_l * D * 0.5      # causal attend
+            bwd = 2.5 * att
+            t_hop_f = kv_hop / (link * 1e9)
+            t_hop_b = (kv_hop + dkv_hop) / (link * 1e9)
+            t_att = att / (cap.bf16_tflops * 1e12)
+            t_bwd = bwd / (cap.bf16_tflops * 1e12)
+            fwd_bytes = (n - 1) * kv_hop
+            bwd_bytes = n * (kv_hop + dkv_hop)
+            exp_f_overlap = (n - 1) * max(0.0, t_hop_f - t_att) * \
+                (link * 1e9)
+            exp_b_overlap = n * max(0.0, t_hop_b - t_bwd) * (link * 1e9)
+            for phase, total, serial_t, overlap_exp in (
+                    ("fwd", fwd_bytes, (n - 1) * t_hop_f, exp_f_overlap),
+                    ("bwd", bwd_bytes, n * t_hop_b, exp_b_overlap)):
+                rows.append(dict(
+                    name=f"ring llama_longctx {phase} cp={n}",
+                    generation=gen, cp=n, phase=phase,
+                    ici_bytes=float(total),
+                    exposed_bytes_serial=float(total),
+                    exposed_bytes_overlap=float(overlap_exp),
+                    t_serial_ms=serial_t * 1e3,
+                    t_exposed_overlap_ms=(overlap_exp / (link * 1e9))
+                    * 1e3,
+                    source="analytic"))
+            print(f"  OK   ring comms {gen} cp={n}: fwd hop "
+                  f"{kv_hop / 2**20:.1f} MiB vs attend {t_att * 1e3:.2f} "
+                  f"ms -> exposed {exp_f_overlap / 2**20:.1f} MiB "
+                  f"(serial {fwd_bytes / 2**20:.1f})", flush=True)
+    return rows
+
+
+def render(step_rows, kernel_rows, comms_rows=()):
     from apex1_tpu.core.capability import get_capability
     v5e, v5p = get_capability("v5e"), get_capability("v5p")
     lines = []
@@ -350,6 +433,29 @@ def render(step_rows, kernel_rows):
           f"| {r['bytes'] / 2**20:,.1f} | {ai:.0f} | {be} "
           f"| {te * 1e3:.3f} | {tf:.1f} |")
     w("")
+    if comms_rows:
+        w("## ICI comms term — ring attention at the llama_longctx "
+          "shape (analytic)")
+        w("")
+        w("`exposed` = transfer time NOT hidden behind compute — the "
+          "serialized (pre-overlap) schedule exposes every hop; the "
+          "double-buffered schedule exposes only the residual per-hop "
+          "time the attend cannot cover. bench.py's "
+          "`predicted`/`roofline_ratio` prices a row's "
+          "`ici_exposed_bytes` at the per-link rate "
+          "(`core.capability.ici_link_gbps`), so the overlap win is "
+          "scoreable, not just asserted (the schedule property itself "
+          "is pinned by `testing.hlo_probe` in tools/aot_check.py).")
+        w("")
+        w("| ring phase | gen | cp | ICI MiB | exposed serial ms "
+          "| exposed overlapped ms |")
+        w("|---|---|---|---|---|---|")
+        for r in comms_rows:
+            w(f"| {r['phase']} | {r['generation']} | {r['cp']} "
+              f"| {r['ici_bytes'] / 2**20:,.1f} "
+              f"| {r['t_serial_ms']:.2f} "
+              f"| {r['t_exposed_overlap_ms']:.2f} |")
+        w("")
     w("Validation protocol for the first hardware window: "
       "`tools/tpu_watch.sh`'s queue writes measured step_ms/MFU for "
       "every config above; divide measured by predicted and record the "
@@ -388,8 +494,10 @@ def main():
     if not args.skip_kernels:
         print(f"== kernel cost models ({TOPOLOGY}) ==", flush=True)
         kernel_rows = predict_kernels(topo)
+    print("== ICI comms term (ring attention, analytic) ==", flush=True)
+    comms_rows = predict_comms()
 
-    md = render(step_rows, kernel_rows)
+    md = render(step_rows, kernel_rows, comms_rows)
     for path in (args.out, args.json):
         d = os.path.dirname(path)
         if d:
@@ -398,9 +506,11 @@ def main():
         f.write(md)
     with open(args.json, "w") as f:
         json.dump({"topology": TOPOLOGY, "steps": step_rows,
-                   "kernels": kernel_rows}, f, indent=1)
+                   "kernels": kernel_rows, "comms": comms_rows},
+                  f, indent=1)
     print(f"wrote {args.out} + {args.json}", flush=True)
-    failures = sum("error" in r for r in step_rows + kernel_rows)
+    failures = sum("error" in r
+                   for r in step_rows + kernel_rows + comms_rows)
     print(f"{failures} failures" if failures else "ALL OK", flush=True)
     sys.exit(1 if failures else 0)
 

@@ -16,6 +16,10 @@
 #      block sweep — the 0.36x-roofline localizer for
 #      llama_longctx (VERDICT r5) — runs BEFORE its re-bench
 #      so the re-bench rides any folded-in winner             (~10 min)
+#   4b. ring_overlap_ab: serialized vs double-buffered ring at
+#      the 16k llama_longctx shape (needs >= 2 devices; emits a
+#      skip record on a single-chip window), also BEFORE the
+#      llama_longctx re-bench                                 (~10 min)
 #   5. llama_longctx re-bench + remaining configs            (~20 min)
 #   6. per-op profile + cond-elision probe                   (~10 min)
 #   7. kernel A/B sweeps + remaining tune_kernels sweeps     (~2x40 min)
@@ -123,6 +127,10 @@ run bench_bert_lg   1500 python bench.py --config bert_large --timeout 1200
 # perf_results/tuning/) runs AHEAD of the llama_longctx re-bench: the
 # 16k config measured 0.36x its roofline and the sweep is the localizer
 run tune_attention  1800 python tools/tune_kernels.py --kernel attention
+# serialized-vs-overlapped ring A/B at the 16k shape, ahead of the
+# llama_longctx re-bench (the overlap layer is the claimed fix for its
+# 0.36x roofline ratio — measure the claim before the headline number)
+run ring_overlap_ab 1800 python tools/bench_ring_ab.py
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
 run bench_bert      1200 python bench.py --config bert --timeout 1000
 run bench_resnet    1200 python bench.py --config resnet --timeout 1000
