@@ -11,45 +11,45 @@ dispatches to the Pallas flash kernel
 projection/bias/residual epilogues — the per-variant kernel zoo collapses.
 
 Layout parity: inputs are **(S, B, E)** seq-first, like the reference
-(fairseq/Megatron convention). Attention-probability dropout (the
-reference drops probabilities inside the kernel) uses the materialized
-composite path when active — training LLM configs run dropout=0 on the
-flash path; with dropout>0 the capability is preserved at the composite's
-memory cost.
+(fairseq/Megatron convention). Attention-probability dropout is FUSED
+into the flash kernel between softmax and AV (``dropout_p`` +
+counter-based seed — exactly the reference's in-kernel fusion point), so
+``dropout > 0`` no longer forces the O(S²) composite: training configs
+with attention dropout stay on the flash path. The dropout seed is
+derived once per call from the flax ``"dropout"`` rng stream
+(`ops.stochastic.seed_from_key` — the sanctioned one-consumption idiom)
+and per-site streams are split off with `ops.stochastic.fold_seed`.
+
+``include_norm_add`` fuses the reference "norm_add" variant: pre-LN on
+the input and a dropout(out)+residual epilogue riding the fused
+`ops.stochastic.fused_bias_dropout_add` row kernel (mask recomputed from
+the seed in backward — no stored mask tensor).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
-from apex1_tpu.ops import layer_norm, scaled_masked_softmax
+from apex1_tpu.ops import layer_norm
 from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.ops.stochastic import (fold_seed, fused_bias_dropout_add,
+                                      seed_from_key)
+
+# per-site salts for fold_seed — attention-probability dropout and the
+# norm_add output dropout must draw DISJOINT streams from one rng draw
+_SALT_ATTN = 0
+_SALT_RESID = 1
 
 
 def _attend(q, k, v, *, causal, mask_additive, dropout, deterministic,
-            dropout_rng, sm_scale):
-    """(B,H,S,D) attention core: flash kernel (additive masks ride its
-    bias operand — both paths compute softmax(scale·qk + mask)), or the
-    composite only when probability dropout must be materialized."""
-    if dropout > 0.0 and not deterministic:
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32)
-        if causal:
-            sq, sk = scores.shape[-2], scores.shape[-1]
-            row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-            scores = jnp.where(col > row, -1e30, scores)
-        probs = scaled_masked_softmax(scores, mask_additive, scale=sm_scale)
-        probs = probs.astype(q.dtype)
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
-        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            dropout_seed, sm_scale):
+    """(B,H,S,D) attention core — ALWAYS the flash kernel: additive
+    masks ride its bias operand and probability dropout is fused
+    in-kernel (both paths compute dropout(softmax(scale·qk + mask))·V
+    with no materialized S×S tensor)."""
     bias = mask_additive
     if bias is not None:
         # the kernel validates bias as (1|B, 1|H, Sq, Sk) with the seq
@@ -60,15 +60,19 @@ def _attend(q, k, v, *, causal, mask_additive, dropout, deterministic,
             bias = bias[None]
         bias = jnp.broadcast_to(
             bias, bias.shape[:2] + (sq, sk)).astype(jnp.float32)
+    p = 0.0 if deterministic else float(dropout)
     return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                           bias=bias)
+                           bias=bias, dropout_p=p,
+                           dropout_seed=dropout_seed if p > 0.0 else None)
 
 
 class SelfMultiheadAttn(nn.Module):
     """``apex.contrib.multihead_attn.SelfMultiheadAttn`` equivalent.
 
-    ``include_norm_add``: fuse pre-LayerNorm + residual add around the
-    attention block (the reference's "norm_add" kernel variants).
+    ``include_norm_add``: fuse pre-LayerNorm + dropout-residual add
+    around the attention block (the reference's "norm_add" kernel
+    variants — the output dropout shares the module's ``dropout`` rate,
+    as the reference's ``self_multihead_attn_norm_add_func`` does).
     ``separate_qkv_params``: three (E,E) projections instead of one packed
     (E,3E) — reference ``separate_qkv_params`` flag.
     """
@@ -115,11 +119,14 @@ class SelfMultiheadAttn(nn.Module):
         def heads(t):  # (S, B, E) -> (B, H, S, D)
             return t.reshape(S, B, H, D).transpose(1, 2, 0, 3)
 
-        rng = (self.make_rng("dropout")
-               if self.dropout > 0.0 and is_training else None)
+        active = self.dropout > 0.0 and is_training
+        seed = (seed_from_key(self.make_rng("dropout")) if active
+                else None)
         ctx = _attend(heads(q), heads(k), heads(v), causal=causal,
                       mask_additive=attn_mask, dropout=self.dropout,
-                      deterministic=not is_training, dropout_rng=rng,
+                      deterministic=not is_training,
+                      dropout_seed=(fold_seed(seed, _SALT_ATTN)
+                                    if active else None),
                       sm_scale=1.0 / math.sqrt(D))
         ctx = ctx.transpose(2, 0, 1, 3).reshape(S, B, E)
         wo = self.param("out_proj_weight", init, (E, E), jnp.float32)
@@ -128,7 +135,12 @@ class SelfMultiheadAttn(nn.Module):
             out = out + self.param("out_proj_bias", nn.initializers.zeros,
                                    (E,), jnp.float32).astype(dtype)
         if self.include_norm_add:
-            out = out + residual
+            # reference norm_add epilogue: residual + dropout(out) — the
+            # fused row kernel recomputes the mask from the seed in its
+            # backward; p=0 lowers to the plain add (pre-PR behavior)
+            out = fused_bias_dropout_add(
+                out, residual, p=self.dropout if active else 0.0,
+                seed=fold_seed(seed, _SALT_RESID) if active else None)
         return out
 
 
@@ -171,12 +183,15 @@ class EncdecMultiheadAttn(nn.Module):
         def heads(t, s):
             return t.reshape(s, B, H, D).transpose(1, 2, 0, 3)
 
-        rng = (self.make_rng("dropout")
-               if self.dropout > 0.0 and is_training else None)
+        active = self.dropout > 0.0 and is_training
+        seed = (seed_from_key(self.make_rng("dropout")) if active
+                else None)
         ctx = _attend(heads(q, Sq), heads(k, Sk), heads(v, Sk),
                       causal=False, mask_additive=attn_mask,
                       dropout=self.dropout, deterministic=not is_training,
-                      dropout_rng=rng, sm_scale=1.0 / math.sqrt(D))
+                      dropout_seed=(fold_seed(seed, _SALT_ATTN)
+                                    if active else None),
+                      sm_scale=1.0 / math.sqrt(D))
         ctx = ctx.transpose(2, 0, 1, 3).reshape(Sq, B, E)
         wo = self.param("out_proj_weight", init, (E, E), jnp.float32)
         out = ctx @ wo.astype(dtype)
@@ -184,5 +199,7 @@ class EncdecMultiheadAttn(nn.Module):
             out = out + self.param("out_proj_bias", nn.initializers.zeros,
                                    (E,), jnp.float32).astype(dtype)
         if self.include_norm_add:
-            out = out + residual
+            out = fused_bias_dropout_add(
+                out, residual, p=self.dropout if active else 0.0,
+                seed=fold_seed(seed, _SALT_RESID) if active else None)
         return out

@@ -15,7 +15,15 @@ rule runs a straight-line abstract interpretation over every function:
 - ``fold_in(key, salt)`` does NOT consume — deriving many streams from
   one base key with distinct salts is the sanctioned pattern;
 - assignment to a name clears its consumed state (``rng, sub =
-  split(rng)`` is the idiomatic refresh).
+  split(rng)`` is the idiomatic refresh);
+- the Pallas TPU kernel PRNG (``pltpu.prng_seed`` /
+  ``pltpu.prng_random_bits``) does NOT consume: its argument is a plain
+  int32 COUNTER SEED, not a jax.random key — re-seeding in a forward
+  kernel and again in the backward's mask recompute is the in-kernel
+  stochasticity contract (`apex1_tpu.ops.stochastic`), not key reuse.
+  Deriving such seeds at the call site via one ``jax.random.randint``
+  draw (which consumes its key ONCE, correctly tracked) or
+  ``ops.stochastic.fold_seed`` is the sanctioned idiom.
 
 A consumed key consumed again -> finding. Branches are analyzed
 independently and merged conservatively (a key must be consumed on ALL
@@ -38,6 +46,11 @@ _KEY_PARAM = re.compile(r"^(key|keys|rng|prng|rngs)$|(_key|_rng|_keys)$")
 
 _MAKERS = {"PRNGKey", "key", "wrap_key_data", "clone"}
 _NONCONSUMING = {"fold_in", "key_data", "key_impl"}
+# Pallas TPU in-kernel PRNG: consumes int32 counter seeds, never keys —
+# matched by dotted-path suffix (pltpu.prng_seed resolves to
+# jax.experimental.pallas.tpu.prng_seed) or bare attribute name when the
+# import alias cannot be resolved
+_KERNEL_PRNG = {"prng_seed", "prng_random_bits"}
 
 
 @dataclasses.dataclass
@@ -146,6 +159,11 @@ class _FnChecker:
     def _call(self, call: ast.Call, state: _State,
               loop_pass: bool) -> None:
         dotted = self.project.resolve_dotted(self.info.mod, call.func)
+        leaf = (dotted.rsplit(".", 1)[-1] if dotted
+                else (call.func.attr
+                      if isinstance(call.func, ast.Attribute) else None))
+        if leaf in _KERNEL_PRNG:
+            return  # int32 counter seed, not a key — re-seeding is fine
         if dotted and dotted.startswith("jax.random."):
             fn = dotted[len("jax.random."):]
             if fn in _MAKERS or fn in _NONCONSUMING:

@@ -26,6 +26,9 @@ from jax.sharding import PartitionSpec as P
 
 from apex1_tpu.core.policy import PrecisionPolicy, get_policy
 from apex1_tpu.ops import layer_norm, softmax_cross_entropy_loss
+from apex1_tpu.ops.stochastic import (fold_seed,
+                                      fused_dropout_add_layer_norm,
+                                      seed_from_key)
 from apex1_tpu.ops.attention import flash_attention
 
 
@@ -65,21 +68,27 @@ class BertLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, seg_mask):
+    def __call__(self, x, seg_mask, deterministic: bool = True):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         E, H = cfg.hidden_size, cfg.num_heads
         D = E // H
         B, S = x.shape[0], x.shape[1]
 
-        def norm(name, z):
+        def norm_params(name):
             g = self.param(f"{name}_scale", nn.initializers.ones, (E,),
                            jnp.float32)
             b = self.param(f"{name}_bias", nn.initializers.zeros, (E,),
                            jnp.float32)
             if not cfg.policy.keep_norms_fp32:
                 g, b = g.astype(dtype), b.astype(dtype)
-            return layer_norm(z, g, b)
+            return g, b
+
+        # one rng draw per layer (make_rng folds the module path, so
+        # every layer draws a distinct key); per-site streams split off
+        # the int32 seed with fold_seed — the APX103-sanctioned idiom
+        active = cfg.dropout > 0.0 and not deterministic
+        seed = seed_from_key(self.make_rng("dropout")) if active else None
 
         qkv = nn.Dense(3 * E, dtype=dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -87,17 +96,36 @@ class BertLayer(nn.Module):
         def heads(t):
             return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
 
+        # attention-probability dropout rides the flash kernel (the
+        # reference fmha fusion point) — no O(S²) tensor materializes
         attn = flash_attention(heads(q), heads(k), heads(v),
                                segment_ids=seg_mask,
-                               sm_scale=1.0 / math.sqrt(D))
+                               sm_scale=1.0 / math.sqrt(D),
+                               dropout_p=cfg.dropout if active else 0.0,
+                               dropout_seed=(fold_seed(seed, 0)
+                                             if active else None))
         attn = attn.transpose(0, 2, 1, 3).reshape(B, S, E)
         attn = nn.Dense(E, dtype=dtype, name="attn_out")(attn)
-        x = norm("attn_ln", x + attn).astype(dtype)
+        g, b = norm_params("attn_ln")
+        if active:
+            # fused dropout(attn)+residual, then the Pallas LN — the
+            # Megatron bias_dropout_add epilogue; masks recomputed from
+            # seeds in backward (no stored mask tensors)
+            x = fused_dropout_add_layer_norm(
+                attn, x, g, b, p=cfg.dropout,
+                seed=fold_seed(seed, 1)).astype(dtype)
+        else:
+            x = layer_norm(x + attn, g, b).astype(dtype)
 
         h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="ffn_in")(x)
         h = nn.gelu(h)
         h = nn.Dense(E, dtype=dtype, name="ffn_out")(h)
-        return norm("ffn_ln", x + h).astype(dtype)
+        g, b = norm_params("ffn_ln")
+        if active:
+            return fused_dropout_add_layer_norm(
+                h, x, g, b, p=cfg.dropout,
+                seed=fold_seed(seed, 2)).astype(dtype)
+        return layer_norm(x + h, g, b).astype(dtype)
 
 
 class Bert(nn.Module):
@@ -106,7 +134,8 @@ class Bert(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types=None, attention_mask=None):
+    def __call__(self, tokens, token_types=None, attention_mask=None,
+                 deterministic: bool = True):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         B, S = tokens.shape
@@ -131,7 +160,7 @@ class Bert(nn.Module):
         x = layer_norm(x, g, b).astype(dtype)
         seg = attention_mask.astype(jnp.int32)
         for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer{i}")(x, seg)
+            x = BertLayer(cfg, name=f"layer{i}")(x, seg, deterministic)
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=dtype,
                                   name="pooler")(x[:, 0]))
         return x, pooled
@@ -145,11 +174,12 @@ class BertPretrain(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, token_types=None, attention_mask=None,
-                 return_mlm_hidden=False):
+                 return_mlm_hidden=False, deterministic: bool = True):
         cfg = self.cfg
         dtype = cfg.policy.compute_dtype
         bert = Bert(cfg, name="bert")
-        seq, pooled = bert(tokens, token_types, attention_mask)
+        seq, pooled = bert(tokens, token_types, attention_mask,
+                           deterministic)
         h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlm_transform")(seq)
         h = nn.gelu(h)
         g = self.param("mlm_ln_scale", nn.initializers.ones,
@@ -204,17 +234,22 @@ def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1,
     ``False`` keeps the materialized-logits path (the parity gold).
 
     ``batch``: dict with tokens, mlm_labels (ignore_index where unmasked),
-    nsp_labels, optional token_types/attention_mask."""
+    nsp_labels, optional token_types/attention_mask, optional
+    ``dropout_rng`` (a jax.random key) — its presence ACTIVATES the
+    model's dropout (cfg.dropout > 0): attention-probability dropout in
+    the flash kernels + the fused dropout-add-LN residual epilogues."""
     from apex1_tpu.ops import linear_cross_entropy
 
     def loss_fn(params, batch):
         labels = batch["mlm_labels"]
         n_masked = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+        det = "dropout_rng" not in batch
+        rngs = None if det else {"dropout": batch["dropout_rng"]}
         if fuse_head:
             h, nsp_logits = model.apply(
                 {"params": params}, batch["tokens"],
                 batch.get("token_types"), batch.get("attention_mask"),
-                return_mlm_hidden=True)
+                return_mlm_hidden=True, deterministic=det, rngs=rngs)
             wte = params["bert"]["word_embeddings"].astype(h.dtype)
             w = jnp.concatenate(
                 [wte, params["mlm_bias"].astype(h.dtype)[:, None]], axis=1)
@@ -226,7 +261,8 @@ def bert_pretrain_loss_fn(model: BertPretrain, *, ignore_index: int = -1,
         else:
             mlm_logits, nsp_logits = model.apply(
                 {"params": params}, batch["tokens"],
-                batch.get("token_types"), batch.get("attention_mask"))
+                batch.get("token_types"), batch.get("attention_mask"),
+                deterministic=det, rngs=rngs)
             mlm_losses = softmax_cross_entropy_loss(
                 mlm_logits.astype(jnp.float32),
                 jnp.maximum(labels, 0)) * (labels != ignore_index)

@@ -26,6 +26,8 @@ from apex1_tpu.ops import (layer_norm, linear_cross_entropy,
                            scaled_upper_triang_masked_softmax,
                            softmax_cross_entropy_loss)
 from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.ops.stochastic import (fold_seed, fused_bias_dropout_add,
+                                      seed_from_key)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +84,16 @@ class Block(nn.Module):
                 gamma, beta = gamma.astype(dtype), beta.astype(dtype)
             return layer_norm(z, gamma, beta)
 
+        # dropout (cfg.dropout > 0, training): attention-probability
+        # dropout fused in the flash kernel + fused dropout-add residual
+        # epilogues; one rng draw per block, per-site int32 streams via
+        # fold_seed (the APX103-sanctioned idiom)
+        active = cfg.dropout > 0.0 and not deterministic and cache is None
+        if active and not cfg.use_flash:
+            raise ValueError("dropout > 0 needs use_flash=True (the "
+                             "composite path has no fused dropout)")
+        seed = seed_from_key(self.make_rng("dropout")) if active else None
+
         # attention — flash kernel (O(S·D) memory; the materialized
         # scores + fused-softmax path is kept via use_flash=False for
         # the kernel-parity cross-check)
@@ -103,7 +115,10 @@ class Block(nn.Module):
         elif cfg.use_flash:
             attn = flash_attention(q, k, v, causal=True,
                                    segment_ids=segment_ids,
-                                   sm_scale=1.0 / math.sqrt(hd))
+                                   sm_scale=1.0 / math.sqrt(hd),
+                                   dropout_p=cfg.dropout if active else 0.0,
+                                   dropout_seed=(fold_seed(seed, 0)
+                                                 if active else None))
         else:
             if segment_ids is not None:
                 raise ValueError("packed batches need use_flash=True")
@@ -113,14 +128,25 @@ class Block(nn.Module):
                 scores, scale=1.0 / math.sqrt(hd))
             attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dtype), v)
         attn = attn.transpose(0, 2, 1, 3).reshape(B, S, h)
-        x = x + nn.Dense(h, dtype=dtype, name="proj")(attn)
+        proj = nn.Dense(h, dtype=dtype, name="proj")(attn)
+        if active:
+            # Megatron bias_dropout_add epilogue (pre-LN stack: no norm
+            # after the add) — mask recomputed from the seed in backward
+            x = fused_bias_dropout_add(proj, x, p=cfg.dropout,
+                                       seed=fold_seed(seed, 1))
+        else:
+            x = x + proj
 
         # MLP
         y = norm("ln2", x)
         y = nn.Dense(cfg.mlp_ratio * h, dtype=dtype, name="fc_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(h, dtype=dtype, name="fc_out")(y)
-        out = x + y
+        if active:
+            out = fused_bias_dropout_add(y, x, p=cfg.dropout,
+                                         seed=fold_seed(seed, 2))
+        else:
+            out = x + y
         return out if new_cache is None else (out, new_cache)
 
 
@@ -216,10 +242,25 @@ def gpt2_loss_fn(model: GPT2, *, fuse_head: bool = True):
     ``fuse_head=True`` (default) runs the tied LM head through
     ``ops.linear_cross_entropy`` — head matmul fused into the CE, no
     (B, S, V) logits in HBM. ``False`` keeps the materialized-logits path
-    (the parity gold; also what inference uses)."""
+    (the parity gold; also what inference uses).
 
-    def loss_fn(params, tokens, segment_ids=None, positions=None):
-        kw = dict(segment_ids=segment_ids, positions=positions)
+    ``dropout_rng`` (a jax.random key) ACTIVATES the in-kernel dropout
+    paths when ``cfg.dropout > 0`` — same contract as
+    ``bert_pretrain_loss_fn``'s ``batch["dropout_rng"]``; it rides the
+    batch tail positionally through ``Amp.make_train_step``
+    (``step(state, tokens, None, None, rng)``). Without it the model
+    runs deterministic regardless of ``cfg.dropout`` — passing a key
+    with ``cfg.dropout == 0`` is therefore a config mistake and raises."""
+
+    def loss_fn(params, tokens, segment_ids=None, positions=None,
+                dropout_rng=None):
+        if dropout_rng is not None and model.cfg.dropout == 0.0:
+            raise ValueError("dropout_rng passed but cfg.dropout == 0 — "
+                             "the key would be silently unused")
+        kw = dict(segment_ids=segment_ids, positions=positions,
+                  deterministic=dropout_rng is None,
+                  rngs=(None if dropout_rng is None
+                        else {"dropout": dropout_rng}))
         if fuse_head:
             h = model.apply({"params": params}, tokens, return_hidden=True,
                             **kw)

@@ -20,3 +20,6 @@ from apex1_tpu.ops.rope import (  # noqa: F401
 from apex1_tpu.ops.attention import flash_attention, fmha  # noqa: F401
 from apex1_tpu.ops.quantized import (  # noqa: F401
     int8_matmul, quantize_int8)
+from apex1_tpu.ops.stochastic import (  # noqa: F401
+    fold_seed, fused_bias_dropout_add, fused_dropout_add_layer_norm,
+    seed_from_key)

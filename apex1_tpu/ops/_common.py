@@ -72,6 +72,33 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
+def mosaic_dtype(dtype):
+    """The dtype a COMPILED Pallas kernel runs for ``dtype`` operands.
+
+    Mosaic has no IEEE float16 ("Unsupported type: 'f16'" at lowering),
+    so under the fp16 AMP policies fp16 is a STORAGE dtype only: kernel
+    entry points cast f16 operands to bf16 on the compiled-TPU path and
+    cast results back (XLA itself upcasts f16 dots on TPU — neither path
+    computes IEEE-f16 products). Identity everywhere else: interpret
+    mode and the XLA composites take f16 directly, so CPU tier-1
+    behavior is unchanged. The cast is a plain convert_element_type —
+    autodiff transposes it, so custom_vjp kernels only ever see bf16."""
+    if dtype == jnp.float16 and not interpret_mode():
+        return jnp.bfloat16
+    return dtype
+
+
+def to_mosaic(*arrays):
+    """Cast each array to its `mosaic_dtype` (f16 -> bf16 on the
+    compiled-TPU path, identity otherwise). ``None`` passes through;
+    one array in -> one array out. Kernel entry points run EVERY
+    floating-point operand through this so per-operand coverage is
+    auditable at the call site."""
+    out = tuple(a if a is None or a.dtype == mosaic_dtype(a.dtype)
+                else a.astype(mosaic_dtype(a.dtype)) for a in arrays)
+    return out[0] if len(out) == 1 else out
+
+
 def out_struct(shape, dtype, *like):
     """``ShapeDtypeStruct`` for a ``pallas_call`` output whose ``vma``
     (varying-across-mesh-axes set) is the union of the ``like`` inputs'.

@@ -41,10 +41,27 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
-                                    pad_to, use_pallas)
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode,
+                                    out_struct, pad_to, to_mosaic,
+                                    use_pallas)
+from apex1_tpu.ops.stochastic import (attn_keep_mask, threshold_u32,
+                                      tile_keep_mask)
 
 _LANES = 128
+
+
+def _keep_tile(sd_ref, qo_ref, ko_ref, qi, ki, bq, bk, b, h, *,
+               dropout_p, n_h, interp):
+    """Attention-probability keep mask for the (qi, ki) score tile —
+    counter-based on (seed, batch·n_h+head, GLOBAL q start, GLOBAL k
+    start), so the mask is independent of grid iteration order and of
+    ring-shard visiting order, and context-parallel shards (whose
+    ``k_off`` differs) draw disjoint, shift-invariant streams. Forward
+    and both backward kernels call this with identical arguments per
+    tile — the recompute identity the custom VJPs rely on."""
+    return tile_keep_mask(
+        (bq, bk), threshold_u32(dropout_p), sd_ref[0, 0], b * n_h + h,
+        qi * bq + qo_ref[0, 0], ki * bk + ko_ref[0, 0], interp=interp)
 
 
 def _block(size: int, requested: int) -> int:
@@ -132,8 +149,10 @@ def _mask_for(qi, ki, bq, bk, *, causal, true_sq, true_sk, q_off, k_off,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
-                scale, causal, true_sq, true_sk, has_segs, has_bias, n_k):
+                scale, causal, true_sq, true_sk, has_segs, has_bias, n_k,
+                dropout_p=0.0, n_h=0, interp=False):
     rest = list(seg_and_out)
+    sd_ref = rest.pop(0) if dropout_p > 0.0 else None
     if has_segs:
         qseg_ref, kseg_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -143,6 +162,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
     bias_ref = rest.pop(0) if has_bias else None
     o_ref, lse_ref, acc, m_scr, l_scr = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
+    if dropout_p > 0.0:
+        # program ids hoisted OUT of the pl.when-guarded compute: inside
+        # the cond body the primitive has no interpret-mode lowering;
+        # guarded so the p=0 kernel jaxpr stays identical to pre-dropout
+        b, h = pl.program_id(0), pl.program_id(1)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(ki == 0)
@@ -173,8 +197,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
         e = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_new = l_prev * corr + jnp.sum(e, axis=1, keepdims=True)
         v = v_ref[0, 0]
+        if dropout_p > 0.0:
+            # dropout BETWEEN softmax and AV (the reference fmha fusion
+            # point): the softmax denominator l accumulates the
+            # UNdropped e, only the AV contribution is masked+rescaled,
+            # so (out, lse) merge exactly across ring shards
+            keep = _keep_tile(sd_ref, qo_ref, ko_ref, qi, ki, bq, bk,
+                              b, h, dropout_p=dropout_p, n_h=n_h,
+                              interp=interp)
+            e_av = jnp.where(keep, e * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            e_av = e
         acc[...] = acc[...] * corr + jax.lax.dot_general(
-            e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            e_av.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -200,8 +235,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, *seg_and_out,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
                    qo_ref, ko_ref, *seg_and_out,
                    scale, causal, true_sq, true_sk, has_segs, has_bias,
-                   n_k):
+                   n_k, dropout_p=0.0, n_h=0, interp=False):
     rest = list(seg_and_out)
+    sd_ref = rest.pop(0) if dropout_p > 0.0 else None
     if has_segs:
         qseg_ref, kseg_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -211,6 +247,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     bias_ref = rest.pop(0) if has_bias else None
     dq_ref, dq_acc = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
+    if dropout_p > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)  # hoisted, see _fwd
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(ki == 0)
@@ -232,6 +270,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         v = v_ref[0, 0]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # out = Σ drop∘softmax(s)·v with drop a CONSTANT mask ⇒
+            # ds = p·(drop·dp − δ + dlse): the recomputed mask scales
+            # only the dp term (δ already carries the dropped weights
+            # through do·out)
+            keep = _keep_tile(sd_ref, qo_ref, ko_ref, qi, ki, bq, bk,
+                              b, h, dropout_p=dropout_p, n_h=n_h,
+                              interp=interp)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -251,7 +298,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
                     qo_ref, ko_ref, *seg_and_out,
                     scale, causal, true_sq, true_sk, has_segs, has_bias,
-                    n_q, group):
+                    n_q, group, dropout_p=0.0, n_h=0, interp=False):
     # Grid (b, hkv, ki, gi, qi): the GQA group axis sits between the key
     # block and the (innermost) query block, so dk/dv for one kv head
     # accumulate across the whole group in VMEM scratch and are written
@@ -259,6 +306,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     # (VERDICT r1 weak#4), and each k/v block is fetched once per group
     # sweep instead of once per q head.
     rest = list(seg_and_out)
+    sd_ref = rest.pop(0) if dropout_p > 0.0 else None
     if has_segs:
         qseg_ref, kseg_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -268,6 +316,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
     bias_ref = rest.pop(0) if has_bias else None
     dk_ref, dv_ref, dk_acc, dv_acc = rest
     ki, gi, qi = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    if dropout_p > 0.0:
+        # hoisted (see _fwd_kernel); q head on this grid is hkv·group+gi
+        b, hq = pl.program_id(0), pl.program_id(1) * group + gi
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when((gi == 0) & (qi == 0))
@@ -288,11 +339,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
-        dv_acc[...] += jax.lax.dot_general(                  # pᵀ · do
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        if dropout_p > 0.0:
+            # hq = hkv·group + gi — the SAME salt the forward used for
+            # this (b, h, qi, ki) tile
+            keep = _keep_tile(
+                sd_ref, qo_ref, ko_ref, qi, ki, bq, bk, b, hq,
+                dropout_p=dropout_p, n_h=n_h, interp=interp)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_av = jnp.where(keep, p * inv, 0.0)  # dv sees DROPPED probs
+        else:
+            keep = None
+            p_av = p
+        dv_acc[...] += jax.lax.dot_general(                  # p_avᵀ · do
+            p_av.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0]) * scale
         dk_acc[...] += jax.lax.dot_general(                  # dsᵀ · q
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -312,13 +376,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
 
 def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
                   qo_ref, ko_ref, *seg_and_out,
-                  scale, causal, true_sq, true_sk, has_segs, n_r):
+                  scale, causal, true_sq, true_sk, has_segs, n_r,
+                  rh=1, dropout_p=0.0, n_h=0, interp=False):
     """dbias = Σ_broadcast p·(dp − δ + dlse) — one extra recompute pass.
     Grid (Bb, Hb, qi, ki, r) with the broadcast sweep r INNERMOST: every
     revisit of a dbias output block is consecutive, so accumulation
     lives in VMEM scratch and each block is written once (no O(B·H·S²)
-    partials in HBM — the whole point of biasing the flash kernel)."""
+    partials in HBM — the whole point of biasing the flash kernel).
+    ``rh`` is the head broadcast factor Hq//Hb — with the grid sizes it
+    reconstructs the TRUE (b, h) this sweep step visits, so the dropout
+    mask salt matches the forward's."""
     rest = list(seg_and_out)
+    sd_ref = rest.pop(0) if dropout_p > 0.0 else None
     if has_segs:
         qseg_ref, kseg_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -327,6 +396,11 @@ def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         qseg = kseg = None
     bias_ref, dbias_ref, db_acc = rest
     qi, ki, r = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    if dropout_p > 0.0:
+        # true (b, h) of this sweep step (bidx/hidx inverted from the
+        # index maps) — hoisted out of the pl.when-guarded compute
+        b = pl.program_id(0) + (r // rh) * pl.num_programs(0)
+        h = pl.program_id(1) + (r % rh) * pl.num_programs(1)
     bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(r == 0)
@@ -349,6 +423,11 @@ def _dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dlse_ref,
         v = v_ref[0, 0]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_tile(sd_ref, qo_ref, ko_ref, qi, ki, bq, bk,
+                              b, h, dropout_p=dropout_p, n_h=n_h,
+                              interp=interp)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         # dS w.r.t. the PRE-scale logits s_full — no trailing ·scale
         # (that factor belongs to d(qk), not d(bias))
         db_acc[...] += p * (dp - dlt_ref[0, 0] + dlse_ref[0, 0])
@@ -484,23 +563,36 @@ def _bias_spec(g, Bb, Hb, *, dkv=False):
         memory_space=pltpu.VMEM)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
-def _flash(q, k, v, qseg, kseg, q_off, k_off,
-           scale, causal, has_segs, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, qseg, kseg, q_off, k_off, seed,
+           scale, causal, has_segs, block_q, block_k, dropout_p):
     out, lse, _ = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
-                                  scale, causal, has_segs, block_q, block_k)
+                                  scale, causal, has_segs, block_q,
+                                  block_k, dropout_p=dropout_p, seed=seed)
     return out, lse
+
+
+def _drop_kw(dropout_p, g):
+    """Kernel kwargs for the dropout path. EMPTY at p == 0 so the
+    pallas_call partials (and the lowered kernels) stay byte-identical
+    to the pre-dropout programs — the pinned bit-for-bit contract."""
+    if dropout_p <= 0.0:
+        return {}
+    return dict(dropout_p=dropout_p, n_h=g["Hq"], interp=interpret_mode())
 
 
 def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
                     scale, causal, has_segs, block_q, block_k,
-                    bias=None):
+                    bias=None, dropout_p=0.0, seed=None):
     qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
                                   block_q, block_k)
     q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
         _common_specs(g)
     in_specs = [q_spec, kv_spec, kv_spec, off_spec, off_spec]
     args = [qp, kp, vp, *_off_arrays(q_off, k_off)]
+    if dropout_p > 0.0:
+        in_specs += [off_spec]
+        args += [jnp.asarray(seed, jnp.int32).reshape(1, 1)]
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
         args += [qs, ks]
@@ -514,7 +606,7 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           true_sq=g["Sq"], true_sk=g["Sk"],
                           has_segs=has_segs, has_bias=has_bias,
-                          n_k=g["n_k"]),
+                          n_k=g["n_k"], **_drop_kw(dropout_p, g)),
         grid=(g["B"], g["Hq"], g["n_q"], g["n_k"]),
         in_specs=in_specs,
         out_specs=(q_spec, stat_spec),
@@ -534,22 +626,29 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
     return out, lse, lse_p
 
 
-def _flash_fwd(q, k, v, qseg, kseg, q_off, k_off,
-               scale, causal, has_segs, block_q, block_k):
+def _flash_fwd(q, k, v, qseg, kseg, q_off, k_off, seed,
+               scale, causal, has_segs, block_q, block_k, dropout_p):
     out, lse, lse_p = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
                                       scale, causal, has_segs,
-                                      block_q, block_k)
-    return (out, lse), (q, k, v, qseg, kseg, q_off, k_off, out, lse_p)
+                                      block_q, block_k,
+                                      dropout_p=dropout_p, seed=seed)
+    return (out, lse), (q, k, v, qseg, kseg, q_off, k_off, seed, out,
+                        lse_p)
 
 
 def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
-                    bias=None, cast=True):
+                    bias=None, cast=True, dropout_p=0.0):
     """``cast=False`` returns dk/dv in their native fp32 kernel output
     dtype (dq is q.dtype either way — the dq kernel's out_shape): the
     ring backward accumulates per-shard dk/dv across the ring and a
     round-trip through k.dtype before that fp32 sum would discard the
-    very precision the kernels paid for."""
-    q, k, v, qseg, kseg, q_off, k_off, out, lse_p = res
+    very precision the kernels paid for.
+
+    With ``dropout_p > 0`` every backward kernel recomputes the
+    forward's keep mask from the seed residual — the same
+    recompute-instead-of-save trade the kernels already make for the
+    probabilities."""
+    q, k, v, qseg, kseg, q_off, k_off, seed, out, lse_p = res
     dout, dlse = cts
     qp, kp, vp, qs, ks, g = _prep(q, k, v, qseg, kseg, has_segs,
                                   block_q, block_k)
@@ -563,17 +662,23 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
     dlse_p, _ = pad_to(dlse.astype(jnp.float32)[..., None], 2, g["bq"])
 
     stat_args = [lse_p, dlt_p, dlse_p, *_off_arrays(q_off, k_off)]
+    n_seed = 0
+    if dropout_p > 0.0:
+        stat_args += [jnp.asarray(seed, jnp.int32).reshape(1, 1)]
+        n_seed = 1  # one extra SMEM scalar operand per launch
     has_bias = bias is not None
     if has_bias:
         bp, Bb, Hb = _prep_bias(bias, g)
     kern = dict(scale=scale, causal=causal, true_sq=g["Sq"],
-                true_sk=g["Sk"], has_segs=has_segs)
+                true_sk=g["Sk"], has_segs=has_segs,
+                **_drop_kw(dropout_p, g))
 
     # dq: grid (b, h, qi, ki), key axis innermost
     q_spec, kv_spec, stat_spec, off_spec, qseg_spec, kseg_spec = \
         _common_specs(g)
     in_specs = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec,
                 stat_spec, off_spec, off_spec]
+    in_specs += [off_spec] * n_seed
     args = [qp, kp, vp, dop] + stat_args
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
@@ -600,6 +705,7 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
         _dkv_specs(g)
     in_specs = [q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec,
                 stat_spec, off_spec, off_spec]
+    in_specs += [off_spec] * n_seed
     args = [qp, kp, vp, dop] + stat_args
     if has_segs:
         in_specs += [qseg_spec, kseg_spec]
@@ -664,6 +770,7 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
                         lambda bb, hb, qi, ki, r: (bb, hb, qi, ki))
         in_specs = [q_spec_b, kv_spec_b, kv_spec_b, q_spec_b, stat_spec_b,
                     stat_spec_b, stat_spec_b, off_spec_b, off_spec_b]
+        in_specs += [off_spec_b] * n_seed
         args = [qp, kp, vp, dop] + stat_args
         if has_segs:
             in_specs += [qseg_spec_b, kseg_spec_b]
@@ -671,7 +778,8 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
         in_specs += [bias_spec_b]
         args += [bp]
         dbias_p = pl.pallas_call(
-            functools.partial(_dbias_kernel, n_r=n_r, **kern),
+            functools.partial(_dbias_kernel, n_r=n_r, **kern,
+                              **({"rh": RH} if dropout_p > 0.0 else {})),
             grid=(Bb, Hb, g["n_q"], g["n_k"], n_r),
             in_specs=in_specs,
             out_specs=db_spec,
@@ -687,53 +795,60 @@ def _flash_bwd_impl(scale, causal, has_segs, block_q, block_k, res, cts,
     if cast:
         dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
     grads = (dq.astype(q.dtype), dk, dv,
-             f0(qseg), f0(kseg), f0(q_off), f0(k_off))
+             f0(qseg), f0(kseg), f0(q_off), f0(k_off), f0(seed))
     return grads, dbias
 
 
-def _flash_bwd(scale, causal, has_segs, block_q, block_k, res, cts):
+def _flash_bwd(scale, causal, has_segs, block_q, block_k, dropout_p,
+               res, cts):
     grads, _ = _flash_bwd_impl(scale, causal, has_segs, block_q, block_k,
-                               res, cts)
+                               res, cts, dropout_p=dropout_p)
     return grads
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
-def _flash_with_bias(q, k, v, bias, qseg, kseg, q_off, k_off,
-                     scale, causal, has_segs, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13, 14))
+def _flash_with_bias(q, k, v, bias, qseg, kseg, q_off, k_off, seed,
+                     scale, causal, has_segs, block_q, block_k, dropout_p):
     out, lse, _ = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
                                   scale, causal, has_segs, block_q,
-                                  block_k, bias=bias)
+                                  block_k, bias=bias, dropout_p=dropout_p,
+                                  seed=seed)
     return out, lse
 
 
-def _flash_with_bias_fwd(q, k, v, bias, qseg, kseg, q_off, k_off,
-                         scale, causal, has_segs, block_q, block_k):
+def _flash_with_bias_fwd(q, k, v, bias, qseg, kseg, q_off, k_off, seed,
+                         scale, causal, has_segs, block_q, block_k,
+                         dropout_p):
     out, lse, lse_p = _flash_fwd_impl(q, k, v, qseg, kseg, q_off, k_off,
                                       scale, causal, has_segs,
-                                      block_q, block_k, bias=bias)
-    return (out, lse), (q, k, v, bias, qseg, kseg, q_off, k_off, out,
-                        lse_p)
+                                      block_q, block_k, bias=bias,
+                                      dropout_p=dropout_p, seed=seed)
+    return (out, lse), (q, k, v, bias, qseg, kseg, q_off, k_off, seed,
+                        out, lse_p)
 
 
-def _flash_with_bias_bwd(scale, causal, has_segs, block_q, block_k, res,
-                         cts):
-    q, k, v, bias, qseg, kseg, q_off, k_off, out, lse_p = res
+def _flash_with_bias_bwd(scale, causal, has_segs, block_q, block_k,
+                         dropout_p, res, cts):
+    q, k, v, bias, qseg, kseg, q_off, k_off, seed, out, lse_p = res
     grads, dbias = _flash_bwd_impl(
         scale, causal, has_segs, block_q, block_k,
-        (q, k, v, qseg, kseg, q_off, k_off, out, lse_p), cts, bias=bias)
-    dq, dk, dv, fqs, fks, fqo, fko = grads
-    return (dq, dk, dv, dbias.astype(bias.dtype), fqs, fks, fqo, fko)
+        (q, k, v, qseg, kseg, q_off, k_off, seed, out, lse_p), cts,
+        bias=bias, dropout_p=dropout_p)
+    dq, dk, dv, fqs, fks, fqo, fko, fsd = grads
+    return (dq, dk, dv, dbias.astype(bias.dtype), fqs, fks, fqo, fko, fsd)
 
 
 _flash_with_bias.defvjp(_flash_with_bias_fwd, _flash_with_bias_bwd)
 
 
 def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
-                   with_lse=False, bias=None):
-    """XLA-composite gold: identical semantics incl. empty-row handling."""
+                   with_lse=False, bias=None, dropout_p=0.0, seed=None):
+    """XLA-composite gold: identical semantics incl. empty-row handling.
+    Probability dropout uses the SAME counter hash at global positions
+    as the interpret-mode kernels — bit-identical masks on CPU."""
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     if Hq != Hkv:
@@ -759,7 +874,14 @@ def _xla_attention(q, k, v, qseg, kseg, q_off, k_off, scale, causal,
     m = jnp.max(sm, axis=-1, keepdims=True)
     e = jnp.where(mask, jnp.exp(sm - m), 0.0)
     l = jnp.sum(e, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bhkd->bhqd", e / jnp.where(l > 0, l, 1.0),
+    probs = e / jnp.where(l > 0, l, 1.0)
+    if dropout_p > 0.0:
+        keep = attn_keep_mask(seed, B, Hq, row + q_off, col + k_off,
+                              dropout_p)
+        # denominator l stays UNdropped (lse is dropout-free); only the
+        # AV weights are masked+rescaled — matches the kernels
+        probs = jnp.where(keep, probs * (1.0 / (1.0 - dropout_p)), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs,
                      v.astype(jnp.float32)).astype(q.dtype)
     if not with_lse:
         return out
@@ -783,7 +905,8 @@ def _norm_segments(segment_ids, Sq, Sk):
 def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                     sm_scale: float | None = None, q_offset=0, k_offset=0,
                     block_q: int | None = None, block_k: int | None = None,
-                    return_lse: bool = False, bias=None):
+                    return_lse: bool = False, bias=None,
+                    dropout_p: float = 0.0, dropout_seed=None):
     """Flash attention over (B, H, S, D) operands.
 
     ``segment_ids``: (B, S) int array (self-attention) or a
@@ -805,6 +928,17 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
     position bias or an arbitrary additive mask; differentiable (dbias
     via a dedicated broadcast-accumulating backward pass), so the O(S²)
     composite path is never needed for bias-bearing attention.
+    ``dropout_p``/``dropout_seed``: attention-probability dropout FUSED
+    between softmax and AV inside the kernels (≙ the reference fmha /
+    multihead_attn fusion point) — no mask tensor is ever stored; the
+    backward recomputes the mask from the int32 seed. The mask is
+    counter-based on (seed, batch·H+head, global q pos, global k pos),
+    so it is deterministic per (seed, backend), independent of grid
+    order, and ring/context-parallel shards draw disjoint streams via
+    their ``k_offset``. Derive seeds per call site with
+    `apex1_tpu.ops.stochastic.seed_from_key` / `fold_seed`. ``lse`` (and
+    the softmax denominator) stay dropout-free, which is what keeps ring
+    merges exact. dropout_p=0 lowers to the exact pre-dropout kernel.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("expected (B, H, S, D) operands")
@@ -813,6 +947,14 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                          f"Hkv={k.shape[1]}")
     scale = (1.0 / float(np.sqrt(q.shape[-1]))
              if sm_scale is None else float(sm_scale))
+    # fp16 (the O*_fp16 AMP policies) is a storage dtype on TPU: Mosaic
+    # has no f16, so compiled kernels run bf16 and the result is cast
+    # back — see ops._common.mosaic_dtype. Resolved BEFORE the block
+    # lookup so the tuning table keys on the dtype the kernel compiles.
+    io_dtype = q.dtype
+    if use_pallas():
+        # an f16 bias hits the same Mosaic f16 wall as q/k/v
+        q, k, v, bias = to_mosaic(q, k, v, bias)
     block_q, block_k = _auto_blocks(q.shape[3], block_q, block_k, q.dtype,
                                     k.shape[2])
     has_segs, qseg, kseg = _norm_segments(segment_ids, q.shape[2],
@@ -830,6 +972,15 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                 or bias.shape[2:] != (Sq, Sk)):
             raise ValueError(f"bias shape {bias.shape} must be "
                              f"(1|{B}, 1|{Hq}, {Sq}, {Sk})")
+    dropout_p = float(dropout_p)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 needs an explicit int32 "
+                         "dropout_seed (ops.stochastic.seed_from_key / "
+                         "fold_seed at the call site)")
+    seed = (jnp.asarray(dropout_seed, jnp.int32) if dropout_p > 0.0
+            else jnp.zeros((), jnp.int32))
     if use_pallas():
         dummy = jnp.zeros((1, 1), jnp.int32)
         if bias is not None:
@@ -837,26 +988,33 @@ def flash_attention(q, k, v, *, causal: bool = False, segment_ids=None,
                 q, k, v, bias,
                 qseg if has_segs else dummy,
                 kseg if has_segs else dummy,
-                q_offset, k_offset,
-                scale, causal, has_segs, block_q, block_k)
+                q_offset, k_offset, seed,
+                scale, causal, has_segs, block_q, block_k, dropout_p)
         else:
             out, lse = _flash(q, k, v,
                               qseg if has_segs else dummy,
                               kseg if has_segs else dummy,
-                              q_offset, k_offset,
-                              scale, causal, has_segs, block_q, block_k)
+                              q_offset, k_offset, seed,
+                              scale, causal, has_segs, block_q, block_k,
+                              dropout_p)
     else:
         out, lse = _xla_attention(q, k, v, qseg, kseg, q_offset, k_offset,
-                                  scale, causal, with_lse=True, bias=bias)
+                                  scale, causal, with_lse=True, bias=bias,
+                                  dropout_p=dropout_p, seed=seed)
+    if out.dtype != io_dtype:
+        out = out.astype(io_dtype)  # fp16 storage dtype restored
     return (out, lse) if return_lse else out
 
 
 def fmha(qkv, *, segment_ids=None, causal: bool = True,
-         sm_scale: float | None = None):
+         sm_scale: float | None = None, dropout_p: float = 0.0,
+         dropout_seed=None):
     """``apex.contrib.fmha.FMHAFun`` equivalent: packed (B, S, 3, H, D)
     QKV, varlen via ``segment_ids`` instead of cu_seqlens. No seqlen-512 or
-    head-dim-64 cap — the flash kernel serves all sizes."""
+    head-dim-64 cap — the flash kernel serves all sizes. ``dropout_p``
+    is the reference's in-kernel probability dropout (seeded, fused)."""
     q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
     out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                          sm_scale=sm_scale)
+                          sm_scale=sm_scale, dropout_p=dropout_p,
+                          dropout_seed=dropout_seed)
     return out.transpose(0, 2, 1, 3)

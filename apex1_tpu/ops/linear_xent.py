@@ -38,8 +38,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex1_tpu.ops._common import (NEG_INF, interpret_mode, out_struct,
-                                    pad_to, use_pallas)
+from apex1_tpu.ops._common import (NEG_INF, interpret_mode, mosaic_dtype,
+                                    out_struct, pad_to, to_mosaic,
+                                    use_pallas)
 
 _LANES = 128
 
@@ -400,6 +401,10 @@ def linear_cross_entropy(x, weight, labels, *, smoothing: float = 0.0,
     x2 = x.reshape(-1, x.shape[-1])
     t2 = labels.reshape(-1, 1).astype(jnp.int32)
     if use_pallas():
+        # fp16 is a storage dtype on TPU (Mosaic has no f16): the kernel
+        # takes bf16; the fp32 loss output needs no restore — see
+        # ops._common.mosaic_dtype
+        x2, weight = to_mosaic(x2, weight)
         loss = _fused(x2, weight, t2, float(smoothing), padding_idx,
                       num_classes, block_t, block_v)
     else:

@@ -60,6 +60,7 @@ import numpy as np
 from apex1_tpu.ops._common import NEG_INF, use_pallas
 from apex1_tpu.ops._common import vary as _vary
 from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.ops.stochastic import attn_keep_mask
 
 
 def _axis_size(axis_name) -> int:
@@ -75,7 +76,8 @@ def _merge(out_a, lse_a, out_b, lse_b):
 
 
 def _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
-                   block_q, block_k):
+                   block_q, block_k, dropout_p=0.0, seed=None,
+                   skip_masked=True):
     """Double-buffered forward ring. Returns (out_fp32, lse).
 
     Schedule: the ppermute for the NEXT visiting shard is issued before
@@ -83,14 +85,25 @@ def _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
     all n−1 neighbor transfers overlap the n attends. Attend/merge order
     is identical to the serialized schedule — forward numerics are
     bit-for-bit the same; only the permutes' dataflow changes.
+
+    ``dropout_p``/``seed``: in-kernel probability dropout — every shard
+    step passes its TRUE global offsets so the counter-based mask is
+    keyed on global positions: shards draw disjoint streams and the mask
+    is invariant to the visiting order (serial and overlapped schedules
+    drop identical weights). ``seed`` must be replicated over the ring.
+    ``skip_masked=False`` disables the causal lax.cond shard skip (the
+    fully-masked attend runs and merges a NEG_INF partial — numerically
+    identical); kept for the A/B timing in tools/bench_cond_elision.py.
     """
     n = _axis_size(axis_name)
     B, Hq, Sq, _ = q.shape
     Sk = k.shape[2]
-    # axis_index only when the causal mask consumes it: a dead
-    # partition-id chain in the custom_vjp jaxpr breaks XLA sharding
-    # propagation (consumer-less partition-id is UNIMPLEMENTED there)
-    if causal:
+    # axis_index only when the causal mask (or the dropout counter,
+    # which keys on global positions) consumes it: a dead partition-id
+    # chain in the custom_vjp jaxpr breaks XLA sharding propagation
+    # (consumer-less partition-id is UNIMPLEMENTED there)
+    needs_offs = causal or dropout_p > 0.0
+    if needs_offs:
         idx = jax.lax.axis_index(axis_name)
         q_off = idx * Sq
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -100,11 +113,12 @@ def _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
     lse = _vary(jnp.full((B, Hq, Sq), NEG_INF, jnp.float32), axis_name)
 
     def attend(k_cur, v_cur, kseg_cur, t, out, lse):
-        # offsets are consumed only by the causal mask; computing them
-        # unconditionally would leave a dead partition-id chain in the
-        # custom_vjp jaxpr (not DCE'd before XLA sharding propagation,
-        # which then fails on the consumer-less partition-id)
-        if causal:
+        # offsets are consumed only by the causal mask / dropout
+        # counter; computing them unconditionally would leave a dead
+        # partition-id chain in the custom_vjp jaxpr (not DCE'd before
+        # XLA sharding propagation, which then fails on the
+        # consumer-less partition-id)
+        if needs_offs:
             src = (idx - t) % n       # who this K/V shard belongs to
             k_off = src * Sk
             qo, ko = q_off, k_off
@@ -116,14 +130,15 @@ def _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
                 q, k_cur, v_cur, causal=causal,
                 segment_ids=(qseg, kseg_cur) if has_segs else None,
                 sm_scale=sm_scale, q_offset=qo, k_offset=ko,
-                block_q=block_q, block_k=block_k, return_lse=True)
+                block_q=block_q, block_k=block_k, return_lse=True,
+                dropout_p=dropout_p, dropout_seed=seed)
 
         def skip(_):
             return (_vary(jnp.zeros(q.shape, q.dtype), axis_name),
                     _vary(jnp.full((B, Hq, Sq), NEG_INF, jnp.float32),
                           axis_name))
 
-        if causal:
+        if causal and skip_masked:
             # visiting shard strictly in the future → fully masked
             out_t, lse_t = jax.lax.cond(k_off > q_off + Sq - 1, skip, run,
                                         None)
@@ -170,7 +185,8 @@ def _resolve_scale(q, sm_scale):
 
 
 def _step_grads_pallas(q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, out,
-                       lse, do, scale, causal, has_segs, block_q, block_k):
+                       lse, do, scale, causal, has_segs, block_q, block_k,
+                       dropout_p=0.0, seed=None):
     """One visiting shard's (dq_t, dk_t, dv_t) via the flash backward
     kernels, evaluated with the FINAL merged (out, lse): p_t =
     exp(s_t − lse_global) is each key's true global softmax weight, so
@@ -187,21 +203,26 @@ def _step_grads_pallas(q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, out,
     bq = _block(Sq, block_q)
     lse_p, _ = pad_to(lse[..., None], 2, bq, value=NEG_INF)
     dummy = jnp.zeros((1, 1), jnp.int32)
+    sd = (jnp.asarray(seed, jnp.int32) if dropout_p > 0.0
+          else jnp.zeros((), jnp.int32))
     res = (q, k_cur, v_cur,
            qseg if has_segs else dummy,
            kseg_cur if has_segs else dummy,
-           q_off, k_off, out, lse_p)
+           q_off, k_off, sd, out, lse_p)
     cts = (do, jnp.zeros(lse.shape, jnp.float32))
     # cast=False: dk/dv stay in the kernels' native fp32 so the ring
     # accumulation is exact (dq is q.dtype — the dq kernel's output
-    # dtype, same per-shard precision as single-shard flash)
+    # dtype, same per-shard precision as single-shard flash). With
+    # dropout the backward kernels recompute the mask from (seed,
+    # global offsets) — identical to what the forward shard drew.
     grads, _ = _flash_bwd_impl(scale, causal, has_segs, block_q, block_k,
-                               res, cts, cast=False)
+                               res, cts, cast=False, dropout_p=dropout_p)
     return grads[0], grads[1], grads[2]
 
 
 def _step_grads_xla(q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, lse,
-                    delta, do, scale, causal, has_segs):
+                    delta, do, scale, causal, has_segs, dropout_p=0.0,
+                    seed=None):
     """XLA-composite per-shard backward (CPU/GPU gold): same math as
     `_step_grads_pallas` with the local S×S score block materialized."""
     B, Hq, Sq, D = q.shape
@@ -227,8 +248,17 @@ def _step_grads_xla(q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, lse,
     # lse is the GLOBAL logsumexp; rows with no valid keys carry the
     # NEG_INF sentinel — their exp overflows but the mask zeroes p
     p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
-    dv_full = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    if dropout_p > 0.0:
+        keep = attn_keep_mask(seed, B, Hq, row + q_off, col + k_off,
+                              dropout_p)
+        inv = 1.0 / (1.0 - dropout_p)
+        p_av = jnp.where(keep, p * inv, 0.0)   # dv sees DROPPED probs
+    else:
+        p_av = p
+    dv_full = jnp.einsum("bhqk,bhqd->bhkd", p_av, dof)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    if dropout_p > 0.0:
+        dp = jnp.where(keep, dp * inv, 0.0)
     ds = p * (dp - delta[..., None]) * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
     dk_full = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
@@ -239,7 +269,8 @@ def _step_grads_xla(q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, lse,
 
 
 def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
-                   sm_scale, has_segs, block_q, block_k):
+                   sm_scale, has_segs, block_q, block_k, dropout_p=0.0,
+                   seed=None, skip_masked=True):
     """Double-buffered backward ring over the INVERTED permutation.
 
     Shards flow backward (device i sends to i−1), so this device visits
@@ -252,9 +283,10 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
     n = _axis_size(axis_name)
     B, Hq, Sq, D = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
-    # offsets exist only for the causal mask — see _ring_fwd_loop on why
-    # a dead partition-id chain must not be traced
-    if causal:
+    # offsets exist only for the causal mask / dropout counter — see
+    # _ring_fwd_loop on why a dead partition-id chain must not be traced
+    needs_offs = causal or dropout_p > 0.0
+    if needs_offs:
         idx = jax.lax.axis_index(axis_name)
         q_off = idx * Sq
     else:
@@ -279,11 +311,13 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
             if pallas:
                 g = _step_grads_pallas(
                     q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, out,
-                    lse, do, scale, causal, has_segs, block_q, block_k)
+                    lse, do, scale, causal, has_segs, block_q, block_k,
+                    dropout_p=dropout_p, seed=seed)
             else:
                 g = _step_grads_xla(
                     q, k_cur, v_cur, qseg, kseg_cur, q_off, k_off, lse,
-                    delta, do, scale, causal, has_segs)
+                    delta, do, scale, causal, has_segs,
+                    dropout_p=dropout_p, seed=seed)
             return tuple(t.astype(jnp.float32) for t in g)
 
         def skip(_):
@@ -291,7 +325,7 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
                                     axis_name)
             return (z(q.shape), z(k.shape), z(v.shape))
 
-        if causal:
+        if causal and skip_masked:
             # visiting shard strictly in the future → zero cotangents;
             # the cond skips the FLOPs, the transfer still rides
             return jax.lax.cond(k_off > q_off + Sq - 1, skip, run, None)
@@ -300,7 +334,7 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
     kseg0 = qseg if has_segs else jnp.zeros((), jnp.int32)
     f32 = jnp.float32
     dq_own, dk_own, dv_own = step_grads(k, v, kseg0,
-                                        idx if causal else 0)
+                                        idx if needs_offs else 0)
     dq = dq_own.astype(f32)
     dk_own = dk_own.astype(f32)
     dv_own = dv_own.astype(f32)
@@ -338,7 +372,7 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
         v_nxt = jax.lax.ppermute(v_cur, axis_name, inv)
         kseg_nxt = (jax.lax.ppermute(kseg_cur, axis_name, inv)
                     if has_segs else kseg_cur)
-        src = (idx + 1 + t) % n if causal else 0
+        src = (idx + 1 + t) % n if needs_offs else 0
         dq_t, dk_pend, dv_pend = step_grads(k_cur, v_cur, kseg_cur, src)
         dq = dq + dq_t.astype(f32)
         return (k_nxt, v_nxt, kseg_nxt, dk_acc, dv_acc, dk_pend,
@@ -355,31 +389,38 @@ def _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _ring(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
-          block_q, block_k):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _ring(q, k, v, qseg, seed, axis_name, causal, sm_scale, has_segs,
+          block_q, block_k, dropout_p, skip_masked):
     out, _ = _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale,
-                            has_segs, block_q, block_k)
+                            has_segs, block_q, block_k,
+                            dropout_p=dropout_p, seed=seed,
+                            skip_masked=skip_masked)
     return out.astype(q.dtype)
 
 
-def _ring_fwd_rule(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
-                   block_q, block_k):
+def _ring_fwd_rule(q, k, v, qseg, seed, axis_name, causal, sm_scale,
+                   has_segs, block_q, block_k, dropout_p, skip_masked):
     out, lse = _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale,
-                              has_segs, block_q, block_k)
+                              has_segs, block_q, block_k,
+                              dropout_p=dropout_p, seed=seed,
+                              skip_masked=skip_masked)
     out = out.astype(q.dtype)
-    return out, (q, k, v, qseg, out, lse)
+    return out, (q, k, v, qseg, seed, out, lse)
 
 
 def _ring_bwd_rule(axis_name, causal, sm_scale, has_segs, block_q, block_k,
-                   res, do):
-    q, k, v, qseg, out, lse = res
+                   dropout_p, skip_masked, res, do):
+    q, k, v, qseg, seed, out, lse = res
     dq, dk, dv = _ring_bwd_loop(q, k, v, qseg, out, lse, do, axis_name,
                                 causal, sm_scale, has_segs, block_q,
-                                block_k)
+                                block_k, dropout_p=dropout_p, seed=seed,
+                                skip_masked=skip_masked)
     f0 = np.zeros(jnp.shape(qseg), dtype=jax.dtypes.float0)
+    f0s = np.zeros(jnp.shape(seed), dtype=jax.dtypes.float0)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            f0)
+            f0, f0s)
 
 
 _ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
@@ -388,7 +429,8 @@ _ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 def ring_attention(q, k, v, axis_name, *, causal: bool = False,
                    sm_scale: float | None = None, segment_ids=None,
                    block_q: int | None = None, block_k: int | None = None,
-                   use_custom_vjp: bool = True):
+                   use_custom_vjp: bool = True, dropout_p: float = 0.0,
+                   dropout_seed=None, skip_masked: bool = True):
     """Attention over a sequence sharded on mesh axis ``axis_name``.
 
     ``q``: local shard (B, Hq, S_local, D); ``k``/``v``: (B, Hkv, S_local,
@@ -404,23 +446,41 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = False,
     ``use_custom_vjp=False`` reverts the backward to XLA's transpose of
     the forward scan (serialized transfers) — kept for parity tests and
     as an escape hatch; forward numerics are identical either way.
+    ``dropout_p``/``dropout_seed``: in-kernel attention-probability
+    dropout (`ops.attention.flash_attention`); the seed must be
+    REPLICATED over the ring (every device passes the same int32) — the
+    counter-based mask keys on each shard's global k-offset, so shards
+    draw disjoint streams and serial/overlapped schedules drop
+    identical weights. ``skip_masked=False`` disables the causal
+    lax.cond shard skip (A/B knob for tools/bench_cond_elision.py;
+    numerics identical).
     """
     sm_scale = None if sm_scale is None else float(sm_scale)
+    dropout_p = float(dropout_p)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 needs an explicit int32 "
+                         "dropout_seed (replicated over the ring)")
+    seed = (jnp.asarray(dropout_seed, jnp.int32) if dropout_p > 0.0
+            else jnp.zeros((), jnp.int32))
     has_segs = segment_ids is not None
     qseg = (segment_ids if has_segs
             else jnp.zeros((1, 1), jnp.int32))
     if use_custom_vjp:
-        return _ring(q, k, v, qseg, axis_name, causal, sm_scale, has_segs,
-                     block_q, block_k)
+        return _ring(q, k, v, qseg, seed, axis_name, causal, sm_scale,
+                     has_segs, block_q, block_k, dropout_p, skip_masked)
     out, _ = _ring_fwd_loop(q, k, v, qseg, axis_name, causal, sm_scale,
-                            has_segs, block_q, block_k)
+                            has_segs, block_q, block_k,
+                            dropout_p=dropout_p, seed=seed,
+                            skip_masked=skip_masked)
     return out.astype(q.dtype)
 
 
 def ring_attention_serial(q, k, v, axis_name, *, causal: bool = False,
                           sm_scale: float | None = None, segment_ids=None,
                           block_q: int | None = None,
-                          block_k: int | None = None):
+                          block_k: int | None = None,
+                          dropout_p: float = 0.0, dropout_seed=None,
+                          skip_masked: bool = True):
     """The ORIGINAL serialized schedule — rotate first, then attend, so
     every one of the n−1 ICI transfers is exposed (the attend consumes
     the permute it just issued). Retained as the A/B baseline
@@ -437,6 +497,10 @@ def ring_attention_serial(q, k, v, axis_name, *, causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
     has_segs = segment_ids is not None
     qseg = segment_ids
+    dropout_p = float(dropout_p)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 needs an explicit int32 "
+                         "dropout_seed (replicated over the ring)")
 
     out0 = _vary(jnp.zeros(q.shape, jnp.promote_types(q.dtype,
                                                       jnp.float32)),
@@ -452,14 +516,15 @@ def ring_attention_serial(q, k, v, axis_name, *, causal: bool = False,
                 q, k_cur, v_cur, causal=causal,
                 segment_ids=(qseg, kseg_cur) if has_segs else None,
                 sm_scale=sm_scale, q_offset=q_off, k_offset=k_off,
-                block_q=block_q, block_k=block_k, return_lse=True)
+                block_q=block_q, block_k=block_k, return_lse=True,
+                dropout_p=dropout_p, dropout_seed=dropout_seed)
 
         def skip(_):
             return (_vary(jnp.zeros(q.shape, q.dtype), axis_name),
                     _vary(jnp.full((B, Hq, Sq), NEG_INF, jnp.float32),
                           axis_name))
 
-        if causal:
+        if causal and skip_masked:
             # visiting shard strictly in the future → fully masked
             out_t, lse_t = jax.lax.cond(k_off > q_off + Sq - 1, skip, run,
                                         None)

@@ -117,6 +117,11 @@ SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
     KernelSpec("xentropy", ("block_rows",), ("lanes",), 8,
                _row_check(2)),                     # x in, dx out (stats
                                                    # are (br, 1) noise)
+    KernelSpec("bias_dropout_add", ("block_rows",), ("lanes",), 8,
+               _row_check(4)),                     # x, residual, out (+
+                                                   # dy/dx in bwd); mask
+                                                   # is PRNG-recomputed,
+                                                   # never stored
     KernelSpec("linear_xent", ("block_t", "block_v"), ("Hp",), 16,
                _linear_xent_check),
     KernelSpec("int8_matmul", ("block_n", "block_k"), ("N", "K"), 128,

@@ -139,44 +139,53 @@ def timed_steps(train_step, state, batch, iters):
     loss = float(metrics["loss"])
     if not math.isfinite(loss):
         raise RuntimeError(f"benchmark loss is not finite: {loss}")
-    return dt / iters, flops_per_step
+    # final metrics ride along so configs can surface state evidence
+    # (fp16 O1: skipped_steps + final loss_scale in the record)
+    return dt / iters, flops_per_step, metrics
 
 
-def _amp_state_step(model_loss_fn, params, lr=1e-4):
+def _amp_state_step(model_loss_fn, params, lr=1e-4, opt_level="O2"):
     from apex1_tpu.amp import Amp
     from apex1_tpu.optim.fused_adam import fused_adam
 
-    amp = Amp(tx=fused_adam(lr, weight_decay=0.01), opt_level="O2")
+    amp = Amp(tx=fused_adam(lr, weight_decay=0.01), opt_level=opt_level)
     return amp.init(params), amp.make_train_step(model_loss_fn)
 
 
-def bench_gpt2(on_accel, batch=None, seq=None):
+def bench_gpt2(on_accel, batch=None, seq=None, fp16=False):
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn
 
+    # fp16=True: the O1_fp16 policy — fp16 compute, fp32 fragile ops,
+    # DYNAMIC loss scaling with skip-on-overflow (half the reference's
+    # reason to exist; VERDICT Weak #8 wanted hardware evidence with the
+    # skip-step count and final loss-scale in the record)
+    level = "O1_fp16" if fp16 else "O2"
     if on_accel:
         # B=16 AOT-verified on v5e (8.2 GiB incl. donated args; B=8 left
         # the MXU underfed — tools/aot_check.py sized both)
         B, S, iters = batch or 16, seq or 1024, 10
-        cfg = GPT2Config(policy=get_policy("O2"),
+        cfg = GPT2Config(policy=get_policy(level),
                          max_seq_len=max(S, 1024))
     else:
         B, S, iters = batch or 2, seq or 128, 3
-        cfg = GPT2Config.tiny(policy=get_policy("O2"),
+        cfg = GPT2Config.tiny(policy=get_policy(level),
                               max_seq_len=max(S, 128))
     model = GPT2(cfg)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S)),
         jnp.int32)
     params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
-    state, step = _amp_state_step(gpt2_loss_fn(model), params)
+    state, step = _amp_state_step(gpt2_loss_fn(model), params,
+                                  opt_level=level)
     name = "GPT-2-125M" if on_accel else "GPT-2(tiny smoke)"
     return (state, step, (tokens,), B * S, iters,
-            f"tokens/sec/chip {name} amp-O2 fused_adam", "tokens/sec/chip",
+            f"tokens/sec/chip {name} amp-{level} fused_adam",
+            "tokens/sec/chip",
             145_000.0)   # BASELINE.md pinned A100 row: gpt2
 
 
-def bench_bert(on_accel, large=False):
+def bench_bert(on_accel, large=False, dropout=0.0):
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.bert import (BertConfig, BertPretrain,
                                        bert_pretrain_loss_fn)
@@ -184,10 +193,10 @@ def bench_bert(on_accel, large=False):
     if on_accel:
         B, S, iters = (4, 512, 8) if large else (8, 512, 10)
         mk = BertConfig.bert_large if large else BertConfig.bert_base
-        cfg = mk(policy=get_policy("O2"))
+        cfg = mk(policy=get_policy("O2"), dropout=dropout)
     else:
         B, S, iters = 2, 64, 3
-        cfg = BertConfig.tiny(policy=get_policy("O2"))
+        cfg = BertConfig.tiny(policy=get_policy("O2"), dropout=dropout)
     model = BertPretrain(cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
@@ -196,10 +205,20 @@ def bench_bert(on_accel, large=False):
                  rng.integers(0, cfg.vocab_size, (B, S)), -1), jnp.int32)
     batch = {"tokens": tokens, "mlm_labels": mlm_labels,
              "nsp_labels": jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)}
+    if dropout > 0.0:
+        # presence of the key ACTIVATES the in-kernel dropout paths
+        # (flash attention-probability dropout + fused dropout-add-LN
+        # epilogues). One fixed key per run: every timed step draws the
+        # same masks — the PRNG work is identical per step, which is
+        # what the throughput number prices; training would thread a
+        # fresh key per step.
+        batch["dropout_rng"] = jax.random.key(1234)
     params = jax.jit(model.init)(jax.random.key(0), tokens)["params"]
     state, step = _amp_state_step(bert_pretrain_loss_fn(model), params)
     name = (("BERT-large-pretrain" if large else "BERT-base-pretrain")
             if on_accel else "BERT(tiny smoke)")
+    if dropout > 0.0:
+        name += f"-dropout{dropout}"
     # BASELINE.md pinned A100 rows: bert_large / bert
     proxy = 57_500.0 if large else 173_000.0
     return (state, step, (batch,), B * S, iters,
@@ -414,7 +433,9 @@ def bench_decode(on_accel, quant=False):
 
 BENCHES = {
     "gpt2": bench_gpt2,
+    "gpt2_fp16": functools.partial(bench_gpt2, fp16=True),
     "bert": bench_bert,
+    "bert_dropout": functools.partial(bench_bert, dropout=0.1),
     "bert_large": functools.partial(bench_bert, large=True),
     "resnet": bench_resnet,
     "llama_longctx": bench_llama_longctx,
@@ -434,10 +455,12 @@ def _emit(record):
 # a config with several queue entries lists every log it lands in)
 _BANKED_LOGS = {
     "bert": ["bench_bert.log"],
+    "bert_dropout": ["bench_bert_drop.log"],
     "bert_large": ["bench_bert_lg.log"],
     "decode": ["bench_decode.log"],
     "decode_int8": ["bench_dec_int8.log"],
     "gpt2": ["bench_gpt2.log", "bench_gpt2_b24.log"],
+    "gpt2_fp16": ["bench_gpt2_fp16.log"],
     "llama_block": ["bench_llama_blk.log"],
     "llama_longctx": ["bench_llama16k.log"],
     "resnet": ["bench_resnet.log"],
@@ -627,7 +650,8 @@ def main():
         # at 8.2 GiB on v5e; 24 fits with margin — both sized by
         # tools/aot_check.py). A candidate that fails (OOM on a
         # smaller-memory pool chip) is skipped, not fatal.
-        if args.config == "gpt2" and on_accel and args.batch is None:
+        if args.config in ("gpt2", "gpt2_fp16") and on_accel \
+                and args.batch is None:
             cand_batches = [16, 24]
         else:
             cand_batches = [args.batch]
@@ -638,12 +662,12 @@ def main():
         for b in cand_batches:
             try:
                 kw = {}
-                if args.config == "gpt2":
+                if args.config in ("gpt2", "gpt2_fp16"):
                     kw = dict(batch=b, seq=args.seq)
                 (state, step, batch, units_per_step, iters, metric, unit,
                  proxy) = BENCHES[args.config](on_accel, **kw)
-                per_step, flops_per_step = timed_steps(step, state,
-                                                       batch, iters)
+                (per_step, flops_per_step,
+                 final_metrics) = timed_steps(step, state, batch, iters)
                 rate = units_per_step / per_step
                 if rate > best_rate:   # unrounded comparison
                     best_rate = rate
@@ -655,6 +679,14 @@ def main():
                     }
                     if len(cand_batches) > 1:
                         best["batch"] = b
+                    # dynamic-loss-scaling evidence (fp16 O1): the
+                    # record carries the skip count and where the scale
+                    # settled — zero skips after warmup and a stable
+                    # scale is the pass signal
+                    for mk_ in ("loss_scale", "skipped_steps"):
+                        if mk_ in final_metrics:
+                            best[mk_] = float(
+                                np.asarray(final_metrics[mk_]))
                     if flops_per_step is not None and on_accel:
                         from apex1_tpu.core.capability import (
                             get_capability)

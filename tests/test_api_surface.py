@@ -22,6 +22,9 @@ SURFACE = {
         "FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense",
         "fused_dense_gelu_dense"],
     "apex1_tpu.ops.attention": ["flash_attention", "fmha"],
+    "apex1_tpu.ops.stochastic": [
+        "fused_bias_dropout_add", "fused_dropout_add_layer_norm",
+        "seed_from_key", "fold_seed"],
     "apex1_tpu.ops.linear_xent": ["linear_cross_entropy"],
     "apex1_tpu.parallel": [
         "DistributedDataParallel", "SyncBatchNorm",

@@ -310,7 +310,13 @@ class TestRaggedGenerate:
     rows with per-row positions/segment masking must emit EXACTLY the
     tokens each row produces when generated alone at its true length."""
 
-    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    @pytest.mark.parametrize("family", [
+        "gpt2",
+        # llama adds GQA x ragged on top of gpt2's contract — GQA cached
+        # decode stays tier-1 via TestLlamaGenerate::
+        # test_gqa_cached_matches_full_forward; full run via check_all --all
+        pytest.param("llama", marks=pytest.mark.slow),
+    ])
     def test_rows_match_solo_generation(self, family):
         if family == "gpt2":
             cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)
@@ -385,6 +391,10 @@ class TestRaggedGenerate:
             err_msg="pad-slot content leaked into MoE ragged decode "
                     "(pads claiming expert capacity?)")
 
+    @pytest.mark.slow  # 4 distinct-shape generate compiles (~28s); tier-1
+    # keeps MoE-ragged routing via test_ragged_moe_pad_content_invariance
+    # and rows-match-solo via test_rows_match_solo_generation; full run
+    # via check_all --all
     def test_ragged_moe_rows_match_solo_decode(self):
         """MoE x ragged with AMPLE capacity (no expert ever overflows,
         so batched-vs-solo capacity coupling vanishes): each row must
@@ -406,6 +416,10 @@ class TestRaggedGenerate:
                 np.asarray(got[b]), np.asarray(solo[0]),
                 err_msg=f"MoE row {b} (len {ln}) diverged from solo")
 
+    @pytest.mark.slow  # ~15s of MoE generate compiles; MoE-ragged routing
+    # stays tier-1 via test_ragged_moe_pad_content_invariance and the
+    # prefix-cache contract via TestPrefixCaching::
+    # test_continuation_matches_flat_prompt; full run via check_all --all
     def test_moe_prefix_cache_continuation_matches_flat(self):
         """docs/serving.md matrix: MoE x prefix caching — a prefix
         prefilled once through the MoE decoder, continued via

@@ -273,6 +273,42 @@ PRNG_SUP = """
         return a, b
 """
 
+# pltpu.prng_seed consumes int32 COUNTER SEEDS, not keys: re-seeding in
+# the forward kernel and again in the backward's mask recompute is the
+# in-kernel stochasticity contract (ops.stochastic), not key reuse —
+# even when the seed variable is key-NAMED. Deriving the seed with ONE
+# jax.random.randint draw at the call site is the sanctioned idiom.
+PRNG_KERNEL_NEG = """
+    import jax
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel_reseed(seed_rng, o_ref):
+        pltpu.prng_seed(seed_rng, 0)        # fwd tile stream
+        a = pltpu.prng_random_bits((8, 128))
+        pltpu.prng_seed(seed_rng, 1)        # bwd recompute: NOT reuse
+        b = pltpu.prng_random_bits((8, 128))
+        o_ref[...] = a ^ b
+
+    def call_site(rng, fwd, bwd, x):
+        seed = jax.random.randint(rng, (), 0, 2**31 - 1)  # one draw
+        y = fwd(x, seed)         # the int32 seed is reused freely by
+        dx = bwd(x, seed)        # the fwd and bwd kernels — not a key
+        return y, dx
+"""
+
+# the exemption must NOT leak: a real key double-drawn around kernel
+# PRNG calls is still flagged
+PRNG_KERNEL_POS = """
+    import jax
+    from jax.experimental.pallas import tpu as pltpu
+
+    def mixed(key):
+        a = jax.random.normal(key, (2,))
+        pltpu.prng_seed(key, 0)             # exempt — not a consumption
+        b = jax.random.uniform(key, (2,))   # second REAL draw: flagged
+        return a, b
+"""
+
 
 class TestPrngReuse:
     def test_positive(self):
@@ -291,6 +327,17 @@ class TestPrngReuse:
         assert "APX103" not in codes(res)
         sup = res.suppressed()
         assert len(sup) == 1 and "tied masks" in sup[0].reason
+
+    def test_kernel_prng_seed_is_not_key_reuse(self):
+        res = run_lint(PRNG_KERNEL_NEG)
+        assert "APX103" not in codes(res), \
+            [f.render() for f in res.unsuppressed()]
+
+    def test_kernel_prng_exemption_does_not_leak(self):
+        res = run_lint(PRNG_KERNEL_POS)
+        bad = [f for f in res.unsuppressed() if f.rule == "APX103"]
+        assert len(bad) == 1, [f.render() for f in res.findings]
+        assert "jax.random.uniform" in bad[0].message
 
 
 # ---------------------------------------------------------------------------

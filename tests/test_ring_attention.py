@@ -364,3 +364,72 @@ class TestUlysses:
         for a, b in zip(g_ep, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
+
+
+class TestRingDropout:
+    """In-kernel dropout over the ring (PR 5): the counter-based mask
+    keys on GLOBAL positions via each shard's k_offset, so (a) serial
+    and overlapped schedules drop identical weights, (b) the sharded
+    result equals single-device flash dropout, (c) both custom-VJP
+    paths agree. The tolerance is tight-allclose, not bitwise: the two
+    schedules compile to different programs and differ by float
+    rounding only (a wrong mask would differ by O(1) dropped weights)."""
+
+    P_DROP, SEED = 0.2, 99
+
+    def _run(self, mesh, fn, q, k, v, **kw):
+        spec = P(None, None, "cp", None)
+
+        def local(q, k, v):
+            return fn(q, k, v, "cp", causal=True, dropout_p=self.P_DROP,
+                      dropout_seed=self.SEED, **kw)
+
+        return jax.jit(jax.shard_map(local, mesh=mesh,
+                                     in_specs=(spec,) * 3,
+                                     out_specs=spec))(q, k, v)
+
+    def test_serial_overlapped_parity_and_flash_equivalence(self, rng,
+                                                            devices):
+        from apex1_tpu.parallel.ring_attention import ring_attention_serial
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q, k, v = _mk(rng)
+        o_ov = self._run(mesh, ring_attention, q, k, v)
+        o_se = self._run(mesh, ring_attention_serial, q, k, v)
+        np.testing.assert_allclose(o_ov, o_se, rtol=5e-6, atol=5e-7)
+        # sharded == unsharded: the mask is a pure function of global
+        # position, so context parallelism does not change WHICH
+        # weights drop — only how the sum is sliced
+        want = flash_attention(q, k, v, causal=True,
+                               dropout_p=self.P_DROP,
+                               dropout_seed=self.SEED)
+        np.testing.assert_allclose(o_ov, want, rtol=2e-5, atol=2e-5)
+        # and dropout actually happened
+        plain = flash_attention(q, k, v, causal=True)
+        assert not np.allclose(o_ov, plain, atol=1e-3)
+        # causal-skip cond off (tools/bench_cond_elision.py's A/B arm):
+        # numerics identical
+        o_ns = self._run(mesh, ring_attention, q, k, v,
+                         skip_masked=False)
+        np.testing.assert_allclose(o_ns, o_ov, rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.slow  # two full ring-backward compiles: check_all --all
+    def test_grads_both_vjp_paths(self, rng, devices):
+        mesh = make_mesh(cp=SP, dp=1, devices=devices[:SP])
+        q, k, v = _mk(rng)
+        spec = P(None, None, "cp", None)
+
+        def grads(use_custom):
+            def local(q, k, v):
+                return ring_attention(q, k, v, "cp", causal=True,
+                                      dropout_p=self.P_DROP,
+                                      dropout_seed=self.SEED,
+                                      use_custom_vjp=use_custom)
+
+            sm = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                               out_specs=spec)
+            return jax.grad(lambda q, k, v: jnp.sum(sm(q, k, v) ** 2),
+                            (0, 1, 2))(q, k, v)
+
+        for a, b in zip(grads(True), grads(False)):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+

@@ -1,0 +1,455 @@
+"""In-kernel stochasticity determinism contract (`ops.stochastic` + the
+flash kernels' fused probability dropout).
+
+The contract (docs/perf_playbook.md "In-kernel dropout"):
+
+- same seed → BIT-IDENTICAL output across calls and across jit
+  boundaries, per backend;
+- dropout=0 lowers to the pre-existing program bit-for-bit;
+- keep-rate is statistically correct at p ∈ {0.1, 0.5};
+- the backward recomputes the forward's mask exactly from the seed
+  (recompute identity) — pinned in interpret mode, where the kernel
+  hash and the XLA composites are bit-equal so AD-of-composite is an
+  exact oracle for the custom-VJP kernels;
+- masks are NOT bitwise-matched to a jax.random.bernoulli composite —
+  statistical parity only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.ops._common import force_impl
+from apex1_tpu.ops.attention import flash_attention
+from apex1_tpu.ops.stochastic import (fold_seed, fused_bias_dropout_add,
+                                      fused_dropout_add_layer_norm,
+                                      hash_bits_u32, seed_from_key,
+                                      threshold_u32)
+
+SEED = jnp.int32(20240801)
+
+
+def _xrb(rng, *shape, dtype=jnp.float32):
+    return jnp.asarray(rng.normal(size=shape), dtype)
+
+
+# ---------------------------------------------------------------------------
+# the counter hash itself
+# ---------------------------------------------------------------------------
+
+class TestCounterHash:
+    @pytest.mark.parametrize("p", [0.1, 0.5])
+    def test_keep_rate(self, p):
+        row = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 1)
+        bits = hash_bits_u32(SEED, 3, row, col)
+        keep = np.asarray(bits >= threshold_u32(p))
+        rate = keep.mean()
+        # 512² draws: binomial σ ≈ 0.001 — 5σ bounds
+        assert abs(rate - (1.0 - p)) < 0.005, (p, rate)
+
+    def test_streams_disjoint_across_salts_and_seeds(self):
+        row = jax.lax.broadcasted_iota(jnp.int32, (64, 128), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (64, 128), 1)
+        a = np.asarray(hash_bits_u32(SEED, 0, row, col))
+        b = np.asarray(hash_bits_u32(SEED, 1, row, col))
+        c = np.asarray(hash_bits_u32(SEED + 1, 0, row, col))
+        assert (a != b).mean() > 0.99
+        assert (a != c).mean() > 0.99
+
+    def test_shift_invariance(self):
+        """The stream is a pure function of GLOBAL position: evaluating
+        a window at an offset reproduces the global stream's slice —
+        the property that makes ring shards schedule-invariant."""
+        row = jax.lax.broadcasted_iota(jnp.int32, (32, 32), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (32, 32), 1)
+        full = np.asarray(hash_bits_u32(SEED, 7, row, col + 0))
+        shifted = np.asarray(hash_bits_u32(SEED, 7, row[:, :16],
+                                           col[:, :16] + 16))
+        np.testing.assert_array_equal(full[:, 16:], shifted)
+
+    def test_salt_row_not_interchangeable(self):
+        """(salt=a, row=b) and (salt=b, row=a) must draw DIFFERENT
+        streams — a symmetric hash would pairwise-correlate per-head
+        attention masks across (batch·head, q-row) index pairs."""
+        col = jnp.arange(128, dtype=jnp.int32)
+        pairs = [(3, 5), (0, 1), (7, 96)]
+        for a, b in pairs:
+            x = np.asarray(hash_bits_u32(
+                SEED, a, jnp.full_like(col, b), col))
+            y = np.asarray(hash_bits_u32(
+                SEED, b, jnp.full_like(col, a), col))
+            assert (x != y).mean() > 0.99, (a, b)
+
+    def test_fold_seed_derives_distinct_streams(self):
+        s0, s1 = fold_seed(SEED, 0), fold_seed(SEED, 1)
+        assert int(s0) != int(s1)
+        assert int(s0) >= 0 and int(s1) >= 0  # int32-seed value range
+
+    def test_seed_from_key(self):
+        s = seed_from_key(jax.random.key(0))
+        assert s.dtype == jnp.int32 and s.shape == ()
+        assert int(s) != int(seed_from_key(jax.random.key(1)))
+
+
+# ---------------------------------------------------------------------------
+# fused_bias_dropout_add
+# ---------------------------------------------------------------------------
+
+class TestBiasDropoutAdd:
+    def test_p0_is_plain_add(self, rng):
+        x, r = _xrb(rng, 4, 96), _xrb(rng, 4, 96)
+        b = _xrb(rng, 96)
+        got = fused_bias_dropout_add(x, r, p=0.0, bias=b)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(x + b + r))
+
+    def test_bit_identical_across_calls_and_jit(self, rng):
+        x, r = _xrb(rng, 6, 160), _xrb(rng, 6, 160)
+        with force_impl("pallas"):
+            a = fused_bias_dropout_add(x, r, p=0.5, seed=SEED)
+            b = fused_bias_dropout_add(x, r, p=0.5, seed=SEED)
+        f = jax.jit(lambda x, r, s: fused_bias_dropout_add(
+            x, r, p=0.5, seed=s))
+        with force_impl("pallas"):
+            c, d = f(x, r, SEED), f(x, r, SEED)
+        for other in (b, c, d):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(other))
+
+    def test_kernel_matches_xla_bitwise_on_cpu(self, rng):
+        """Interpret-mode kernel and XLA composite share the hash at
+        global positions — outputs are bit-identical on CPU."""
+        x, r = _xrb(rng, 40, 96), _xrb(rng, 40, 96)
+        b = _xrb(rng, 96)
+        with force_impl("pallas"):
+            a = fused_bias_dropout_add(x, r, p=0.3, seed=SEED, bias=b)
+        with force_impl("xla"):
+            c = fused_bias_dropout_add(x, r, p=0.3, seed=SEED, bias=b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    @pytest.mark.parametrize("p", [0.1, 0.5])
+    def test_keep_rate_and_mean(self, rng, p):
+        x = jnp.ones((128, 256), jnp.float32)
+        r = jnp.zeros((128, 256), jnp.float32)
+        with force_impl("pallas"):
+            y = np.asarray(fused_bias_dropout_add(x, r, p=p, seed=SEED))
+        kept = y != 0
+        assert abs(kept.mean() - (1.0 - p)) < 0.01, kept.mean()
+        # kept values carry 1/(1-p): the mean is preserved in expectation
+        assert abs(y.mean() - 1.0) < 0.04, y.mean()
+
+    def test_backward_recomputes_forward_mask(self, rng):
+        """Recompute identity, observed directly: d(sum y)/dx must be
+        EXACTLY mask/(1-p) — the mask the forward applied (observable
+        as y - r != 0)."""
+        x, r = _xrb(rng, 24, 128), _xrb(rng, 24, 128)
+        p = 0.4
+
+        def f(x):
+            with force_impl("pallas"):
+                return fused_bias_dropout_add(x, r, p=p, seed=SEED)
+
+        y = f(x)
+        fwd_mask = np.asarray(y - r) != 0
+        dx = np.asarray(jax.grad(lambda x: jnp.sum(f(x)))(x))
+        np.testing.assert_array_equal(dx != 0, fwd_mask)
+        np.testing.assert_allclose(dx[fwd_mask], 1.0 / (1.0 - p),
+                                   rtol=1e-6)
+
+    @pytest.mark.slow  # cross-impl grad parity; identity pinned above
+    def test_bias_and_residual_grads(self, rng):
+        x, r = _xrb(rng, 24, 96), _xrb(rng, 24, 96)
+        b = _xrb(rng, 96)
+
+        def loss(impl):
+            def f(x, r, b):
+                with force_impl(impl):
+                    y = fused_bias_dropout_add(x, r, p=0.25, seed=SEED,
+                                               bias=b)
+                return jnp.sum(y ** 2)
+            return jax.grad(f, (0, 1, 2))(x, r, b)
+
+        for gp, gx in zip(loss("pallas"), loss("xla")):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_bf16(self, rng):
+        x, r = _xrb(rng, 16, 128, dtype=jnp.bfloat16), \
+            _xrb(rng, 16, 128, dtype=jnp.bfloat16)
+        with force_impl("pallas"):
+            a = fused_bias_dropout_add(x, r, p=0.5, seed=SEED)
+        with force_impl("xla"):
+            b = fused_bias_dropout_add(x, r, p=0.5, seed=SEED)
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(a.astype(jnp.float32)),
+            np.asarray(b.astype(jnp.float32)))
+
+    def test_requires_seed(self, rng):
+        x = _xrb(rng, 4, 96)
+        with pytest.raises(ValueError, match="seed"):
+            fused_bias_dropout_add(x, x, p=0.5)
+
+    def test_not_bernoulli_matched_but_statistical(self, rng):
+        """The contract explicitly does NOT promise bitwise equality
+        with a jax.random.bernoulli composite — only the keep-rate."""
+        x = jnp.ones((64, 128), jnp.float32)
+        r = jnp.zeros_like(x)
+        with force_impl("pallas"):
+            y = np.asarray(fused_bias_dropout_add(x, r, p=0.5, seed=SEED))
+        ref = np.asarray(jax.random.bernoulli(
+            jax.random.key(int(SEED)), 0.5, x.shape))
+        ours = y != 0
+        assert not np.array_equal(ours, ref)  # different PRNGs
+        assert abs(ours.mean() - ref.mean()) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# fused_dropout_add_layer_norm
+# ---------------------------------------------------------------------------
+
+class TestDropoutAddLayerNorm:
+    def test_composes_dropout_add_then_ln(self, rng):
+        from apex1_tpu.ops import layer_norm
+        x, r = _xrb(rng, 3, 8, 96), _xrb(rng, 3, 8, 96)
+        g, b = jnp.ones((96,), jnp.float32), jnp.zeros((96,), jnp.float32)
+        with force_impl("pallas"):
+            y, z = fused_dropout_add_layer_norm(
+                x, r, g, b, p=0.2, seed=SEED, prenorm=True)
+            z_ref = fused_bias_dropout_add(x, r, p=0.2, seed=SEED)
+            y_ref = layer_norm(z_ref, g, b)
+        np.testing.assert_array_equal(np.asarray(z), np.asarray(z_ref))
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+
+    @pytest.mark.slow  # cross-impl grad parity; composition pinned above
+    def test_rms_variant_and_grads(self, rng):
+        x, r = _xrb(rng, 16, 128), _xrb(rng, 16, 128)
+        g = jnp.ones((128,), jnp.float32)
+
+        def loss(impl):
+            def f(x, r, g):
+                with force_impl(impl):
+                    y = fused_dropout_add_layer_norm(
+                        x, r, g, None, p=0.3, seed=SEED, rms=True)
+                return jnp.sum(y ** 2)
+            return jax.grad(f, (0, 1, 2))(x, r, g)
+
+        for gp, gx in zip(loss("pallas"), loss("xla")):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                       rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# flash-kernel fused probability dropout
+# ---------------------------------------------------------------------------
+
+class TestFlashDropout:
+    B, H, S, D = 2, 2, 64, 16
+
+    def _qkv(self, rng):
+        sh = (self.B, self.H, self.S, self.D)
+        return (_xrb(rng, *sh), _xrb(rng, *sh), _xrb(rng, *sh))
+
+    def test_p0_lowers_bit_for_bit(self, rng):
+        """dropout_p=0 is PINNED to the pre-dropout kernel: bit-equal
+        output, and the traced program contains NO mask machinery (the
+        unconsumed seed scalar is the only delta vs the default call —
+        the kernels' arg lists and bodies are built identically)."""
+        q, k, v = self._qkv(rng)
+
+        def default(q, k, v):
+            with force_impl("pallas"):
+                return flash_attention(q, k, v, causal=True)
+
+        def p0(q, k, v):
+            with force_impl("pallas"):
+                return flash_attention(q, k, v, causal=True,
+                                       dropout_p=0.0)
+
+        def pdrop(q, k, v):
+            with force_impl("pallas"):
+                return flash_attention(q, k, v, causal=True,
+                                       dropout_p=0.2, dropout_seed=SEED)
+
+        np.testing.assert_array_equal(np.asarray(default(q, k, v)),
+                                      np.asarray(p0(q, k, v)))
+        # the mask machinery (interpret: uint32 hash xor/shift chain;
+        # TPU: prng_seed/prng_random_bits) traces ONLY at p > 0 —
+        # falsifiable: the dropout'd jaxpr must contain it
+        mask_ops = ("xor", "prng")
+        txt_def = str(jax.make_jaxpr(default)(q, k, v))
+        txt_p0 = str(jax.make_jaxpr(p0)(q, k, v))
+        txt_drop = str(jax.make_jaxpr(pdrop)(q, k, v))
+        for op in mask_ops:
+            assert op not in txt_def and op not in txt_p0, op
+        assert any(op in txt_drop for op in mask_ops)
+
+    def test_deterministic_across_calls_and_jit(self, rng):
+        q, k, v = self._qkv(rng)
+
+        def f(q, k, v, s):
+            with force_impl("pallas"):
+                return flash_attention(q, k, v, causal=True,
+                                       dropout_p=0.2, dropout_seed=s)
+
+        a = f(q, k, v, SEED)
+        b = f(q, k, v, SEED)
+        jf = jax.jit(f)
+        c, d = jf(q, k, v, SEED), jf(q, k, v, SEED)
+        for other in (b, c, d):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(other))
+        # and a different seed draws a different mask
+        assert not np.array_equal(np.asarray(a),
+                                  np.asarray(f(q, k, v, SEED + 1)))
+
+    def test_grads_match_composite_oracle(self, rng):
+        """Recompute identity for the flash custom VJPs: on CPU the
+        interpret-mode kernels and the XLA composite share bit-equal
+        masks, so AD of the explicit composite (which differentiates
+        THROUGH the stored mask) is an exact oracle for the kernels'
+        recompute-from-seed backward."""
+        q, k, v = self._qkv(rng)
+
+        def grads(impl, **kw):
+            def f(q, k, v):
+                with force_impl(impl):
+                    o = flash_attention(q, k, v, dropout_p=0.2,
+                                        dropout_seed=SEED, **kw)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return jax.grad(f, (0, 1, 2))(q, k, v)
+
+        for kw in (dict(causal=True), dict()):
+            for gp, gx in zip(grads("pallas", **kw), grads("xla", **kw)):
+                np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                           rtol=3e-5, atol=3e-5)
+
+    @pytest.mark.slow  # feature-matrix grads: full run via check_all --all
+    def test_gqa_and_segments_compose(self, rng):
+        q, _, _ = self._qkv(rng)
+        kv = _xrb(rng, self.B, 1, self.S, self.D)
+        seg = jnp.asarray(rng.integers(0, 3, (self.B, self.S)), jnp.int32)
+
+        def grads(impl):
+            def f(q, k, v):
+                with force_impl(impl):
+                    o = flash_attention(q, k, v, segment_ids=seg,
+                                        dropout_p=0.3, dropout_seed=SEED)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return jax.grad(f, (0, 1, 2))(q, kv, kv)
+
+        for gp, gx in zip(grads("pallas"), grads("xla")):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                       rtol=3e-5, atol=3e-5)
+
+    @pytest.mark.slow  # feature-matrix grads: full run via check_all --all
+    def test_bias_dbias_composes(self, rng):
+        q, k, v = self._qkv(rng)
+        bias = _xrb(rng, 1, 1, self.S, self.S)
+
+        def grads(impl):
+            def f(q, k, v, bias):
+                with force_impl(impl):
+                    o = flash_attention(q, k, v, bias=bias, dropout_p=0.2,
+                                        dropout_seed=SEED)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return jax.grad(f, (0, 1, 2, 3))(q, k, v, bias)
+
+        for gp, gx in zip(grads("pallas"), grads("xla")):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                       rtol=3e-5, atol=3e-5)
+
+    def test_lse_is_dropout_free(self, rng):
+        """lse (and the softmax denominator) must NOT see the mask —
+        that is what keeps ring merges exact."""
+        q, k, v = self._qkv(rng)
+        with force_impl("pallas"):
+            _, lse0 = flash_attention(q, k, v, causal=True,
+                                      return_lse=True)
+            _, lse1 = flash_attention(q, k, v, causal=True,
+                                      dropout_p=0.5, dropout_seed=SEED,
+                                      return_lse=True)
+        np.testing.assert_array_equal(np.asarray(lse0), np.asarray(lse1))
+
+    def test_requires_seed(self, rng):
+        q, k, v = self._qkv(rng)
+        with pytest.raises(ValueError, match="dropout_seed"):
+            flash_attention(q, k, v, dropout_p=0.5)
+
+
+# ---------------------------------------------------------------------------
+# fp16 storage-dtype bridge (Mosaic has no f16 — AOT gate r5 caught the
+# O1_fp16 bench step failing to compile: "Unsupported type: 'f16'")
+# ---------------------------------------------------------------------------
+
+class TestF16MosaicBridge:
+    """Compiled-TPU kernels must never see float16 operands: the public
+    entries cast f16 -> bf16 (storage vs compute dtype) and restore f16
+    on the way out. Pinned at the jaxpr level under the same dispatch
+    patch tools/aot_check.py uses, so the contract is testable on CPU."""
+
+    @staticmethod
+    def _tpu_dispatch(monkeypatch):
+        import apex1_tpu.ops._common as _common
+        monkeypatch.setattr(_common, "on_tpu", lambda: True)
+
+    @staticmethod
+    def _pallas_in_avals(jaxpr):
+        out = []
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    out.extend(v.aval for v in eqn.invars)
+            for sub in jax.core.subjaxprs(jx):
+                walk(sub)
+
+        walk(jaxpr.jaxpr)
+        assert out, "expected at least one pallas_call in the jaxpr"
+        return out
+
+    def test_mosaic_dtype(self, monkeypatch):
+        from apex1_tpu.ops import _common
+        assert _common.mosaic_dtype(jnp.float16) == jnp.float16  # off-TPU
+        self._tpu_dispatch(monkeypatch)
+        assert _common.mosaic_dtype(jnp.float16) == jnp.bfloat16
+        assert _common.mosaic_dtype(jnp.bfloat16) == jnp.bfloat16
+        assert _common.mosaic_dtype(jnp.float32) == jnp.float32
+
+    def test_flash_attention_f16_bridged(self, monkeypatch):
+        self._tpu_dispatch(monkeypatch)
+        q = jax.ShapeDtypeStruct((1, 2, 64, 32), jnp.float16)
+
+        def f(q, k, v):
+            return flash_attention(q, k, v, causal=True, dropout_p=0.1,
+                                   dropout_seed=SEED)
+
+        jx = jax.make_jaxpr(f)(q, q, q)
+        assert all(a.dtype != jnp.float16
+                   for a in self._pallas_in_avals(jx))
+        assert jx.out_avals[0].dtype == jnp.float16  # storage restored
+
+    def test_bias_dropout_add_f16_bridged(self, monkeypatch):
+        self._tpu_dispatch(monkeypatch)
+        x = jax.ShapeDtypeStruct((64, 128), jnp.float16)
+        b = jax.ShapeDtypeStruct((128,), jnp.float32)
+
+        def f(x, r, b):
+            return fused_bias_dropout_add(x, r, bias=b, p=0.1, seed=SEED)
+
+        jx = jax.make_jaxpr(f)(x, x, b)
+        assert all(a.dtype != jnp.float16
+                   for a in self._pallas_in_avals(jx))
+        assert jx.out_avals[0].dtype == jnp.float16
+
+    def test_layer_norm_f16_bridged(self, monkeypatch):
+        self._tpu_dispatch(monkeypatch)
+        from apex1_tpu.ops import layer_norm
+        x = jax.ShapeDtypeStruct((64, 128), jnp.float16)
+        g = jax.ShapeDtypeStruct((128,), jnp.float32)
+
+        jx = jax.make_jaxpr(
+            lambda x, g, b: layer_norm(x, g, b))(x, g, g)
+        assert all(a.dtype != jnp.float16
+                   for a in self._pallas_in_avals(jx))
+        assert jx.out_avals[0].dtype == jnp.float16

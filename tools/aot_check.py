@@ -178,6 +178,26 @@ def main():
         check("flash bias T5-ish (2,8,1024,64) fwd+bwd", fab,
               [bshp, bshp, bshp, (1, 8, 1024, 1024)],
               in_specs=(P("dp"), P("dp"), P("dp"), P()), grad=True)
+        # in-kernel probability dropout (bert-pretrain config: attention
+        # dropout 0.1): the Mosaic gate for pltpu.prng_seed/random_bits
+        # in all three kernels — tier-1 only exercises the interpret-
+        # mode hash path, so THIS is the real TPU guard (same standing-
+        # risk shape as the ring collectives gate)
+        fad = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, dropout_p=0.1, dropout_seed=1234)
+        dshp = (8, 12, 512, 64)
+        check("flash dropout p=0.1 (8,12,512,64) fwd", fad, [dshp] * 3)
+        check("flash dropout p=0.1 (8,12,512,64) fwd+bwd", fad,
+              [dshp] * 3, grad=True)
+        check("flash dropout longctx (1,32,16384,64) fwd+bwd", fad,
+              [(1, 32, 16384, 64)] * 3, grad=True)
+        from apex1_tpu.ops import fused_bias_dropout_add
+        check("bias_dropout_add (16384,1024) fwd+bwd",
+              lambda x, r, b: fused_bias_dropout_add(
+                  x, r, bias=b, p=0.1, seed=42),
+              [(16384, 1024), (16384, 1024), (1024,)],
+              dtypes=[jnp.bfloat16, jnp.bfloat16, jnp.float32],
+              in_specs=(P("dp"), P("dp"), P()), grad=True)
 
         T, Hid, V = 16 * 1023, 768, 50432
         check(f"linear_xent gpt2 ({T},{Hid},{V}) fwd+bwd",

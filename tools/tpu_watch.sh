@@ -20,9 +20,14 @@
 #      the 16k llama_longctx shape (needs >= 2 devices; emits a
 #      skip record on a single-chip window), also BEFORE the
 #      llama_longctx re-bench                                 (~10 min)
-#   5. llama_longctx re-bench + remaining configs            (~20 min)
-#   6. per-op profile + cond-elision probe                   (~10 min)
+#   5. llama_longctx re-bench; bert_dropout (PR5 fused in-kernel
+#      dropout — the headline BERT-pretrain config) AHEAD of the
+#      plain bert re-bench; remaining configs                (~25 min)
+#   6. per-op profile + cond-elision probe + the NEW
+#      bench_cond_elision production-site A/B                (~15 min)
 #   7. kernel A/B sweeps + remaining tune_kernels sweeps     (~2x40 min)
+#   7b. gpt2 O1-fp16 dynamic-loss-scaling bench (VERDICT
+#      Weak #8) BEHIND the sweeps                            (~10 min)
 #   8. full hw_numerics re-sweep                             (~20 min)
 #
 # Every phase tees its log to perf_results/ AS IT RUNS (stdbuf line
@@ -132,6 +137,11 @@ run tune_attention  1800 python tools/tune_kernels.py --kernel attention
 # 0.36x roofline ratio — measure the claim before the headline number)
 run ring_overlap_ab 1800 python tools/bench_ring_ab.py
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
+# dropout=0.1 bert variant FIRST (PR5: attention-probability dropout now
+# rides the flash kernel + fused dropout-add-LN epilogues — this is the
+# headline BERT-pretrain configuration, measured before the plain
+# re-bench so the fused-dropout cost/win is priced on the same window)
+run bench_bert_drop 1500 python bench.py --config bert_dropout --timeout 1200
 run bench_bert      1200 python bench.py --config bert --timeout 1000
 run bench_resnet    1200 python bench.py --config resnet --timeout 1000
 run bench_t5        1500 python bench.py --config t5 --timeout 1200
@@ -140,9 +150,16 @@ run bench_decode    1200 python bench.py --config decode --timeout 1000
 run bench_dec_int8  1200 python bench.py --config decode_int8 --timeout 1000
 run profile_gpt2    1200 python tools/profile_step.py --config gpt2 --top 40
 run cond_elision     900 python tools/cond_elision_probe.py
+# A/B wall-clock of the PRODUCTION cond skips (pipeline bubble-skip +
+# ring causal-skip) — executable-verified since r4, first timing
+run bench_cond_ab   1200 python tools/bench_cond_elision.py
 run kern_all        4800 python tools/bench_kernels.py all "${TINY[@]}"
 run kern_all_llama  4800 python tools/bench_kernels.py all --llama "${TINY[@]}"
 run tune_all        4800 python tools/tune_kernels.py --kernel all
+# gpt2 O1-fp16 dynamic loss scaling BEHIND the sweeps (VERDICT Weak #8:
+# fp16 is half the reference's reason to exist, zero hardware evidence;
+# record carries skipped_steps + final loss_scale)
+run bench_gpt2_fp16 1200 python bench.py --config gpt2_fp16 --timeout 1000
 run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
 

@@ -248,6 +248,31 @@ def case_xentropy(tiny):
                      tiny_cands=(32, 64), cands=(8, 16, 32))
 
 
+def case_bias_dropout_add(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops import fused_bias_dropout_add
+
+    def build(tiny):
+        R, H = (256, 128) if tiny else (8192, 1024)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(R, H)), jnp.bfloat16)
+        r = jnp.asarray(rng.normal(size=(R, H)), jnp.bfloat16)
+        b = jnp.asarray(rng.normal(size=(H,)), jnp.float32)
+
+        def make(blocks):
+            def f(x, r):
+                return fused_bias_dropout_add(
+                    x, r, bias=b, p=0.1, seed=1234,
+                    block_rows=blocks["block_rows"])
+            return _grad_of_sum(f, (0, 1)), (x, r)
+
+        return make, H, "bfloat16"
+
+    return _row_case("bias_dropout_add", tiny, build)
+
+
 def case_int8(tiny):
     import jax.numpy as jnp
     import numpy as np
@@ -280,6 +305,7 @@ CASES = {
     "layer_norm": case_layer_norm,
     "rope": case_rope,
     "xentropy": case_xentropy,
+    "bias_dropout_add": case_bias_dropout_add,
     "int8": case_int8,
 }
 
