@@ -18,12 +18,30 @@ Backend: orbax ``StandardCheckpointer`` (async-capable, atomic renames).
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Any, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# suffix marker for in-progress saves: a killed save leaves only
+# `<path>.tmp-<pid>`, never a half-written `<path>` that LOOKS restorable
+_TMP_MARK = ".tmp-"
+
+
+class CheckpointError(RuntimeError):
+    """Typed checkpoint failure naming the path and the reason — the
+    orbax/tensorstore stack traces (missing dir, truncated array file,
+    structure mismatch) all surface through this so callers
+    (`resilience.find_restorable`, resume loops) can catch ONE type and
+    decide, instead of pattern-matching backend internals."""
+
+    def __init__(self, path: str | os.PathLike, reason: str):
+        self.path = os.fspath(path)
+        self.reason = reason
+        super().__init__(f"checkpoint {self.path}: {reason}")
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
@@ -33,10 +51,39 @@ def _checkpointer() -> ocp.StandardCheckpointer:
 def save_checkpoint(path: str | os.PathLike, state: Any, *,
                     force: bool = True) -> None:
     """Write ``state`` (any pytree of arrays, e.g. `AmpState`) to ``path``.
-    Sharded arrays are written shard-wise by their current sharding."""
+    Sharded arrays are written shard-wise by their current sharding.
+
+    Atomicity: the write lands in ``<path>.tmp-<pid>`` and is renamed to
+    ``path`` only after the backend finished and synced — a save killed
+    mid-write leaves the temp dir (ignored by restore and
+    `resilience.find_restorable`), never a truncated ``path``."""
     path = os.fspath(os.path.abspath(path))
-    with _checkpointer() as ckptr:
-        ckptr.save(path, state, force=force)
+    if os.path.exists(path) and not force:
+        raise CheckpointError(path, "exists and force=False")
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    old = None
+    try:
+        with _checkpointer() as ckptr:
+            ckptr.save(tmp, state, force=True)
+        # overwrite via move-aside, never delete-then-rename: a kill
+        # between the two renames leaves EITHER the old checkpoint at
+        # `path` or the new one — at no instant zero committed copies
+        if os.path.exists(path):
+            old = f"{path}.old-{os.getpid()}"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(path, old)
+        os.rename(tmp, path)
+    except CheckpointError:
+        raise
+    except Exception as e:
+        if old is not None and not os.path.exists(path):
+            os.rename(old, path)        # put the old checkpoint back
+            old = None
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise CheckpointError(path, f"save failed: {e}") from e
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def restore_checkpoint(path: str | os.PathLike, template: Any = None, *,
@@ -48,12 +95,24 @@ def restore_checkpoint(path: str | os.PathLike, template: Any = None, *,
     saved structure (e.g. ``jax.eval_shape(make_state)``); with ``mesh`` +
     ``spec_tree`` (PartitionSpecs), arrays restore directly onto the mesh
     with those shardings — resume on a different topology than the save.
+
+    Raises `CheckpointError` (never a raw orbax/tensorstore traceback)
+    on a missing path, an unfinished ``.tmp-`` save, or a corrupt /
+    structure-mismatched checkpoint.
     """
     path = os.fspath(os.path.abspath(path))
-    with _checkpointer() as ckptr:
-        if template is None:
-            return ckptr.restore(path)
-        return ckptr.restore(path, _abstract(template, mesh, spec_tree))
+    if not os.path.exists(path):
+        raise CheckpointError(path, "missing (no such directory)")
+    if _TMP_MARK in os.path.basename(path):
+        raise CheckpointError(
+            path, "partial write (unfinished save temp dir)")
+    try:
+        with _checkpointer() as ckptr:
+            if template is None:
+                return ckptr.restore(path)
+            return ckptr.restore(path, _abstract(template, mesh, spec_tree))
+    except Exception as e:
+        raise CheckpointError(path, f"restore failed: {e}") from e
 
 
 def _abstract(template, mesh, spec_tree):

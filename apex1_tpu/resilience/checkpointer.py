@@ -1,0 +1,300 @@
+"""Async, integrity-checked, ring-kept checkpoints over the orbax
+backend in `apex1_tpu.checkpoint` — the training-runtime-facing half of
+SURVEY §5.2's missing elastic recovery.
+
+Design:
+
+- **async double-buffering** — ``save(step, state)`` takes a cheap
+  DEVICE-side snapshot (``jnp.copy`` per leaf: async dispatch, and
+  donation-safe — the caller's next ``donate_argnums=0`` step may
+  invalidate the live buffers while the save is still running) and hands
+  it to ONE background worker. Step N+k trains while step N fetches to
+  host and writes. At most two snapshots ever exist (one writing, one
+  queued — the slot is reserved before the copy is made); a third
+  ``save`` blocks until the writer drains.
+- **atomic commit + integrity manifest** — the payload lands in
+  ``step_XXXXXXXX.tmp-<pid>/state`` via the (itself atomic)
+  `checkpoint.save_checkpoint`; `manifest.write_manifest` digests every
+  file and leaf; the temp dir is renamed to ``step_XXXXXXXX`` and only
+  then is the ``latest`` pointer file atomically promoted. A crash at
+  ANY point leaves either a complete committed checkpoint or ignorable
+  debris — never a half-directory that looks restorable.
+- **ring keep-policy** — last ``keep`` checkpoints survive; saves with
+  ``milestone=True`` are pinned outside the ring (manifest
+  ``meta["milestone"]``). GC runs after each commit.
+- **backward scan** — `find_restorable` walks newest→oldest past
+  truncated / bit-flipped / uncommitted checkpoints to the newest VALID
+  one instead of surfacing a tensorstore traceback from the corpse.
+- **exact resume** — the manifest round-trips ``step`` + a JSON ``meta``
+  dict (data-iterator position, PRNG seed, anything the loop needs; the
+  array half — params, opt state, loss-scale state — IS the state tree)
+  and a program ``fingerprint`` that refuses silent resume onto a
+  changed program.
+
+Scope: single-controller processes (the CPU proxy, single-chip bench
+runs, each rank of a multi-controller job checkpointing its own
+addressable shards via ``to_global`` upstream). Multi-controller barrier
+coordination stays with `checkpoint.CheckpointManager`.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import re
+import shutil
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from apex1_tpu.checkpoint import (CheckpointError, restore_checkpoint,
+                                  save_checkpoint)
+from apex1_tpu.resilience.manifest import (IntegrityError, Manifest,
+                                           atomic_write_text,
+                                           read_manifest, tree_entries,
+                                           verify_files, verify_tree,
+                                           write_manifest)
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_LATEST = "latest"
+_STATE_SUBDIR = "state"
+
+
+def step_dir_name(step: int) -> str:
+    if step < 0:
+        raise ValueError("step must be >= 0")
+    return f"step_{int(step):08d}"
+
+
+def _list_step_dirs(directory: str) -> list[Tuple[int, str]]:
+    """[(step, absolute path)] sorted ascending; ignores temp debris."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def is_valid_checkpoint(path: str | os.PathLike) -> bool:
+    """Committed + passes the file-level integrity manifest."""
+    try:
+        verify_files(path)
+        return True
+    except IntegrityError:
+        return False
+
+
+def find_restorable(directory: str | os.PathLike) -> Optional[str]:
+    """Newest VALID checkpoint dir under ``directory``, or None.
+
+    Scans every ``step_*`` dir newest→oldest, verifying each file
+    manifest, so a truncated newest checkpoint (killed save) or a
+    bit-flipped middle one degrades to the next older valid snapshot
+    instead of an unrecoverable job. The ``latest`` pointer file is
+    deliberately NOT trusted here: a kill between the commit rename
+    and the pointer promote leaves a newer fully-valid checkpoint the
+    pointer doesn't know about, and "newest valid" must win (the
+    pointer remains as an operator-facing breadcrumb, and the newest
+    dir is the first one verified anyway, so the scan costs nothing
+    extra in the healthy case)."""
+    directory = os.fspath(directory)
+    for _step, path in reversed(_list_step_dirs(directory)):
+        if is_valid_checkpoint(path):
+            return path
+    return None
+
+
+class ResilientCheckpointer:
+    """Train-loop API: ``save(step, state)`` (async) / ``save_sync`` /
+    ``restore(template)`` / ``latest_valid()``. See module docstring."""
+
+    def __init__(self, directory: str | os.PathLike, *, keep: int = 3,
+                 fingerprint: Optional[int] = None):
+        self.directory = os.fspath(os.path.abspath(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.keep = int(keep)
+        self.fingerprint = fingerprint
+        self._q: queue.Queue = queue.Queue()
+        # the real memory bound: a slot is taken BEFORE the device-side
+        # snapshot is built and released only after the worker dropped
+        # it, so at most two snapshots ever coexist (one writing, one
+        # queued) — a queue maxsize can't give this bound, because the
+        # third save() would build its snapshot before put() blocks
+        self._slots = threading.Semaphore(2)
+        self._errors: list[BaseException] = []
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._work, daemon=True)
+        self._worker.start()
+
+    # -- save path ---------------------------------------------------------
+
+    def _snapshot(self, state):
+        """Device-side copy of every jax leaf (async dispatch): the live
+        buffers may be donated to the very next train step."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True)
+            if isinstance(x, jax.Array) else x, state)
+
+    def save(self, step: int, state: Any, *, meta: Optional[dict] = None,
+             milestone: bool = False) -> None:
+        """Queue an async snapshot of ``state`` at ``step``. Blocks only
+        while two snapshots are already outstanding (one writing, one
+        queued) — the slot is reserved BEFORE the snapshot is built, so
+        the two-snapshot memory bound holds. Background failures
+        surface on the NEXT save/wait/close."""
+        self._raise_pending()
+        self._slots.acquire()
+        try:
+            snap = self._snapshot(state)
+            m = dict(meta or {})
+            if milestone:
+                m["milestone"] = True
+            self._q.put((int(step), snap, m))
+        except BaseException:
+            self._slots.release()
+            raise
+
+    def save_sync(self, step: int, state: Any, *,
+                  meta: Optional[dict] = None,
+                  milestone: bool = False) -> str:
+        """Synchronous save (the preemption-grace path): returns the
+        committed checkpoint dir."""
+        self.save(step, state, meta=meta, milestone=milestone)
+        self.wait()
+        return os.path.join(self.directory, step_dir_name(step))
+
+    def wait(self) -> None:
+        """Block until every queued save committed (or failed)."""
+        self._q.join()
+        self._raise_pending()
+
+    def _raise_pending(self):
+        with self._lock:
+            if self._errors:
+                err = self._errors[:]
+                self._errors.clear()
+                raise CheckpointError(
+                    self.directory,
+                    f"background save failed: {err[0]!r}") from err[0]
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            step, snap, meta = item
+            try:
+                self._write_one(step, snap, meta)
+            except BaseException as e:
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                del item, snap
+                self._slots.release()
+                self._q.task_done()
+
+    def _write_one(self, step: int, snap, meta: dict):
+        import jax
+
+        host = jax.device_get(snap)
+        host = jax.tree_util.tree_map(np.asarray, host)
+        final = os.path.join(self.directory, step_dir_name(step))
+        tmp = f"{final}.tmp-{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            save_checkpoint(os.path.join(tmp, _STATE_SUBDIR), host)
+            write_manifest(tmp, step=step, tree=tree_entries(host),
+                           fingerprint=self.fingerprint, meta=meta)
+            # re-save of an existing step: move the old dir aside
+            # before the commit rename so there is no instant with
+            # zero committed copies of this step, then drop it
+            old = None
+            if os.path.exists(final):
+                old = f"{final}.old-{os.getpid()}"
+                shutil.rmtree(old, ignore_errors=True)
+                os.rename(final, old)
+            os.rename(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._promote_latest(step)
+        self._gc()
+
+    def _promote_latest(self, step: int):
+        atomic_write_text(os.path.join(self.directory, _LATEST),
+                          step_dir_name(step) + "\n")
+
+    def _gc(self):
+        dirs = _list_step_dirs(self.directory)
+        if len(dirs) <= self.keep:
+            return
+        for _step, path in dirs[:-self.keep]:
+            try:
+                if read_manifest(path).meta.get("milestone"):
+                    continue            # pinned outside the ring
+            except IntegrityError:
+                pass                    # corrupt/uncommitted: collectable
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- restore path ------------------------------------------------------
+
+    def latest_valid(self) -> Optional[str]:
+        return find_restorable(self.directory)
+
+    def restore(self, template: Any, *, path: Optional[str] = None,
+                expect_fingerprint: Optional[int] = None,
+                allow_fingerprint_mismatch: bool = False
+                ) -> Tuple[Any, Manifest]:
+        """Restore the newest valid checkpoint (or ``path``): verify the
+        file manifest, restore, verify the restored LEAVES against the
+        recorded digests, enforce the program fingerprint. Returns
+        ``(state, manifest)`` — ``manifest.step`` / ``manifest.meta``
+        carry the resume position."""
+        if path is None:
+            path = self.latest_valid()
+            if path is None:
+                raise CheckpointError(self.directory,
+                                      "no valid checkpoint to restore")
+        manifest = verify_files(path)
+        want_fp = (expect_fingerprint if expect_fingerprint is not None
+                   else self.fingerprint)
+        if (want_fp is not None and manifest.fingerprint is not None
+                and not allow_fingerprint_mismatch
+                and int(manifest.fingerprint, 16) != int(want_fp)):
+            raise CheckpointError(
+                path, f"program fingerprint mismatch: checkpoint "
+                f"{manifest.fingerprint}, current {int(want_fp):#x} — "
+                "the program changed since this checkpoint was written; "
+                "pass allow_fingerprint_mismatch=True to resume anyway")
+        state = restore_checkpoint(os.path.join(path, _STATE_SUBDIR),
+                                   template=template)
+        verify_tree(path, state, manifest)
+        return state, manifest
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self.wait()
+        self._q.put(None)
+        self._worker.join(timeout=60.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,239 @@
+"""Per-checkpoint integrity manifest — the thing that turns "orbax
+didn't crash" into "this checkpoint is the one we wrote".
+
+Two layers of evidence, both in one ``manifest.json`` next to the
+checkpoint payload:
+
+- **file digests** — relative path, byte size, sha256 of every file the
+  backend wrote. Cheap to re-verify WITHOUT restoring (a directory walk),
+  which is what lets `find_restorable` scan backward past truncated /
+  bit-flipped checkpoints instead of dying inside tensorstore.
+- **leaf digests** — tree structure (key paths), shape, dtype, sha256 of
+  each leaf's host bytes at save time. Re-checked after restore, so a
+  wrong-but-readable restore (stale file swapped in, dtype drift) is a
+  typed error, never silently wrong params.
+
+The manifest also round-trips the resume tuple's scalar half: ``step``,
+the program/config ``fingerprint`` (`utils.debug.program_fingerprint` —
+resume onto a CHANGED program is refused, not silent), and a free-form
+JSON ``meta`` dict (data-iterator position, PRNG seed, loss-scale
+summary — whatever the training loop needs to continue exactly).
+
+The manifest file itself is written temp-file + ``os.replace`` and is
+the COMMIT MARKER: no manifest ⇒ the checkpoint never finished.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+MANIFEST_NAME = "manifest.json"
+_FORMAT = "apex1-resilient-ckpt-v1"
+
+
+def atomic_write_text(path: str | os.PathLike, text: str) -> None:
+    """Temp file + flush + fsync + ``os.replace`` — the ONE
+    torn-write-proof file commit for the resilience layer (manifests,
+    the ``latest`` pointer, diagnostic records). A crash at any point
+    leaves either the old file or the new one, never a truncated mix.
+    (`bench._emit` keeps its own inline copy: its fallback path must
+    not depend on importing this package.)"""
+    path = os.fspath(path)
+    tmp = os.path.join(os.path.dirname(path),
+                       f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str | os.PathLike, doc: Any) -> None:
+    atomic_write_text(path, json.dumps(doc, indent=1, sort_keys=True))
+
+
+class IntegrityError(RuntimeError):
+    """Manifest mismatch: the checkpoint's content does not match what
+    was recorded at save time (corruption, truncation, wrong restore)."""
+
+    def __init__(self, path: str | os.PathLike, reason: str):
+        self.path = os.fspath(path)
+        self.reason = reason
+        super().__init__(f"integrity check failed at {self.path}: {reason}")
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _leaf_digest(x: np.ndarray) -> str:
+    """sha256 over the C-contiguous little-endian bytes of ``x`` —
+    layout-independent so a restore onto a different sharding/mesh still
+    matches."""
+    a = np.ascontiguousarray(x)
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("<"))
+    return hashlib.sha256(a.tobytes()).hexdigest()
+
+
+def _host_leaves(tree: Any):
+    """[(keypath-str, numpy array)] for every leaf, via jax tree paths.
+    jax PRNG key arrays are digested over their key DATA (uint32)."""
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "dtype") and jax.dtypes.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key):
+            leaf = jax.random.key_data(leaf)
+        out.append((jax.tree_util.keystr(path), np.asarray(leaf)))
+    return out
+
+
+@dataclasses.dataclass
+class Manifest:
+    """Parsed manifest — `write_manifest`/`read_manifest` round-trip."""
+
+    step: int
+    fingerprint: Optional[str]          # hex string or None
+    meta: dict                          # resume extras (JSON-safe)
+    tree: list                          # [{path, shape, dtype, sha256}]
+    files: list                         # [{path, bytes, sha256}]
+
+    def to_json(self) -> dict:
+        return {"format": _FORMAT, "step": self.step,
+                "fingerprint": self.fingerprint, "meta": self.meta,
+                "tree": self.tree, "files": self.files}
+
+
+def tree_entries(state: Any) -> list:
+    """Per-leaf manifest entries from a (host or device) pytree."""
+    return [{"path": p, "shape": list(a.shape), "dtype": str(a.dtype),
+             "sha256": _leaf_digest(a)}
+            for p, a in _host_leaves(state)]
+
+
+def _walk_files(ckpt_dir: str) -> list:
+    out = []
+    for root, _dirs, files in os.walk(ckpt_dir):
+        for name in sorted(files):
+            if name == MANIFEST_NAME:
+                continue
+            full = os.path.join(root, name)
+            out.append(os.path.relpath(full, ckpt_dir))
+    return sorted(out)
+
+
+def write_manifest(ckpt_dir: str | os.PathLike, *, step: int,
+                   state: Any = None, tree: Optional[list] = None,
+                   fingerprint: Optional[int] = None,
+                   meta: Optional[dict] = None) -> Manifest:
+    """Digest every payload file under ``ckpt_dir`` (+ the leaf digests
+    of ``state``, or precomputed ``tree`` entries) and atomically write
+    ``manifest.json``. Call AFTER the backend finished writing."""
+    ckpt_dir = os.fspath(ckpt_dir)
+    if tree is None:
+        tree = tree_entries(state) if state is not None else []
+    files = []
+    for rel in _walk_files(ckpt_dir):
+        full = os.path.join(ckpt_dir, rel)
+        files.append({"path": rel, "bytes": os.path.getsize(full),
+                      "sha256": _sha256_file(full)})
+    m = Manifest(step=int(step),
+                 fingerprint=(None if fingerprint is None
+                              else f"{int(fingerprint):#x}"),
+                 meta=dict(meta or {}), tree=tree, files=files)
+    atomic_write_json(os.path.join(ckpt_dir, MANIFEST_NAME), m.to_json())
+    return m
+
+
+def read_manifest(ckpt_dir: str | os.PathLike) -> Manifest:
+    """Parse ``manifest.json``; raises `IntegrityError` when missing or
+    unparseable (no manifest ⇒ the save never committed)."""
+    ckpt_dir = os.fspath(ckpt_dir)
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise IntegrityError(ckpt_dir, f"manifest missing ({e})") from e
+    except json.JSONDecodeError as e:
+        raise IntegrityError(ckpt_dir, f"manifest unparseable ({e})") from e
+    if doc.get("format") != _FORMAT:
+        raise IntegrityError(
+            ckpt_dir, f"unknown manifest format {doc.get('format')!r}")
+    try:
+        return Manifest(step=int(doc["step"]),
+                        fingerprint=doc.get("fingerprint"),
+                        meta=doc.get("meta", {}), tree=doc["tree"],
+                        files=doc["files"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise IntegrityError(ckpt_dir, f"manifest malformed ({e})") from e
+
+
+def verify_files(ckpt_dir: str | os.PathLike,
+                 manifest: Optional[Manifest] = None) -> Manifest:
+    """Re-digest the payload files against the manifest. Catches
+    truncation (size mismatch / missing file) and bit flips (sha256)
+    without restoring. Returns the manifest on success."""
+    ckpt_dir = os.fspath(ckpt_dir)
+    m = manifest if manifest is not None else read_manifest(ckpt_dir)
+    recorded = {e["path"]: e for e in m.files}
+    on_disk = set(_walk_files(ckpt_dir))
+    missing = set(recorded) - on_disk
+    if missing:
+        raise IntegrityError(ckpt_dir,
+                             f"missing files: {sorted(missing)[:4]}")
+    extra = on_disk - set(recorded)
+    if extra:
+        # extra payload files mean the dir is not the one we digested
+        raise IntegrityError(ckpt_dir,
+                             f"unrecorded files: {sorted(extra)[:4]}")
+    for rel, e in recorded.items():
+        full = os.path.join(ckpt_dir, rel)
+        size = os.path.getsize(full)
+        if size != e["bytes"]:
+            raise IntegrityError(
+                ckpt_dir, f"{rel}: {size} bytes, manifest says "
+                f"{e['bytes']} (truncated?)")
+        got = _sha256_file(full)
+        if got != e["sha256"]:
+            raise IntegrityError(
+                ckpt_dir, f"{rel}: content digest mismatch (bit flip?)")
+    return m
+
+
+def verify_tree(ckpt_dir: str | os.PathLike, state: Any,
+                manifest: Optional[Manifest] = None) -> None:
+    """Verify a RESTORED pytree against the manifest's leaf digests:
+    structure, shapes, dtypes, content. A mismatch is a typed error —
+    never a silent wrong restore."""
+    ckpt_dir = os.fspath(ckpt_dir)
+    m = manifest if manifest is not None else read_manifest(ckpt_dir)
+    got = {e["path"]: e for e in tree_entries(state)}
+    want = {e["path"]: e for e in m.tree}
+    if set(got) != set(want):
+        raise IntegrityError(
+            ckpt_dir, "tree structure mismatch: "
+            f"missing {sorted(set(want) - set(got))[:4]}, "
+            f"unexpected {sorted(set(got) - set(want))[:4]}")
+    for p, w in want.items():
+        g = got[p]
+        for field in ("shape", "dtype", "sha256"):
+            if g[field] != w[field]:
+                raise IntegrityError(
+                    ckpt_dir, f"leaf {p}: {field} mismatch "
+                    f"({g[field]!r} != recorded {w[field]!r})")

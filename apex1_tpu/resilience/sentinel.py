@@ -1,0 +1,286 @@
+"""Divergence sentinel — a finite/divergence guard for ALL dtypes.
+
+The fp16 path already skips non-finite-grad steps inside
+`core.loss_scale` (device-side ``all_finite`` + ``select_tree``), but
+bf16/fp32 runs train unguarded: a NaN loss at step k silently poisons
+every parameter after it, and the failure is discovered hours later in
+a loss curve. The sentinel closes that hole with a three-rung
+escalation ladder:
+
+1. **skip-step** (device side, every step, free): `guard_train_step`
+   wraps any ``(state, *batch) -> (state, metrics)`` train step. It
+   derives a fused health flag from the step's own metrics
+   (``isfinite(loss) & isfinite(grad_norm) [& grads_finite]
+   [& grad_norm < threshold]``) and keeps the OLD params/opt state on an
+   unhealthy step via `core.loss_scale.select_tree` — the same where-keep
+   machinery as the fp16 overflow skip, so no host sync is introduced:
+   the flag is a carried `SentinelState` scalar, and the wrapped step's
+   jaxpr contains no callbacks (pinned by test + graftlint).
+2. **rollback** (host side, every ``check_every`` steps): `Sentinel.poll`
+   reads the carried counters — the only device sync, amortized over N
+   steps — and once ``consecutive_bad >= rollback_after`` (default 2)
+   directs the loop to restore the last-good checkpoint via the
+   `ResilientCheckpointer` and re-fold its PRNG stream (`refold_key` /
+   `refold_seed`) so the retried trajectory doesn't replay the exact
+   batch/noise sequence that diverged.
+3. **abort** (host side): ``consecutive_bad >= abort_after``, or the
+   rollback budget exhausted, or no valid checkpoint to roll back to —
+   a `DivergenceError` carrying the banked diagnostic record (JSON on
+   disk: step, counters, last loss/grad-norm) instead of a mystery hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+
+from apex1_tpu.core.loss_scale import select_tree
+from apex1_tpu.resilience.manifest import atomic_write_json
+from apex1_tpu.resilience.retry import _mix32
+
+
+@chex.dataclass(frozen=True)
+class SentinelState:
+    """Device-carried counters (a pytree — checkpoint it with the rest
+    of the train state so resume keeps the escalation context)."""
+
+    steps_seen: jnp.ndarray       # i32: wrapped steps executed
+    consecutive_bad: jnp.ndarray  # i32: current unhealthy streak
+    total_bad: jnp.ndarray        # i32: lifetime unhealthy steps
+    last_bad_step: jnp.ndarray    # i32: steps_seen index, -1 = never
+    last_loss: jnp.ndarray        # f32: most recent loss (diagnostics)
+    last_grad_norm: jnp.ndarray   # f32
+
+
+def sentinel_init() -> SentinelState:
+    return SentinelState(steps_seen=jnp.int32(0),
+                         consecutive_bad=jnp.int32(0),
+                         total_bad=jnp.int32(0),
+                         last_bad_step=jnp.int32(-1),
+                         last_loss=jnp.float32(0.0),
+                         last_grad_norm=jnp.float32(0.0))
+
+
+def health_flag(metrics: dict, *, gnorm_threshold: Optional[float] = None,
+                axis_names: Tuple[str, ...] = ()) -> jnp.ndarray:
+    """Fused scalar health predicate from a train step's metrics dict:
+    loss/grad_norm finite, ``grads_finite`` honored when present, and an
+    optional hard grad-norm ceiling (divergence is not only NaN). Under
+    ``shard_map`` pass ``axis_names`` so ranks agree (pmin)."""
+    flags = []
+    for key in ("loss", "grad_norm"):
+        if key in metrics:
+            v = jnp.asarray(metrics[key])
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                flags.append(jnp.all(jnp.isfinite(v)))
+    if "grads_finite" in metrics:
+        flags.append(jnp.asarray(metrics["grads_finite"]))
+    if gnorm_threshold is not None and "grad_norm" in metrics:
+        flags.append(jnp.asarray(metrics["grad_norm"])
+                     < jnp.float32(gnorm_threshold))
+    if not flags:
+        healthy = jnp.bool_(True)
+    else:
+        healthy = flags[0]
+        for f in flags[1:]:
+            healthy = jnp.logical_and(healthy, f)
+    for ax in axis_names:
+        healthy = jax.lax.pmin(healthy.astype(jnp.int32),
+                               ax).astype(jnp.bool_)
+    return healthy
+
+
+def guard_train_step(train_step: Callable, *,
+                     gnorm_threshold: Optional[float] = None,
+                     axis_names: Tuple[str, ...] = ()) -> Callable:
+    """Wrap ``train_step(state, *batch) -> (new_state, metrics)`` into
+    ``guarded((state, sentinel_state), *batch) -> ((state', sentinel'),
+    metrics)``. Unhealthy steps keep the old state (a ``step`` field, if
+    the state has one, still advances — matching the fp16 overflow-skip
+    contract so data progress is not replayed). Pure and host-sync-free:
+    wrap the RESULT in ``jax.jit``/``shard_map``."""
+
+    # graftlint: hot -- returned for the caller to jax.jit (same
+    # closure-return edge as amp.make_train_step)
+    def guarded(carry, *batch):
+        state, s = carry
+        new_state, metrics = train_step(state, *batch)
+        healthy = health_flag(metrics, gnorm_threshold=gnorm_threshold,
+                              axis_names=axis_names)
+        kept = select_tree(healthy, new_state, state)
+        if dataclasses.is_dataclass(kept) and hasattr(kept, "step"):
+            kept = dataclasses.replace(kept, step=new_state.step)
+        bad = jnp.logical_not(healthy)
+        loss = jnp.asarray(metrics.get("loss", jnp.float32(0.0)))
+        gnorm = jnp.asarray(metrics.get("grad_norm", jnp.float32(0.0)))
+        new_s = SentinelState(
+            steps_seen=s.steps_seen + 1,
+            consecutive_bad=jnp.where(bad, s.consecutive_bad + 1,
+                                      0).astype(jnp.int32),
+            total_bad=(s.total_bad + bad.astype(jnp.int32)),
+            last_bad_step=jnp.where(bad, s.steps_seen,
+                                    s.last_bad_step).astype(jnp.int32),
+            last_loss=loss.astype(jnp.float32),
+            last_grad_norm=gnorm.astype(jnp.float32))
+        metrics = dict(metrics)
+        metrics["sentinel_healthy"] = healthy
+        return (kept, new_s), metrics
+
+    return guarded
+
+
+def refold_key(key, attempt: int):
+    """Re-fold a jax PRNG key for a post-rollback retry: attempt 1, 2, …
+    draw distinct streams, so the retry does not replay the exact
+    stochastic trajectory that diverged."""
+    return jax.random.fold_in(key, jnp.uint32(0x5EED0000 + int(attempt)))
+
+
+def refold_seed(seed: int, attempt: int) -> int:
+    """Integer-seed (counter-based kernels, `ops.stochastic`) analog of
+    `refold_key` — deterministic avalanche of (seed, attempt)."""
+    return _mix32(int(seed) ^ _mix32(0x5EED0000 + int(attempt)))
+
+
+class DivergenceError(RuntimeError):
+    """Escalation exhausted; ``record`` is the banked diagnostic."""
+
+    def __init__(self, msg: str, record: dict):
+        super().__init__(msg)
+        self.record = record
+
+
+class Sentinel:
+    """Host-side escalation policy around the device-carried counters.
+
+    Typical loop::
+
+        sent = Sentinel(ckptr, check_every=10)
+        guarded = jax.jit(sent.guard(amp.make_train_step(loss_fn)))
+        carry = (state, sentinel_init())
+        while step < total:
+            carry, metrics = guarded(carry, batch_at(step))
+            action = sent.poll(carry[1])          # syncs every Nth call
+            if action == "rollback":
+                state, manifest, s0 = sent.rollback(template=carry[0])
+                step = manifest.step              # rewind data position
+                carry = (state, s0)               # + refold_key(...)
+                continue
+            step += 1
+
+    ``poll`` raises `DivergenceError` on the abort rung; every rollback
+    and abort banks a JSON diagnostic record under ``diagnostics_dir``
+    (default ``<checkpoint dir>/diagnostics``).
+    """
+
+    def __init__(self, checkpointer=None, *, check_every: int = 10,
+                 rollback_after: int = 2, abort_after: int = 4,
+                 max_rollbacks: int = 2,
+                 gnorm_threshold: Optional[float] = None,
+                 diagnostics_dir: Optional[str] = None):
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        if not 1 <= rollback_after <= abort_after:
+            raise ValueError("need 1 <= rollback_after <= abort_after")
+        self.checkpointer = checkpointer
+        self.check_every = int(check_every)
+        self.rollback_after = int(rollback_after)
+        self.abort_after = int(abort_after)
+        self.max_rollbacks = int(max_rollbacks)
+        self.gnorm_threshold = gnorm_threshold
+        self.diagnostics_dir = diagnostics_dir
+        self.records: list[dict] = []   # banked this process, in order
+        self.rollbacks_done = 0
+        self._polls = 0
+
+    def guard(self, train_step: Callable,
+              axis_names: Tuple[str, ...] = ()) -> Callable:
+        return guard_train_step(train_step,
+                                gnorm_threshold=self.gnorm_threshold,
+                                axis_names=axis_names)
+
+    def init_state(self) -> SentinelState:
+        return sentinel_init()
+
+    # -- host control plane (cold code: the int() casts below are the
+    # amortized every-Nth-step sync, never inside a traced program) -----
+
+    def _diagnostic(self, s: SentinelState, action: str) -> dict:
+        return {"action": action,
+                "time": time.time(),
+                "steps_seen": int(s.steps_seen),
+                "consecutive_bad": int(s.consecutive_bad),
+                "total_bad": int(s.total_bad),
+                "last_bad_step": int(s.last_bad_step),
+                "last_loss": float(s.last_loss),
+                "last_grad_norm": float(s.last_grad_norm),
+                "rollbacks_done": self.rollbacks_done}
+
+    def _bank_dir(self) -> Optional[str]:
+        """Resolved lazily, not at __init__: the checkpointer may be
+        attached after construction (fingerprint chicken-and-egg in
+        training loops — see examples/gpt2_amp.py)."""
+        if self.diagnostics_dir is not None:
+            return self.diagnostics_dir
+        if self.checkpointer is not None:
+            return os.path.join(self.checkpointer.directory,
+                                "diagnostics")
+        return None
+
+    def _bank(self, record: dict) -> dict:
+        self.records.append(record)
+        ddir = self._bank_dir()
+        if ddir:
+            os.makedirs(ddir, exist_ok=True)
+            name = (f"divergence_{len(self.records):04d}_"
+                    f"{record['action']}.json")
+            atomic_write_json(os.path.join(ddir, name), record)
+            record["path"] = os.path.join(ddir, name)
+        return record
+
+    def poll(self, s: SentinelState, *, force: bool = False
+             ) -> Optional[str]:
+        """Check the carried counters every ``check_every``-th call (one
+        device sync). Returns None (healthy / not checked), ``"skip"``
+        (bad steps were skipped device-side, below the rollback rung),
+        or ``"rollback"``; raises `DivergenceError` on the abort rung."""
+        self._polls += 1
+        if not force and self._polls % self.check_every:
+            return None
+        consecutive = int(s.consecutive_bad)
+        if consecutive == 0:
+            return None
+        can_rollback = (self.checkpointer is not None
+                        and self.rollbacks_done < self.max_rollbacks
+                        and self.checkpointer.latest_valid() is not None)
+        if consecutive >= self.abort_after or (
+                consecutive >= self.rollback_after and not can_rollback):
+            record = self._bank(self._diagnostic(s, "abort"))
+            raise DivergenceError(
+                f"diverged: {consecutive} consecutive unhealthy steps "
+                f"(total {int(s.total_bad)}), escalation exhausted — "
+                f"diagnostic banked at {record.get('path', '<memory>')}",
+                record)
+        if consecutive >= self.rollback_after:
+            self._bank(self._diagnostic(s, "rollback"))
+            return "rollback"
+        self._bank(self._diagnostic(s, "skip"))
+        return "skip"
+
+    def rollback(self, template: Any):
+        """Restore the last-good checkpoint. Returns ``(state, manifest,
+        fresh_sentinel_state)``; the caller rewinds its data position to
+        ``manifest.step`` and re-folds its PRNG with `refold_key(key,
+        sentinel.rollbacks_done)`."""
+        if self.checkpointer is None:
+            raise DivergenceError("rollback requested without a "
+                                  "checkpointer", {})
+        state, manifest = self.checkpointer.restore(template)
+        self.rollbacks_done += 1
+        return state, manifest, sentinel_init()

@@ -629,13 +629,21 @@ class RequestFeeder:
     raw prompts from ``source`` (an iterable of anything — text lines,
     token lists), tokenizes them OFF the engine's critical path, and
     pushes them through ``submit`` (the engine/scheduler entry point),
-    absorbing `Backpressure` with bounded retry instead of dropping —
-    the host-side half of continuous batching (the device never waits
-    on tokenization; the queue never overflows silently).
+    absorbing `Backpressure` with the scheduler docstring's promised
+    429/retry contract: BOUNDED EXPONENTIAL BACKOFF with deterministic
+    jitter (``resilience.retry.backoff_delays`` — base ``retry_wait_s``,
+    doubling, capped at ``retry_cap_s``, jittered so a burst of rejected
+    feeders doesn't re-slam the queue in lockstep) and a
+    drop-after-deadline rule: once an item has spent ``deadline_s``
+    total in retries it is shed (``dropped``), because an overloaded
+    engine must shed load, not stretch tail latency unboundedly.
 
     ``tokenize(item) -> (tokens, kwargs)`` where kwargs go straight to
     ``submit(tokens, **kwargs)`` (``max_new_tokens`` etc.). Rejections
-    that outlive ``retries`` land in ``dropped`` with the reason.
+    that outlive ``retries``/``deadline_s`` land in ``dropped`` with the
+    reason. ``counters`` tracks the aggregate: ``submitted``,
+    ``retries`` (backoff sleeps taken), ``dropped_backpressure``,
+    ``dropped_error`` — the feed-side metrics record.
 
     The worker only SUBMITS; stepping the engine stays with the caller
     (the engine is not thread-safe by design — one loop owns the
@@ -650,15 +658,24 @@ class RequestFeeder:
 
     def __init__(self, source: Iterable, tokenize: Callable,
                  submit: Callable, *, retries: int = 100,
-                 retry_wait_s: float = 0.005):
+                 retry_wait_s: float = 0.005,
+                 retry_cap_s: float = 0.25,
+                 deadline_s: Optional[float] = None,
+                 jitter: float = 0.5, seed: int = 0):
         self.source = source
         self.tokenize = tokenize
         self.submit = submit
         self.retries = int(retries)
         self.retry_wait_s = float(retry_wait_s)
+        self.retry_cap_s = float(retry_cap_s)
+        self.deadline_s = deadline_s
+        self.jitter = float(jitter)
+        self.seed = int(seed)
         self.submitted: list = []
         self.dropped: list = []          # (item, reason)
         self.errors: list = []
+        self.counters = {"submitted": 0, "retries": 0,
+                         "dropped_backpressure": 0, "dropped_error": 0}
         self._thread: Optional[threading.Thread] = None
         self._done = threading.Event()
 
@@ -668,12 +685,13 @@ class RequestFeeder:
         return self._done.is_set()
 
     def start(self) -> "RequestFeeder":
+        from apex1_tpu.resilience.retry import backoff_delays
         from apex1_tpu.serving.scheduler import (Backpressure,
                                                  new_request_id)
 
         def work():
             try:
-                for item in self.source:
+                for n_item, item in enumerate(self.source):
                     # a PER-ITEM failure (tokenizer bug, contract
                     # ValueError from submit) drops THAT item and keeps
                     # feeding — one malformed request must not silently
@@ -682,6 +700,7 @@ class RequestFeeder:
                         tokens, kw = self.tokenize(item)
                     except Exception as e:
                         self.dropped.append((item, f"tokenize: {e!r}"))
+                        self.counters["dropped_error"] += 1
                         self.errors.append(e)
                         continue
                     # one id across every retry attempt: transient
@@ -689,18 +708,37 @@ class RequestFeeder:
                     # record instead of minting a phantom rejected
                     # record per attempt (review finding)
                     kw.setdefault("req_id", new_request_id())
-                    for attempt in range(self.retries + 1):
+                    delays = backoff_delays(
+                        self.retries, base_s=self.retry_wait_s,
+                        cap_s=self.retry_cap_s, jitter=self.jitter,
+                        seed=self.seed ^ n_item)
+                    t0 = _time.monotonic()
+                    while True:
                         try:
                             self.submitted.append(
                                 self.submit(tokens, **kw))
+                            self.counters["submitted"] += 1
                             break
                         except Backpressure as e:
-                            if attempt == self.retries:
-                                self.dropped.append((item, e.reason))
+                            d = next(delays, None)
+                            waited = _time.monotonic() - t0
+                            if d is None:
+                                reason = f"{e.reason} (retries exhausted)"
+                            elif (self.deadline_s is not None
+                                  and waited + d > self.deadline_s):
+                                reason = (f"{e.reason} (deadline "
+                                          f"{self.deadline_s}s after "
+                                          f"{waited:.3f}s)")
                             else:
-                                _time.sleep(self.retry_wait_s)
+                                self.counters["retries"] += 1
+                                _time.sleep(d)
+                                continue
+                            self.dropped.append((item, reason))
+                            self.counters["dropped_backpressure"] += 1
+                            break
                         except Exception as e:
                             self.dropped.append((item, repr(e)))
+                            self.counters["dropped_error"] += 1
                             self.errors.append(e)
                             break
             except BaseException as e:   # source iteration died —

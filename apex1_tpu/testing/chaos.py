@@ -1,0 +1,248 @@
+"""Deterministic chaos-injection harness — every recovery path in
+`apex1_tpu.resilience` is EXERCISED in tier-1 on CPU, not just trusted
+on silicon.
+
+All injection is seed-keyed and pure-function-of-its-inputs: two runs
+with the same seed inject the same faults at the same steps, which is
+what makes "SIGTERM mid-run, resume, bit-identical to uninterrupted"
+an assertable property instead of a flaky one.
+
+Fault classes (one helper per class, composable):
+
+- **NaN/Inf poisoning** (`poison_at_steps`, traced): multiply a loss /
+  grad tree by a factor that is NaN exactly at the listed steps —
+  drives the sentinel's skip/rollback/abort ladder from inside jit.
+- **checkpoint corruption** (`truncate_checkpoint`,
+  `bitflip_checkpoint`, host): deterministic file pick + deterministic
+  byte, so `find_restorable`'s backward scan is tested against real
+  on-disk damage.
+- **simulated preemption** (`sigterm_self_at`, host): SIGTERM delivered
+  to the current process at a step boundary, exercising
+  `PreemptionHandler` + the resumable-exit contract.
+- **transient backend errors** (`Flaky`): a callable that raises
+  `resilience.TransientError` for its first N calls — verifies
+  retry/backoff policies actually retry, back off, and give up on
+  schedule.
+
+``python -m apex1_tpu.testing.chaos --smoke`` runs the two headline
+recoveries end-to-end (injected-NaN rollback + corrupt-checkpoint
+fallback scan) in <30 s on CPU — the ``== chaos smoke ==`` step in
+``tools/check_all.sh``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from apex1_tpu.resilience.manifest import read_manifest
+from apex1_tpu.resilience.retry import TransientError, _mix32
+
+__all__ = [
+    "poison_at_steps", "poison_tree_at_steps", "truncate_checkpoint",
+    "bitflip_checkpoint", "sigterm_self_at", "Flaky", "TransientError",
+]
+
+
+# -- traced-side injection --------------------------------------------------
+
+def poison_at_steps(value, step, steps: Sequence[int], *,
+                    poison: float = float("nan")):
+    """Return ``value`` except at the listed ``steps``, where every
+    element becomes ``poison`` (NaN default, pass ``float('inf')`` for
+    Inf). ``step`` may be traced (the train state's step counter);
+    ``steps`` is static. Identity (and jit-cache-identical) when
+    ``steps`` is empty."""
+    import jax.numpy as jnp
+
+    if not len(steps):
+        return value
+    v = jnp.asarray(value)
+    hits = jnp.asarray(list(steps), jnp.int32)
+    hit = jnp.any(hits == jnp.asarray(step, jnp.int32))
+    bad = jnp.asarray(poison, v.dtype)
+    return jnp.where(hit, jnp.full_like(v, bad), v)
+
+
+def poison_tree_at_steps(tree, step, steps: Sequence[int], *,
+                         poison: float = float("nan")):
+    """`poison_at_steps` over every floating leaf of a pytree (poisoned
+    grads, not just a poisoned loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        return poison_at_steps(x, step, steps, poison=poison)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+# -- on-disk corruption -----------------------------------------------------
+
+def _pick_payload_file(ckpt_dir: str, seed: int) -> str:
+    """Deterministic payload-file pick from the checkpoint's own
+    manifest: the largest file (ties broken by path), rotated by seed —
+    corruption always lands on bytes the integrity manifest covers."""
+    m = read_manifest(ckpt_dir)
+    files = sorted(m.files, key=lambda e: (-e["bytes"], e["path"]))
+    if not files:
+        raise ValueError(f"{ckpt_dir}: no payload files to corrupt")
+    biggest = [e for e in files if e["bytes"] == files[0]["bytes"]]
+    pick = biggest[_mix32(seed) % len(biggest)]
+    return os.path.join(ckpt_dir, pick["path"])
+
+
+def truncate_checkpoint(ckpt_dir: str | os.PathLike, *, seed: int = 0,
+                        keep_fraction: float = 0.5) -> str:
+    """Truncate a manifest-covered payload file to ``keep_fraction`` of
+    its size (a killed writer / torn copy). Returns the damaged path."""
+    path = _pick_payload_file(os.fspath(ckpt_dir), seed)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(int(size * keep_fraction))
+    return path
+
+
+def bitflip_checkpoint(ckpt_dir: str | os.PathLike, *, seed: int = 0
+                       ) -> str:
+    """XOR one deterministic byte of a payload file (cosmic-ray /
+    bit-rot model). File size is unchanged — only the content digest can
+    catch this. Returns the damaged path."""
+    path = _pick_payload_file(os.fspath(ckpt_dir), seed)
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"{path}: empty file, nothing to flip")
+    off = _mix32(seed ^ 0xB17F11B) % size
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+# -- preemption + transient faults ------------------------------------------
+
+def sigterm_self_at(step: int, at_step: Optional[int],
+                    *, signum: int = signal.SIGTERM) -> bool:
+    """Deliver ``signum`` to THIS process when ``step == at_step`` (the
+    simulated mid-run preemption). Returns True when fired. A no-op
+    (False) when ``at_step`` is None — training loops can leave the call
+    in place, keyed off an env var the chaos test sets."""
+    if at_step is None or int(step) != int(at_step):
+        return False
+    os.kill(os.getpid(), signum)
+    return True
+
+
+class Flaky:
+    """Wrap ``fn`` to raise `TransientError` on its first ``fails``
+    calls, then pass through — the backend-unreachable model. The call
+    log (`attempts`, `failures`) is what retry/backoff tests assert."""
+
+    def __init__(self, fn: Callable, *, fails: int = 2,
+                 exc: type = TransientError):
+        self.fn = fn
+        self.fails = int(fails)
+        self.exc = exc
+        self.attempts = 0
+        self.failures = 0
+
+    def __call__(self, *args, **kwargs):
+        self.attempts += 1
+        if self.attempts <= self.fails:
+            self.failures += 1
+            raise self.exc(
+                f"injected transient failure {self.failures}/{self.fails}")
+        return self.fn(*args, **kwargs)
+
+
+# -- smoke entry point (check_all.sh `== chaos smoke ==`) -------------------
+
+def _smoke() -> int:
+    """Two headline recoveries, tiny shapes, CPU, <30 s:
+    (1) injected-NaN grads → device-side skip → second hit → rollback to
+    last-good with a banked diagnostic; (2) newest checkpoint truncated
+    AND the one before bit-flipped → `find_restorable` selects the older
+    valid one and restore round-trips."""
+    import tempfile
+
+    from apex1_tpu.testing import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(1)
+    import jax
+    import jax.numpy as jnp
+
+    from apex1_tpu.amp import Amp
+    from apex1_tpu.optim.fused_sgd import fused_sgd
+    from apex1_tpu.resilience import (ResilientCheckpointer, Sentinel,
+                                      find_restorable, sentinel_init)
+
+    amp = Amp(tx=fused_sgd(0.1), opt_level="O0")
+    state = amp.init({"w": jnp.ones((8,), jnp.float32)})
+
+    def loss_fn(p, x, step):
+        loss = jnp.sum(jnp.square(p["w"])) * x
+        return poison_at_steps(loss, step, (3, 4))
+
+    with tempfile.TemporaryDirectory() as d:
+        ck = ResilientCheckpointer(d, keep=4)
+        sent = Sentinel(ck, check_every=1, rollback_after=2)
+        guarded = jax.jit(sent.guard(amp.make_train_step(loss_fn)))
+        carry = (state, sentinel_init())
+        rolled_back = False
+        i = 0
+        while i < 6 and not rolled_back:
+            carry, _m = guarded(carry, jnp.float32(1.0),
+                                carry[0].step)
+            ck.save_sync(int(carry[0].step), carry[0],
+                         meta={"data_step": i + 1})
+            if sent.poll(carry[1]) == "rollback":
+                good, manifest, s0 = sent.rollback(template=carry[0])
+                carry = (good, s0)
+                rolled_back = True
+            i += 1
+        assert rolled_back, "NaN injection never escalated to rollback"
+        assert sent.records[-1]["action"] == "rollback"
+        assert np.isfinite(np.asarray(carry[0].params["w"])).all()
+        print(f"chaos smoke [1/2] OK: NaN@step3,4 -> skip -> rollback to "
+              f"step {manifest.step}, diagnostic banked "
+              f"({sent.records[-1].get('path', '<memory>')})")
+
+        # (2) damage the two newest checkpoints two different ways
+        dirs = sorted(p for p in os.listdir(d) if p.startswith("step_"))
+        assert len(dirs) >= 3
+        truncate_checkpoint(os.path.join(d, dirs[-1]))
+        bitflip_checkpoint(os.path.join(d, dirs[-2]))
+        best = find_restorable(d)
+        assert best is not None and os.path.basename(best) == dirs[-3], \
+            f"expected fallback to {dirs[-3]}, got {best}"
+        restored, man = ck.restore(template=carry[0], path=best)
+        assert int(man.step) == int(restored.step)
+        ck.close()
+        print(f"chaos smoke [2/2] OK: truncated {dirs[-1]} + bit-flipped "
+              f"{dirs[-2]} -> find_restorable fell back to {dirs[-3]}")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the two headline recovery paths (CPU, <30s)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return _smoke()
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

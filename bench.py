@@ -100,7 +100,8 @@ def _remove_stale_libtpu_lockfile(path="/tmp/libtpu_lockfile"):
 
 
 def timed_steps(train_step, state, batch, iters):
-    """(seconds/step, flops/step) with the loop in one dispatch.
+    """(seconds/step, flops/step, final metrics, final state) with the
+    loop in one dispatch.
 
     The many-step loop is AOT-lowered so ``cost_analysis`` can price one
     dispatch (→ MFU) without a second compile; the sync reduction covers
@@ -139,9 +140,10 @@ def timed_steps(train_step, state, batch, iters):
     loss = float(metrics["loss"])
     if not math.isfinite(loss):
         raise RuntimeError(f"benchmark loss is not finite: {loss}")
-    # final metrics ride along so configs can surface state evidence
-    # (fp16 O1: skipped_steps + final loss_scale in the record)
-    return dt / iters, flops_per_step, metrics
+    # final metrics + state ride along so configs can surface state
+    # evidence (fp16 O1: skipped_steps + final loss_scale) and bank a
+    # resume checkpoint of the trained state (--ckpt-dir)
+    return dt / iters, flops_per_step, metrics, state
 
 
 def _amp_state_step(model_loss_fn, params, lr=1e-4, opt_level="O2"):
@@ -446,9 +448,32 @@ BENCHES = {
 }
 
 
-def _emit(record):
-    """The ONE JSON line the driver parses — also on partial failure."""
+def _emit(record, out_path=None):
+    """The ONE JSON line the driver parses — also on partial failure.
+
+    ``out_path``: crash-safe partial banking for sweeps — the record is
+    ALSO written to this file via temp-file + atomic rename, so a sweep
+    killed between configs still banks every completed record (a
+    half-written JSON file can never exist at ``out_path``). Inline
+    copy of `resilience.manifest.atomic_write_text` on purpose: this
+    fallback path must not depend on importing the package it may be
+    reporting a failure of."""
     print(json.dumps(record), flush=True)
+    if not out_path:
+        return
+    try:
+        out_path = os.path.abspath(out_path)
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out_path)
+    except OSError as e:   # banking is best-effort; stdout already has it
+        print(f"WARNING: could not bank record to {out_path}: {e}",
+              file=sys.stderr, flush=True)
 
 
 # perf_results/ log names per config (tools/tpu_watch.sh queue names;
@@ -586,6 +611,39 @@ def _attach_roofline(record, config, results_dir=None):
     return record
 
 
+def _try_resume(ckpt_dir, template):
+    """--resume auto: restore the newest VALID checkpoint under
+    ``ckpt_dir`` (integrity-verified, scans past corrupt ones). Returns
+    ``(state, "step_N")`` or ``(template, None)`` when nothing usable is
+    banked — a bench must measure, not die, on a stale/foreign dir."""
+    try:
+        from apex1_tpu.resilience import ResilientCheckpointer
+
+        with ResilientCheckpointer(ckpt_dir) as ck:
+            state, man = ck.restore(template=template)
+        return state, f"step_{man.step}"
+    except Exception as e:
+        print(f"WARNING: --resume auto: no usable checkpoint under "
+              f"{ckpt_dir} ({e}); starting fresh", file=sys.stderr,
+              flush=True)
+        return template, None
+
+
+def _bank_ckpt(ckpt_dir, state, fallback_step):
+    """Bank the trained bench state (synchronously) so the next
+    ``--resume auto`` run continues from it."""
+    from apex1_tpu.resilience import ResilientCheckpointer
+
+    step_no = getattr(state, "step", None)
+    if step_no is None and isinstance(state, tuple) and state:
+        step_no = getattr(state[0], "step", None)
+    step_no = (int(np.asarray(step_no)) if step_no is not None
+               else int(fallback_step))
+    with ResilientCheckpointer(ckpt_dir) as ck:
+        ck.save_sync(step_no, state, meta={"source": "bench.py"})
+    return step_no
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="gpt2", choices=sorted(BENCHES))
@@ -597,6 +655,19 @@ def main():
                     help="watchdog for build+compile+measure (seconds)")
     ap.add_argument("--probe-timeout", type=float, default=180.0)
     ap.add_argument("--probe-retries", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="also bank the record to this file (temp-file + "
+                    "atomic rename): an interrupted sweep keeps every "
+                    "completed config's record")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="resilient checkpoint dir: the trained bench "
+                    "state is banked here after measuring, and --resume "
+                    "auto continues from the newest valid checkpoint")
+    ap.add_argument("--resume", default="never", choices=("auto", "never"),
+                    help="auto: restore the bench state from the newest "
+                    "VALID checkpoint under --ckpt-dir (resilience."
+                    "find_restorable) and stamp the record with "
+                    "`resumed_from` provenance")
     args = ap.parse_args()
 
     unit = "images/sec/chip" if args.config == "resnet" else "tokens/sec/chip"
@@ -624,7 +695,7 @@ def main():
             # record should carry its own roofline score (value /
             # predicted) so the 0.36x-class localizer reads off the line
             fallback["best_banked"] = _attach_roofline(prior, args.config)
-        _emit(fallback)
+        _emit(fallback, args.out)
         return
 
     def _alarm(signum, frame):
@@ -659,24 +730,46 @@ def main():
         best = None
         best_rate = -1.0
         last_err = None
-        for b in cand_batches:
+        bank_state = None
+        bank_iters = 0
+        resume_cache = None   # restore + digest-verify once per run,
+        for b in cand_batches:  # not per candidate (batch-independent)
             try:
                 kw = {}
                 if args.config in ("gpt2", "gpt2_fp16"):
                     kw = dict(batch=b, seq=args.seq)
                 (state, step, batch, units_per_step, iters, metric, unit,
                  proxy) = BENCHES[args.config](on_accel, **kw)
-                (per_step, flops_per_step,
-                 final_metrics) = timed_steps(step, state, batch, iters)
+                resumed_from = None
+                if args.ckpt_dir and args.resume == "auto":
+                    if resume_cache is None:
+                        restored, rf = _try_resume(args.ckpt_dir, state)
+                        if rf is not None:
+                            # hold the restored state as HOST arrays:
+                            # timed_steps donates its input buffers, so
+                            # each candidate needs fresh device copies
+                            restored = jax.device_get(restored)
+                        resume_cache = (restored, rf)
+                    host_restored, resumed_from = resume_cache
+                    if resumed_from is not None:
+                        state = jax.tree_util.tree_map(jnp.asarray,
+                                                       host_restored)
+                (per_step, flops_per_step, final_metrics,
+                 final_state) = timed_steps(step, state, batch, iters)
                 rate = units_per_step / per_step
                 if rate > best_rate:   # unrounded comparison
                     best_rate = rate
+                    bank_state, bank_iters = final_state, iters
                     best = {
                         "metric": f"{metric} [{backend}]",
                         "value": round(rate, 1),
                         "unit": unit,
                         "vs_baseline": round(rate / proxy, 4),
                     }
+                    if resumed_from:
+                        # provenance: this number continued from a banked
+                        # checkpoint, not a fresh init
+                        best["resumed_from"] = resumed_from
                     if len(cand_batches) > 1:
                         best["batch"] = b
                     # dynamic-loss-scaling evidence (fp16 O1): the
@@ -721,12 +814,18 @@ def main():
         if best is None:
             raise last_err if last_err is not None else RuntimeError(
                 "no benchmark candidate ran")
-        _emit(_attach_roofline(best, args.config))
+        if args.ckpt_dir and bank_state is not None:
+            try:
+                _bank_ckpt(args.ckpt_dir, bank_state, bank_iters)
+            except Exception as e:  # banking must not eat the record
+                print(f"WARNING: checkpoint banking failed: {e}",
+                      file=sys.stderr, flush=True)
+        _emit(_attach_roofline(best, args.config), args.out)
     except Exception as e:  # the line must still print on any failure
         signal.alarm(0)
         fallback["metric"] = f"{unit} {args.config} [{backend}]"
         fallback["error"] = f"{type(e).__name__}: {e}"
-        _emit(fallback)
+        _emit(fallback, args.out)
 
 
 if __name__ == "__main__":

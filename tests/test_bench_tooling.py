@@ -102,6 +102,50 @@ def mvp_mod():
     return mod
 
 
+class TestCrashSafeBanking:
+    def test_emit_banks_record_atomically(self, bench_mod, tmp_path,
+                                          capsys):
+        """--out satellite: the record lands at out_path via temp-file
+        + atomic rename (no .tmp debris), nested dirs are created, and
+        stdout still carries the driver's JSON line."""
+        rec = {"metric": "m [tpu]", "value": 1.5}
+        out = tmp_path / "sweep" / "gpt2.json"
+        bench_mod._emit(rec, str(out))
+        assert json.loads(capsys.readouterr().out) == rec
+        assert json.loads(out.read_text()) == rec
+        assert os.listdir(out.parent) == ["gpt2.json"]
+
+    def test_emit_overwrites_previous_record(self, bench_mod, tmp_path):
+        out = tmp_path / "r.json"
+        bench_mod._emit({"value": 1}, str(out))
+        bench_mod._emit({"value": 2}, str(out))
+        assert json.loads(out.read_text()) == {"value": 2}
+
+    def test_emit_banking_failure_never_eats_the_record(self, bench_mod,
+                                                        tmp_path,
+                                                        capsys):
+        """Banking is best-effort: an unwritable out_path warns on
+        stderr but the stdout line (the driver contract) still prints."""
+        target = tmp_path / "f"
+        target.write_text("not a dir")
+        rec = {"value": 3}
+        bench_mod._emit(rec, str(target / "x.json"))
+        captured = capsys.readouterr()
+        assert json.loads(captured.out) == rec
+        assert "could not bank" in captured.err
+
+    def test_try_resume_falls_back_to_fresh_on_junk_dir(self, bench_mod,
+                                                        tmp_path,
+                                                        capsys):
+        """--resume auto must measure, not die, on a stale/foreign
+        checkpoint dir."""
+        template = {"w": [1, 2, 3]}
+        (tmp_path / "step_00000001").mkdir()   # uncommitted debris
+        state, resumed = bench_mod._try_resume(str(tmp_path), template)
+        assert state is template and resumed is None
+        assert "starting fresh" in capsys.readouterr().err
+
+
 class TestMeasuredVsPredicted:
     """The roofline-scoring artifact generator: its rows feed BASELINE.md
     and the judge's perf assessment, so pin the join arithmetic."""

@@ -50,6 +50,10 @@ def test_roundtrip_amp_state(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # 870s-cap headroom: quant x checkpoint COMPOSITION
+# (26s: two generate compiles); each layer stays pinned in tier-1 —
+# int8 generate parity in test_quantized, orbax round-trip fidelity in
+# test_roundtrip_amp_state/test_loss_scale_state_round_trips
 def test_quantized_decode_params_round_trip(tmp_path):
     """int8 serving trees (models.quant_decode) checkpoint bit-exactly —
     int8 weights, fp32 scales, bf16 embedding table all survive orbax,

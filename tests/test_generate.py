@@ -368,6 +368,9 @@ class TestRaggedGenerate:
                             jnp.asarray(prompts))["params"]
         return cfg, model, params, prompts, pad_mask, lens
 
+    @pytest.mark.slow  # 870s-cap headroom: MoE x ragged pad
+    # invariance COMPOSITION (6s generate compile); non-MoE ragged pad
+    # invariance + MoE routing stay tier-1, full run via check_all --all
     def test_ragged_moe_pad_content_invariance(self):
         """MoE x ragged (review r5): pad tokens must claim NO expert
         capacity — with a tight capacity factor, a routed pad would
@@ -473,6 +476,10 @@ class TestRaggedGenerate:
                          cache=make_cache(2, 10),
                          prompt_lens=jnp.asarray(bad, jnp.int32))
 
+    @pytest.mark.slow  # 870s-cap headroom (13s: int8 generate
+    # compiles); the pair's halves stay tier-1 (ragged rows-match-solo
+    # above, int8 decode parity in test_quantized) and the triple runs
+    # via check_all.sh --all
     def test_ragged_composes_with_int8_decode(self):
         """The serving stack's two features must compose: ragged
         generate through the int8 quant decoder, each row token-exact

@@ -282,6 +282,10 @@ class TestQuantDecode:
                         cache=make_cache_f(2, 11))
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.slow  # 870s-cap headroom (12s of generate compiles):
+    # the MoE x int8 x ragged TRIPLE composition; its pairs stay tier-1
+    # (moe_quant logits/generate above, non-MoE ragged-int8 pins in
+    # test_generate/test_speculative)
     def test_moe_int8_ragged_rows_match_solo(self):
         """docs/serving.md matrix: MoE x int8 x ragged. Ample expert
         capacity (no overflow -> no batched-vs-solo capacity coupling):

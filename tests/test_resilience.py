@@ -1,0 +1,502 @@
+"""Resilient-runtime tests — ISSUE 6 acceptance criteria.
+
+Covers: integrity manifests (round-trip + every corruption class a
+typed error), the async checkpointer (donation safety, ring GC +
+milestone pins, `find_restorable`'s backward scan past truncated AND
+bit-flipped checkpoints, fingerprint refusal), the divergence sentinel
+(on-device NaN catch pinned by jaxpr — no host sync — plus the
+skip → rollback → abort ladder with banked diagnostics), the preemption
+handler, the retry/backoff policy, and the chaos harness's own
+determinism."""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.amp import Amp
+from apex1_tpu.checkpoint import CheckpointError
+from apex1_tpu.optim.fused_sgd import fused_sgd
+from apex1_tpu.resilience import (DivergenceError, EXIT_RESUMABLE,
+                                  IntegrityError, PreemptionHandler,
+                                  ResilientCheckpointer, Sentinel,
+                                  TransientError, backoff_delays,
+                                  find_restorable, guard_train_step,
+                                  read_manifest, refold_key, refold_seed,
+                                  retry_call, sentinel_init, verify_files,
+                                  verify_tree, write_manifest)
+from apex1_tpu.testing import chaos
+
+
+def _amp_setup(poisonable=False):
+    amp = Amp(tx=fused_sgd(0.1), opt_level="O0")
+    state = amp.init({"w": jnp.arange(1.0, 9.0, dtype=jnp.float32),
+                      "b": jnp.zeros((4,), jnp.float32)})
+    if poisonable:
+        def loss_fn(p, x, step):
+            loss = jnp.sum(jnp.square(p["w"])) * x + jnp.sum(p["b"])
+            return chaos.poison_at_steps(loss, step, (3, 4))
+    else:
+        def loss_fn(p, x):
+            return jnp.sum(jnp.square(p["w"])) * x + jnp.sum(p["b"])
+    return amp, state, loss_fn
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+
+class TestRetry:
+    def test_delays_deterministic_capped_and_monotone_base(self):
+        a = list(backoff_delays(6, base_s=0.01, cap_s=0.1, seed=7))
+        b = list(backoff_delays(6, base_s=0.01, cap_s=0.1, seed=7))
+        assert a == b                       # seeded jitter: reproducible
+        assert all(d <= 0.1 for d in a)     # cap holds under jitter
+        exact = list(backoff_delays(6, base_s=0.01, cap_s=10.0, jitter=0))
+        assert exact == [0.01 * 2 ** i for i in range(6)]
+        # jitter shrinks, never grows, a delay
+        jit = list(backoff_delays(6, base_s=0.01, cap_s=10.0, seed=3))
+        assert all(j <= e for j, e in zip(jit, exact))
+
+    def test_retry_call_recovers_and_counts(self):
+        flaky = chaos.Flaky(lambda: "ok", fails=3)
+        seen = []
+        out = retry_call(flaky, retries=5, base_s=0.0, jitter=0.0,
+                         on_retry=lambda n, e: seen.append(n))
+        assert out == "ok"
+        assert flaky.attempts == 4 and flaky.failures == 3
+        assert seen == [1, 2, 3]
+
+    def test_retry_call_exhausts_and_reraises(self):
+        flaky = chaos.Flaky(lambda: "ok", fails=10)
+        with pytest.raises(TransientError):
+            retry_call(flaky, retries=2, base_s=0.0, jitter=0.0)
+        assert flaky.attempts == 3          # initial + 2 retries
+
+    def test_retry_call_deadline_drops_early(self):
+        flaky = chaos.Flaky(lambda: "ok", fails=10)
+        with pytest.raises(TransientError):
+            retry_call(flaky, retries=50, base_s=10.0, jitter=0.0,
+                       deadline_s=0.05, sleep=lambda _d: None)
+        assert flaky.attempts == 1          # first 10s delay > deadline
+
+    def test_non_retryable_propagates_immediately(self):
+        def boom():
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            retry_call(boom, retries=5, base_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.py satellite: typed errors + atomic save
+
+class TestCheckpointErrors:
+    def test_missing_path_is_typed(self, tmp_path):
+        from apex1_tpu.checkpoint import restore_checkpoint
+
+        with pytest.raises(CheckpointError, match="missing"):
+            restore_checkpoint(tmp_path / "nope")
+        try:
+            restore_checkpoint(tmp_path / "nope")
+        except CheckpointError as e:
+            assert "nope" in e.path and "missing" in e.reason
+
+    def test_partial_tmp_dir_is_typed(self, tmp_path):
+        from apex1_tpu.checkpoint import restore_checkpoint
+
+        half = tmp_path / "ck.tmp-1234"
+        half.mkdir()
+        with pytest.raises(CheckpointError, match="partial"):
+            restore_checkpoint(half)
+
+    def test_corrupt_payload_is_typed_not_raw_orbax(self, tmp_path):
+        from apex1_tpu.checkpoint import (restore_checkpoint,
+                                          save_checkpoint)
+
+        tree = {"w": jnp.arange(8.0)}
+        save_checkpoint(tmp_path / "ck", tree)
+        # wrong template structure → typed error, not an orbax traceback
+        with pytest.raises(CheckpointError, match="restore failed"):
+            restore_checkpoint(tmp_path / "ck",
+                               template={"nope": jnp.zeros((3, 3))})
+
+    def test_save_leaves_no_tmp_debris(self, tmp_path):
+        from apex1_tpu.checkpoint import save_checkpoint
+
+        save_checkpoint(tmp_path / "ck", {"w": jnp.ones((4,))})
+        names = os.listdir(tmp_path)
+        assert names == ["ck"]              # temp dir renamed away
+
+
+# ---------------------------------------------------------------------------
+# manifest
+
+class TestManifest:
+    def _write(self, tmp_path, tree=None):
+        from apex1_tpu.checkpoint import save_checkpoint
+
+        tree = tree if tree is not None else {
+            "w": jnp.arange(16.0), "n": jnp.int32(3)}
+        d = tmp_path / "ck"
+        save_checkpoint(d / "state", tree)
+        write_manifest(d, step=7, state=tree,
+                       fingerprint=0xABC, meta={"data_step": 9})
+        return d, tree
+
+    def test_round_trip_and_verify(self, tmp_path):
+        d, tree = self._write(tmp_path)
+        m = read_manifest(d)
+        assert (m.step, m.fingerprint, m.meta["data_step"]) == (7, "0xabc",
+                                                                9)
+        verify_files(d)
+        verify_tree(d, tree, m)
+
+    def test_truncation_detected(self, tmp_path):
+        d, _ = self._write(tmp_path)
+        chaos.truncate_checkpoint(d)
+        with pytest.raises(IntegrityError, match="truncated|missing"):
+            verify_files(d)
+
+    def test_bitflip_detected(self, tmp_path):
+        d, _ = self._write(tmp_path)
+        chaos.bitflip_checkpoint(d)
+        with pytest.raises(IntegrityError, match="digest mismatch"):
+            verify_files(d)
+
+    def test_missing_manifest_is_uncommitted(self, tmp_path):
+        d, _ = self._write(tmp_path)
+        os.unlink(d / "manifest.json")
+        with pytest.raises(IntegrityError, match="manifest missing"):
+            verify_files(d)
+
+    def test_wrong_restore_is_typed(self, tmp_path):
+        d, tree = self._write(tmp_path)
+        wrong = dict(tree, w=tree["w"].at[0].set(99.0))
+        with pytest.raises(IntegrityError, match="sha256 mismatch"):
+            verify_tree(d, wrong)
+        with pytest.raises(IntegrityError, match="structure mismatch"):
+            verify_tree(d, {"w": tree["w"]})
+
+
+# ---------------------------------------------------------------------------
+# resilient checkpointer
+
+class TestResilientCheckpointer:
+    def test_async_save_restore_and_meta(self, tmp_path):
+        amp, state, loss_fn = _amp_setup()
+        step = jax.jit(amp.make_train_step(loss_fn))
+        with ResilientCheckpointer(tmp_path / "ck", keep=2) as ck:
+            for i in range(3):
+                state, _ = step(state, jnp.float32(1.0))
+                ck.save(int(state.step), state, meta={"data_step": i + 1})
+            ck.wait()
+            restored, man = ck.restore(template=state)
+        assert man.step == 3 and man.meta["data_step"] == 3
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_snapshot_survives_donation(self, tmp_path):
+        """The async snapshot must copy: the caller's next
+        donate_argnums=0 step invalidates the live buffers while the
+        save is still writing."""
+        amp, state, loss_fn = _amp_setup()
+        step = jax.jit(amp.make_train_step(loss_fn), donate_argnums=0)
+        state, _ = step(state, jnp.float32(1.0))
+        want = np.asarray(state.params["w"]).copy()
+        with ResilientCheckpointer(tmp_path / "ck") as ck:
+            ck.save(1, state)
+            for _ in range(3):          # donates `state` repeatedly
+                state, _ = step(state, jnp.float32(1.0))
+            ck.wait()
+            restored, man = ck.restore(
+                template=jax.tree.map(np.asarray, state))
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      want)
+
+    def test_ring_gc_keeps_last_k_and_milestones(self, tmp_path):
+        tree = {"w": jnp.ones((4,))}
+        with ResilientCheckpointer(tmp_path / "ck", keep=2) as ck:
+            ck.save_sync(1, tree, milestone=True)
+            for s in (2, 3, 4, 5):
+                ck.save_sync(s, tree)
+        kept = sorted(p for p in os.listdir(tmp_path / "ck")
+                      if p.startswith("step_"))
+        assert kept == ["step_00000001", "step_00000004", "step_00000005"]
+
+    def test_scan_past_truncated_and_bitflipped(self, tmp_path):
+        """Acceptance criterion: newest truncated, next bit-flipped →
+        the older valid one is selected and restores."""
+        tree = {"w": jnp.arange(64.0)}
+        with ResilientCheckpointer(tmp_path / "ck", keep=5) as ck:
+            for s in (1, 2, 3):
+                ck.save_sync(s, dict(tree, step=jnp.int32(s)))
+            d = str(tmp_path / "ck")
+            chaos.truncate_checkpoint(os.path.join(d, "step_00000003"))
+            chaos.bitflip_checkpoint(os.path.join(d, "step_00000002"))
+            best = find_restorable(d)
+            assert best is not None
+            assert os.path.basename(best) == "step_00000001"
+            restored, man = ck.restore(
+                template=dict(tree, step=jnp.int32(0)))
+        assert man.step == 1 and int(restored["step"]) == 1
+
+    def test_no_valid_checkpoint_is_typed(self, tmp_path):
+        with ResilientCheckpointer(tmp_path / "ck") as ck:
+            with pytest.raises(CheckpointError, match="no valid"):
+                ck.restore(template={"w": jnp.ones((4,))})
+
+    def test_fingerprint_refuses_changed_program(self, tmp_path):
+        tree = {"w": jnp.ones((4,))}
+        with ResilientCheckpointer(tmp_path / "ck",
+                                   fingerprint=0x1111) as ck:
+            ck.save_sync(1, tree)
+        with ResilientCheckpointer(tmp_path / "ck",
+                                   fingerprint=0x2222) as ck2:
+            with pytest.raises(CheckpointError,
+                               match="fingerprint mismatch"):
+                ck2.restore(template=tree)
+            restored, _ = ck2.restore(template=tree,
+                                      allow_fingerprint_mismatch=True)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.ones((4,)))
+
+    def test_stale_latest_pointer_does_not_hide_newer_valid(self,
+                                                            tmp_path):
+        """A kill between the commit rename and the `latest` promote
+        leaves the pointer naming an OLDER checkpoint; find_restorable
+        must still return the newest valid one."""
+        tree = {"w": jnp.ones((4,))}
+        d = tmp_path / "ck"
+        with ResilientCheckpointer(d, keep=3) as ck:
+            ck.save_sync(1, tree)
+            ck.save_sync(2, tree)
+            with open(d / "latest", "w") as f:
+                f.write("step_00000001\n")     # simulate the torn kill
+            assert os.path.basename(find_restorable(d)) == "step_00000002"
+
+    def test_snapshot_bound_third_save_blocks(self, tmp_path):
+        """At most two snapshots outstanding: with the worker stalled,
+        the first two save() calls return and the THIRD blocks (its
+        snapshot not yet built) until the worker drains one."""
+        import threading
+        import time as _t
+
+        gate = threading.Event()
+        ck = ResilientCheckpointer(tmp_path / "ck")
+        orig = ck._write_one
+        ck._write_one = lambda *a: (gate.wait(30), orig(*a))[1]
+        tree = {"w": jnp.ones((4,))}
+        snaps = []
+        orig_snap = ck._snapshot
+        ck._snapshot = lambda s: snaps.append(1) or orig_snap(s)
+        ck.save(1, tree)
+        ck.save(2, tree)                       # fills the second slot
+        t = threading.Thread(target=lambda: ck.save(3, tree))
+        t.start()
+        _t.sleep(0.3)
+        assert len(snaps) == 2 and t.is_alive()   # third not snapshot
+        gate.set()
+        t.join(timeout=30)
+        ck.close()
+        assert len(snaps) == 3
+        assert os.path.basename(ck.latest_valid()) == "step_00000003"
+
+    def test_uncommitted_save_is_invisible(self, tmp_path):
+        """A step dir without a manifest (killed between payload and
+        commit) is not restorable and is GC-collectable."""
+        tree = {"w": jnp.ones((4,))}
+        d = tmp_path / "ck"
+        with ResilientCheckpointer(d, keep=2) as ck:
+            ck.save_sync(1, tree)
+            # forge an uncommitted newer checkpoint
+            os.makedirs(d / "step_00000002")
+            assert os.path.basename(find_restorable(d)) == "step_00000001"
+            restored, man = ck.restore(template=tree)
+        assert man.step == 1
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+
+class TestSentinel:
+    def _guarded(self, sent=None):
+        amp, state, loss_fn = _amp_setup(poisonable=True)
+        inner = amp.make_train_step(loss_fn)
+        guard = (sent.guard(inner) if sent is not None
+                 else guard_train_step(inner))
+        return state, jax.jit(guard), guard
+
+    def test_nan_caught_on_device_no_host_sync(self):
+        """Acceptance criterion: the guarded step's jaxpr carries NO
+        host callback — the flag is a carried device scalar (graftlint
+        covers the source side; this pins the traced program)."""
+        state, guarded, guard = self._guarded()
+        carry = (state, sentinel_init())
+        jaxpr = str(jax.make_jaxpr(guard)(carry, jnp.float32(1.0),
+                                          state.step))
+        for bad in ("callback", "py_func", "infeed", "outfeed"):
+            assert bad not in jaxpr, f"host sync ({bad}) in guarded step"
+
+    def test_poisoned_step_skipped_params_kept(self):
+        state, guarded, _ = self._guarded()
+        carry = (state, sentinel_init())
+        for _ in range(3):   # steps 0,1,2 clean
+            carry, m = guarded(carry, jnp.float32(1.0), carry[0].step)
+            assert bool(m["sentinel_healthy"])
+        good = np.asarray(carry[0].params["w"]).copy()
+        carry, m = guarded(carry, jnp.float32(1.0), carry[0].step)  # 3: NaN
+        assert not bool(m["sentinel_healthy"])
+        np.testing.assert_array_equal(np.asarray(carry[0].params["w"]),
+                                      good)
+        assert int(carry[0].step) == 4          # step still advances
+        s = carry[1]
+        assert (int(s.consecutive_bad), int(s.total_bad),
+                int(s.last_bad_step)) == (1, 1, 3)
+        # params stay finite through the whole poisoned window
+        assert np.isfinite(np.asarray(carry[0].params["w"])).all()
+
+    def test_escalation_skip_then_rollback_with_banked_record(self,
+                                                              tmp_path):
+        """Acceptance criterion: first hit → skip; second consecutive
+        hit → rollback-to-last-good + a diverged diagnostic banked."""
+        ck = ResilientCheckpointer(tmp_path / "ck")
+        sent = Sentinel(ck, check_every=1, rollback_after=2,
+                        abort_after=4)
+        state, guarded, _ = self._guarded(sent)
+        carry = (state, sentinel_init())
+        for _ in range(3):
+            carry, _m = guarded(carry, jnp.float32(1.0), carry[0].step)
+            assert sent.poll(carry[1]) is None
+        ck.save_sync(int(carry[0].step), carry[0],
+                     meta={"data_step": 3})
+        good = np.asarray(carry[0].params["w"]).copy()
+
+        carry, _m = guarded(carry, jnp.float32(1.0), carry[0].step)
+        assert sent.poll(carry[1]) == "skip"
+        carry, _m = guarded(carry, jnp.float32(1.0), carry[0].step)
+        assert sent.poll(carry[1]) == "rollback"
+        restored, man, s0 = sent.rollback(template=carry[0])
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                      good)
+        assert man.meta["data_step"] == 3 and int(s0.consecutive_bad) == 0
+        actions = [r["action"] for r in sent.records]
+        assert actions == ["skip", "rollback"]
+        banked = sorted(os.listdir(tmp_path / "ck" / "diagnostics"))
+        assert len(banked) == 2 and banked[-1].endswith("rollback.json")
+        rec = json.load(open(tmp_path / "ck" / "diagnostics" / banked[-1]))
+        assert rec["consecutive_bad"] == 2 and rec["action"] == "rollback"
+        ck.close()
+
+    def test_abort_raises_divergence_error(self, tmp_path):
+        sent = Sentinel(None, check_every=1, rollback_after=1,
+                        abort_after=2, diagnostics_dir=str(tmp_path))
+        state, guarded, _ = self._guarded(sent)
+        # no checkpointer → rollback rung unavailable → 1st poll at
+        # consecutive=1 reaches rollback_after but can't roll back: abort
+        carry = (state, sentinel_init())
+        for _ in range(3):
+            carry, _m = guarded(carry, jnp.float32(1.0), carry[0].step)
+        with pytest.raises(DivergenceError) as ei:
+            for _ in range(2):
+                carry, _m = guarded(carry, jnp.float32(1.0),
+                                    carry[0].step)
+                sent.poll(carry[1])
+        assert ei.value.record["action"] == "abort"
+        assert any(n.endswith("abort.json") for n in os.listdir(tmp_path))
+
+    def test_diagnostics_dir_honors_late_attached_checkpointer(self,
+                                                               tmp_path):
+        """Training loops attach the checkpointer AFTER constructing
+        the sentinel (the program-fingerprint chicken-and-egg in
+        examples/gpt2_amp.py); diagnostics must still land under
+        <ckpt dir>/diagnostics, not be silently unbanked."""
+        sent = Sentinel(None, check_every=1, rollback_after=3,
+                        abort_after=4)
+        with ResilientCheckpointer(tmp_path / "ck") as ck:
+            sent.checkpointer = ck
+            state, guarded, _ = self._guarded(sent)
+            carry = (state, sentinel_init())
+            for _ in range(4):          # steps 0-2 clean, 3 poisoned
+                carry, _m = guarded(carry, jnp.float32(1.0),
+                                    carry[0].step)
+            assert sent.poll(carry[1]) == "skip"
+        banked = os.listdir(tmp_path / "ck" / "diagnostics")
+        assert banked and sent.records[-1]["path"].endswith(banked[0])
+
+    def test_gnorm_threshold_flags_finite_divergence(self):
+        amp, state, _ = _amp_setup()
+        inner = amp.make_train_step(
+            lambda p, x: jnp.sum(jnp.square(p["w"])) * x)
+        guarded = jax.jit(guard_train_step(inner, gnorm_threshold=1e3))
+        carry = (state, sentinel_init())
+        carry, m = guarded(carry, jnp.float32(1e8))   # huge but finite
+        assert not bool(m["sentinel_healthy"])
+        assert int(carry[1].consecutive_bad) == 1
+
+    def test_refold_streams_distinct(self):
+        k = jax.random.key(0)
+        a, b = refold_key(k, 1), refold_key(k, 2)
+        assert not np.array_equal(jax.random.key_data(a),
+                                  jax.random.key_data(b))
+        assert refold_seed(7, 1) != refold_seed(7, 2) != 7
+
+
+# ---------------------------------------------------------------------------
+# preemption handler (in-process; subprocess contract in
+# test_fault_recovery.py)
+
+class TestPreemption:
+    def test_sigterm_sets_flag_and_uninstall_restores(self):
+        old = signal.getsignal(signal.SIGTERM)
+        with PreemptionHandler(signals=(signal.SIGTERM,)) as pre:
+            assert not pre.triggered
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert pre.triggered and pre.signum == signal.SIGTERM
+            assert not pre.deadline_exceeded()
+        assert signal.getsignal(signal.SIGTERM) is old
+
+    def test_exit_resumable_code(self, capsys):
+        pre = PreemptionHandler()
+        with pytest.raises(SystemExit) as ei:
+            pre.exit_resumable("test")
+        assert ei.value.code == EXIT_RESUMABLE == 75
+        assert "resumable" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# chaos harness determinism
+
+class TestChaos:
+    def test_poison_identity_when_empty(self):
+        f = lambda x, s: chaos.poison_at_steps(x, s, ())
+        x = jnp.ones((4,))
+        np.testing.assert_array_equal(np.asarray(f(x, jnp.int32(3))),
+                                      np.asarray(x))
+        # empty steps trace to the identity program (no where/isin ops)
+        assert "while" not in str(jax.make_jaxpr(f)(x, jnp.int32(0)))
+
+    def test_poison_hits_exact_steps(self):
+        x = jnp.ones((4,))
+        for s, bad in ((2, True), (3, False)):
+            out = np.asarray(chaos.poison_at_steps(x, jnp.int32(s), (2,)))
+            assert np.isnan(out).all() == bad
+
+    def test_corruption_is_deterministic(self, tmp_path):
+        """Same (checkpoint, seed) → same payload-file pick. (Across
+        SAVES the orbax file names differ — the pick is a pure function
+        of the manifest, which is per-checkpoint.)"""
+        from apex1_tpu.checkpoint import save_checkpoint
+
+        tree = {"w": jnp.arange(256.0)}
+        d = tmp_path / "ck"
+        save_checkpoint(d / "state", tree)
+        write_manifest(d, step=1, state=tree)
+        a = chaos._pick_payload_file(str(d), seed=5)
+        b = chaos._pick_payload_file(str(d), seed=5)
+        assert a == b
+        flipped = chaos.bitflip_checkpoint(d, seed=5)
+        assert flipped == a             # the flip lands on the pick

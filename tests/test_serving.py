@@ -343,6 +343,54 @@ class TestRequestFeeder:
                                           solo(p, 4))
         assert eng.trace_counts == {"prefill": 1, "decode": 1}
 
+    def test_backpressure_backoff_then_success_counts_retries(self):
+        """Satellite contract: Backpressure is absorbed with bounded
+        exponential backoff (resilience.retry schedule) and the
+        counters record the aggregate — no engine needed."""
+        calls = {"n": 0}
+
+        def submit(tokens, **kw):
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                raise Backpressure("queue full")
+            return kw["req_id"]
+
+        feeder = RequestFeeder([[1, 2]], lambda t: (t, {}), submit,
+                               retries=10, retry_wait_s=1e-4).start()
+        feeder.join(timeout=10.0)
+        assert len(feeder.submitted) == 1 and not feeder.dropped
+        assert feeder.counters["submitted"] == 1
+        assert feeder.counters["retries"] == 3
+        assert feeder.counters["dropped_backpressure"] == 0
+
+    def test_backpressure_retries_exhausted_drops_with_reason(self):
+        def submit(tokens, **kw):
+            raise Backpressure("queue full")
+
+        feeder = RequestFeeder([[1], [2]], lambda t: (t, {}), submit,
+                               retries=2, retry_wait_s=1e-4).start()
+        feeder.join(timeout=10.0)
+        assert len(feeder.dropped) == 2
+        assert all("retries exhausted" in r for _, r in feeder.dropped)
+        assert feeder.counters["dropped_backpressure"] == 2
+        assert feeder.counters["retries"] == 4       # 2 per item
+
+    def test_backpressure_deadline_sheds_load(self):
+        """Drop-after-deadline: an item must not stretch tail latency
+        unboundedly even with retries left."""
+        def submit(tokens, **kw):
+            raise Backpressure("queue full")
+
+        feeder = RequestFeeder([[1]], lambda t: (t, {}), submit,
+                               retries=10_000, retry_wait_s=0.05,
+                               jitter=0.0, deadline_s=0.12).start()
+        feeder.join(timeout=10.0)
+        assert len(feeder.dropped) == 1
+        assert "deadline" in feeder.dropped[0][1]
+        assert feeder.counters["dropped_backpressure"] == 1
+        # bounded: far fewer sleeps than the retry budget allowed
+        assert feeder.counters["retries"] < 10
+
     def test_per_item_error_drops_item_and_feed_continues(self, tiny,
                                                           rng):
         """One malformed request (submit's contract ValueError) must

@@ -267,6 +267,10 @@ class TestSpeculativeRaggedAndQuant:
         d_fn, mk_d = llama_decoder(drf)
         return cfg_t, prompt, lens, t_fn, pt, mk_t, d_fn, pd, mk_d
 
+    @pytest.mark.slow  # 870s-cap headroom: speculative x ragged x
+    # quant TRIPLE composition (5.5s); each pair stays tier-1 (ragged
+    # spec in TestSpeculativeGenerate, quant spec below), full run via
+    # check_all --all
     def test_ragged_rows_match_solo_decode(self):
         """Greedy ragged speculative: every row must be token-identical
         to greedy-decoding that row ALONE (the per-row contract

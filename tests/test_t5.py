@@ -134,7 +134,10 @@ class TestT5Model:
         # tier-1 via test_llama.py::test_remat_matches_no_remat — full
         # run via check_all --all
         pytest.param("nothing_saveable", marks=pytest.mark.slow),
-        "dots_saveable",
+        pytest.param("dots_saveable", marks=pytest.mark.slow),
+        # 870s-cap headroom: BOTH T5 remat policies now ride
+        # check_all --all; tier-1 remat parity stays pinned via
+        # test_llama.py::test_remat_matches_no_remat
     ])
     def test_remat_matches_no_remat(self, tiny, policy):
         """Remat (full or selective) must not change loss or grads."""

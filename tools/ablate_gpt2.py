@@ -33,7 +33,7 @@ def run(name, use_flash, fuse_head, opt_level="O2"):
     state = amp.init(params)
     step = amp.make_train_step(gpt2_loss_fn(model, fuse_head=fuse_head))
     t0 = time.time()
-    per_step, _, _ = timed_steps(step, state, (tokens,), iters)
+    per_step, *_ = timed_steps(step, state, (tokens,), iters)
     print(f"{name:40s} {per_step*1e3:8.1f} ms/step  "
           f"{B*S/per_step:9.0f} tok/s  (compile+run {time.time()-t0:.0f}s)",
           flush=True)
@@ -50,7 +50,7 @@ def fwd_only(name, use_flash, fuse_head):
         return params, {"loss": loss_fn(params, tokens)}
 
     t0 = time.time()
-    per_step, _, _ = timed_steps(step, params, (tokens,), iters)
+    per_step, *_ = timed_steps(step, params, (tokens,), iters)
     print(f"{name:40s} {per_step*1e3:8.1f} ms/step  "
           f"(compile+run {time.time()-t0:.0f}s)", flush=True)
 

@@ -20,6 +20,8 @@ echo "== graftlint (static JAX-hazard gate; docs/lint.md) =="
 python tools/lint.py
 echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
 python tools/tune_kernels.py --validate
+echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --smoke
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q
