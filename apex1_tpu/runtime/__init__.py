@@ -638,6 +638,13 @@ class RequestFeeder:
     total in retries it is shed (``dropped``), because an overloaded
     engine must shed load, not stretch tail latency unboundedly.
 
+    A structured rejection's ``retry_after_s`` is the server's backoff
+    hint and is honored as a FLOOR on the next sleep: the exponential
+    schedule may wait longer, never shorter — a thousand feeders
+    retrying "soon" against a server that said "50 ms" is exactly the
+    re-slam the hint exists to prevent. The floored delay still counts
+    against ``deadline_s``.
+
     ``tokenize(item) -> (tokens, kwargs)`` where kwargs go straight to
     ``submit(tokens, **kwargs)`` (``max_new_tokens`` etc.). Rejections
     that outlive ``retries``/``deadline_s`` land in ``dropped`` with the
@@ -721,6 +728,12 @@ class RequestFeeder:
                             break
                         except Backpressure as e:
                             d = next(delays, None)
+                            if d is not None:
+                                # server hint = the floor, not the value:
+                                # back off MORE than asked, never less
+                                floor = getattr(e, "retry_after_s", None)
+                                if floor:
+                                    d = max(d, float(floor))
                             waited = _time.monotonic() - t0
                             if d is None:
                                 reason = f"{e.reason} (retries exhausted)"

@@ -1,13 +1,18 @@
-"""`apex1_tpu.serving` — continuous-batching inference engine.
+"""`apex1_tpu.serving` — continuous-batching inference engine behind a
+fault-tolerant multi-replica front.
 
 The serving layer the ROADMAP's "heavy traffic" north star needs on
 top of the `models.generate` decode spine: a request scheduler with
-backpressure and deadlines (`scheduler`), a fixed-slot KV pool with
-refcounted shared-prefix pages (`kv_pool`), the two-executable
-continuous-batching loop itself (`engine`), and per-request lifecycle
-metrics (`metrics`). See ``docs/serving.md`` § Engine.
+backpressure, deadlines, and per-tenant QoS classes (`scheduler`), a
+fixed-slot KV pool with refcounted shared-prefix pages (`kv_pool`),
+the two-executable continuous-batching loop itself (`engine`),
+per-request lifecycle metrics with failure-path counters (`metrics`),
+supervised replicas with watchdog + idempotent resubmission
+(`replica`), and the load/SLO-routed multi-replica frontend with
+hedging and degraded modes (`frontend`). See ``docs/serving.md``
+§ Engine and § Failure model.
 
-Quick start::
+Quick start (single engine)::
 
     from apex1_tpu.models.generate import llama_decoder
     from apex1_tpu.serving import Engine, EngineConfig
@@ -17,12 +22,30 @@ Quick start::
     rid = engine.submit(prompt_ids, max_new_tokens=64)
     engine.run()
     print(engine.results[rid].tokens)
+
+Multi-replica front::
+
+    from apex1_tpu.serving import FrontendConfig, ServingFrontend
+
+    front = ServingFrontend(lambda: make_my_engine(),
+                            FrontendConfig(n_replicas=2)).start()
+    rid = front.submit(prompt_ids, max_new_tokens=64, qos="guaranteed")
+    front.run_until_drained()
+    print(front.poll(rid).tokens)
 """
 
 from apex1_tpu.serving.engine import (Engine, EngineConfig,  # noqa: F401
-                                      RequestResult)
+                                      RequestResult,
+                                      derive_request_seed)
+from apex1_tpu.serving.frontend import (DegradeProfile,  # noqa: F401
+                                        FrontendConfig,
+                                        ServingFrontend)
 from apex1_tpu.serving.kv_pool import KVPool, PrefixPage  # noqa: F401
 from apex1_tpu.serving.metrics import (RequestRecord,  # noqa: F401
                                        ServingMetrics)
+from apex1_tpu.serving.replica import (PoisonedRequest,  # noqa: F401
+                                       ReplicaConfig, ReplicaKilled,
+                                       ReplicaSupervisor, Submission)
 from apex1_tpu.serving.scheduler import (Backpressure,  # noqa: F401
-                                         Request, Scheduler)
+                                         QOS_CLASSES, Request,
+                                         Scheduler, new_request_id)

@@ -30,9 +30,21 @@ this design forbids — is observable as a count > 1 (`test_serving::
 TestContinuousBatching::
 test_staggered_join_leave_token_identical_two_executables`).
 
-ASYNC DISPATCH: the decode control vectors (token/index/active per
-slot) live on DEVICE and are patched in place at join/leave
-boundaries, so the step chain is dispatch-only from the host's side.
+SAMPLING is counter-based and PER REQUEST: every request carries a
+seed (explicit, or derived from its stable request id), and token i of
+a request is sampled with ``fold_in(key(seed), i)`` — a pure function
+of (params, prompt, seed), independent of batch composition, engine
+step number, or which engine instance runs it. That is the serving
+analogue of PR 6's bit-exact resume: a supervisor that loses a replica
+mid-stream resubmits the request (same id, same seed) to a fresh
+engine and the regenerated stream is token-identical to the lost one,
+at ANY temperature — idempotent resubmission as a sampling property,
+not a greedy-only accident.
+
+ASYNC DISPATCH: the decode control vectors (token/index/active/seed/
+output-position per slot) live on DEVICE and are patched in place at
+join/leave boundaries, so the step chain is dispatch-only from the
+host's side.
 With ``eos_id=None`` retirement is purely length-based (known at
 admission) and the engine NEVER reads a step's tokens back before
 dispatching the next — per-step outputs accumulate in a device-side
@@ -54,10 +66,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex1_tpu.models.generate import last_real_logits, sample_token
+from apex1_tpu.resilience.retry import _mix32
 from apex1_tpu.serving.kv_pool import KVPool
 from apex1_tpu.serving.metrics import ServingMetrics
 from apex1_tpu.serving.scheduler import Backpressure, Request, Scheduler
 from apex1_tpu.utils.observability import MetricsLogger, annotate
+
+
+def derive_request_seed(engine_seed: int, req_id: int) -> int:
+    """The per-request sampling seed when the caller supplies none:
+    a deterministic avalanche of (engine seed, request id). Stable
+    request ids (`scheduler.new_request_id`) therefore give stable
+    seeds — the property replica failover's idempotent resubmission
+    rides (same id on a fresh engine ⇒ bit-identical stream)."""
+    return _mix32(int(engine_seed) ^ _mix32(int(req_id) + 0x5EED)) \
+        & 0x7FFFFFFF
 
 
 @dataclasses.dataclass
@@ -74,7 +97,8 @@ class EngineConfig:
     eos_id: Optional[int] = None
     pad_id: int = 0
     vocab_size: Optional[int] = None
-    seed: int = 0
+    seed: int = 0                # base for derived PER-REQUEST seeds
+                                 # (see derive_request_seed)
     max_queue: int = 64          # admission backpressure bound
     policy: str = "fifo"         # or "sjf" (see serving.scheduler)
 
@@ -107,7 +131,6 @@ class _Slot:
     n_out: int = 1               # tokens emitted so far (first included)
     in_batch: bool = False       # joined the decode batch (not retired
     eos_seen: bool = False       #  at prefill)
-    cancel: bool = False
     produced: List[int] = dataclasses.field(default_factory=list)
 
 
@@ -146,13 +169,17 @@ class Engine:
         self.results: Dict[int, RequestResult] = {}
         self.trace_counts = {"prefill": 0, "decode": 0}
         self._slots: List[Optional[_Slot]] = [None] * cfg.max_slots
-        self._rng = jax.random.key(cfg.seed)
         # device-resident control vectors, patched in place at
         # join/leave boundaries — the steady-state step chain re-feeds
-        # the previous step's outputs without ever touching the host
+        # the previous step's outputs without ever touching the host.
+        # seeds/pos drive the per-request counter-based sampling keys:
+        # token i of a request is fold_in(key(seed), i), whatever slot,
+        # step, or engine instance computes it
         self._d_toks = jnp.zeros((cfg.max_slots,), jnp.int32)
         self._d_idxs = jnp.zeros((cfg.max_slots,), jnp.int32)
         self._d_active = jnp.zeros((cfg.max_slots,), bool)
+        self._d_seeds = jnp.zeros((cfg.max_slots,), jnp.int32)
+        self._d_pos = jnp.zeros((cfg.max_slots,), jnp.int32)
         self._n_active = 0
         # eos_id=None: retirement is length-based, so step tokens are
         # only READ at retirement — the log keeps each step's (N,)
@@ -172,7 +199,7 @@ class Engine:
                          vocab_size=cfg.vocab_size)
 
         def prefill(params, pool, slot, init_lane, install, tokens, idx,
-                    n_real, rng):
+                    n_real, seed):
             self.trace_counts["prefill"] += 1   # the compile-count hook
             lane = jax.tree_util.tree_map(
                 lambda x: jax.lax.dynamic_slice_in_dim(x, slot, 1, 0),
@@ -188,27 +215,28 @@ class Engine:
             pool = jax.tree_util.tree_map(
                 lambda p, l: jax.lax.dynamic_update_slice_in_dim(
                     p, l.astype(p.dtype), slot, 0), pool, lane)
-            rng, sub = jax.random.split(rng)
+            # output token 0's counter-based key (re-seeding per draw
+            # is the counter-PRNG contract — see ops.stochastic)
+            key = jax.random.fold_in(jax.random.key(seed), 0)
             tok = sample_token(last_real_logits(logits, n_real[None]),
-                               sub, **sample_kw)[0]
-            return tok, pool, rng
+                               key, **sample_kw)[0]
+            return tok, pool
 
-        def decode(params, pool, toks, idxs, active, rng):
+        def decode(params, pool, toks, idxs, active, seeds, pos):
             self.trace_counts["decode"] += 1    # the compile-count hook
-            keys = jax.random.split(rng, cfg.max_slots + 1)
 
-            def row(tok, lane, idx, key):
+            def row(tok, lane, idx, seed, p):
                 lane = jax.tree_util.tree_map(lambda x: x[None], lane)
                 logits, lane = apply_fn(params, tok.reshape(1, 1), lane,
                                         idx)
+                key = jax.random.fold_in(jax.random.key(seed), p)
                 nxt = sample_token(logits[:, -1], key, **sample_kw)[0]
                 return nxt, jax.tree_util.tree_map(lambda x: x[0], lane)
 
-            nxt, pool = jax.vmap(row)(toks, pool, idxs,
-                                      keys[:cfg.max_slots])
+            nxt, pool = jax.vmap(row)(toks, pool, idxs, seeds, pos)
             nxt = jnp.where(active, nxt, cfg.pad_id)
-            idxs = idxs + active.astype(jnp.int32)
-            return nxt, idxs, pool, keys[cfg.max_slots]
+            adv = active.astype(jnp.int32)
+            return nxt, idxs + adv, pos + adv, pool
 
         # donate the pool so XLA updates the cache in place; CPU lacks
         # input/output aliasing for some buffers — skip there to avoid
@@ -221,13 +249,21 @@ class Engine:
 
     def submit(self, tokens, max_new_tokens: int, *, prefix=None,
                deadline: Optional[float] = None,
-               req_id: Optional[int] = None) -> int:
+               req_id: Optional[int] = None,
+               qos: str = "best_effort", tenant: Optional[str] = None,
+               seed: Optional[int] = None) -> int:
         """Enqueue a request. Raises `Backpressure` when the queue is
-        full (the caller's 429) and `ValueError` when the request can
-        NEVER fit (prefix + prompt + max_new_tokens - 1 > max_len — not
-        backpressure, a contract violation)."""
+        full and holds no weaker-class victim to shed (the caller's
+        429, with ``retry_after_s``/``queue_depth`` attached) and
+        `ValueError` when the request can NEVER fit (prefix + prompt +
+        max_new_tokens - 1 > max_len — not backpressure, a contract
+        violation). ``seed`` pins the request's sampling stream; None
+        derives one from the request id (stable across resubmission)."""
         req = Request(tokens=tokens, max_new_tokens=max_new_tokens,
-                      prefix=prefix, deadline=deadline, req_id=req_id)
+                      prefix=prefix, deadline=deadline, req_id=req_id,
+                      qos=qos, tenant=tenant, seed=seed)
+        if req.seed is None:
+            req.seed = derive_request_seed(self.cfg.seed, req.req_id)
         if req.total_len > self.cfg.max_len:
             raise ValueError(
                 f"request needs {req.total_len} cache positions but "
@@ -239,18 +275,27 @@ class Engine:
                                n_prompt=req.tokens.size)
             self.metrics.event(req.req_id, "rejected", reason=e.reason)
             raise
+        # a weaker-class request may have been shed to admit this one
+        for victim in self.scheduler.drain_shed():
+            self.metrics.incr("sheds")
+            self._finish(victim.req_id, "evicted",
+                         f"shed ({victim.qos})", [])
         self.metrics.event(rid, "queued", n_prompt=req.tokens.size)
         return rid
 
     def cancel(self, req_id: int) -> bool:
-        """Cancel a queued OR running request. Running requests retire
-        (and free their slot) at the next step boundary."""
+        """Cancel a queued OR running request. A running request is
+        retired IMMEDIATELY: its KV slot and any refcounted
+        shared-prefix page are released before this returns, not at
+        the next step boundary — a frontend cancelling a hedge loser
+        (or shedding load) must get the capacity back now, and an idle
+        engine that is never stepped again must not leak the slot."""
         if self.scheduler.cancel(req_id):
             self._finish(req_id, "cancelled", "cancelled queued", [])
             return True
-        for slot in self._slots:
+        for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.req_id == req_id:
-                slot.cancel = True
+                self._retire(i, "cancelled", "cancelled running")
                 return True
         return False
 
@@ -266,10 +311,8 @@ class Engine:
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            if slot.cancel:
-                self._retire(i, "cancelled", "cancelled running")
-            elif (slot.req.deadline is not None
-                  and slot.req.deadline <= now):
+            if (slot.req.deadline is not None
+                    and slot.req.deadline <= now):
                 self._retire(i, "evicted", "deadline")
         self._admit_all()
         n_active = self._n_active
@@ -278,10 +321,10 @@ class Engine:
                                      self.scheduler.depth)
             return 0
         with annotate("serving/decode_step"):
-            nxt, idxs, self.kv.cache, self._rng = self._decode(
+            nxt, idxs, pos, self.kv.cache = self._decode(
                 self.params, self.kv.cache, self._d_toks, self._d_idxs,
-                self._d_active, self._rng)
-        self._d_toks, self._d_idxs = nxt, idxs
+                self._d_active, self._d_seeds, self._d_pos)
+        self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
         if self._defer:
             self._tok_log[self._step_no] = nxt     # fetched at retire
             toks = None
@@ -344,14 +387,15 @@ class Engine:
                     # snapshot the lane as the page, keep going
                     self._run_chunks(slot, np.asarray(req.prefix,
                                                       np.int32),
-                                     0, self.kv.zeros_lane)
+                                     0, self.kv.zeros_lane, req.seed)
                     lane = jax.tree_util.tree_map(
                         lambda x: x[slot:slot + 1], self.kv.cache)
                     self.kv.put_prefix(req.prefix, lane,
                                        len(req.prefix))
                     self.kv.acquire_prefix(req.prefix, slot)
                     install_lane, idx0 = None, len(req.prefix)
-            tok0 = self._run_chunks(slot, req.tokens, idx0, install_lane)
+            tok0 = self._run_chunks(slot, req.tokens, idx0, install_lane,
+                                    req.seed)
         self.metrics.event(req.req_id, "first_token")
         idx = idx0 + int(req.tokens.size)
         st = _Slot(req=req, first_tok=tok0, start_step=self._step_no)
@@ -369,21 +413,26 @@ class Engine:
             self._retire(slot, "done", "length")
             return
         # device-side boundary patch: the slot joins the decode batch
+        # (pos=1: the next sampled token is the request's output #1 —
+        # prefill already drew #0 from the same per-request stream)
         self._d_toks = self._d_toks.at[slot].set(
             jnp.asarray(tok0, jnp.int32))
         self._d_idxs = self._d_idxs.at[slot].set(idx)
         self._d_active = self._d_active.at[slot].set(True)
+        self._d_seeds = self._d_seeds.at[slot].set(int(req.seed))
+        self._d_pos = self._d_pos.at[slot].set(1)
         st.in_batch = True
         self._n_active += 1
 
     def _run_chunks(self, slot: int, tokens: np.ndarray, idx0: int,
-                    install_lane):
+                    install_lane, seed: int):
         """Feed ``tokens`` through the prefill executable in fixed-width
         right-padded chunks starting at cache position ``idx0``.
         ``install_lane``: batch-1 pytree written over the slot's lane
         before the FIRST chunk (zeros, or a shared-prefix page); None
         continues on the lane as-is. Returns the (device) token sampled
-        after the final chunk."""
+        after the final chunk (drawn from the request's own counter
+        stream at output position 0)."""
         C = self.cfg.prefill_chunk
         n = int(tokens.size)
         tok = None
@@ -394,10 +443,10 @@ class Engine:
             install = np.bool_(c == 0 and install_lane is not None)
             lane_arg = (install_lane if install
                         else self.kv.zeros_lane)
-            tok, self.kv.cache, self._rng = self._prefill(
+            tok, self.kv.cache = self._prefill(
                 self.params, self.kv.cache, np.int32(slot), lane_arg,
                 install, buf, np.int32(idx0 + c * C),
-                np.int32(seg.size), self._rng)
+                np.int32(seg.size), np.int32(seed))
         return tok
 
     # ---- retirement -----------------------------------------------------
@@ -441,6 +490,8 @@ class Engine:
 
     def _finish(self, req_id: int, status: str, reason: str,
                 produced: List[int]):
+        if status == "evicted" and not reason.startswith("shed"):
+            self.metrics.incr("evictions")  # sheds counted separately
         self.metrics.event(req_id, status, reason=reason,
                            n_generated=len(produced))
         self.results[req_id] = RequestResult(

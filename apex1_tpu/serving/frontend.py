@@ -1,0 +1,508 @@
+"""Fault-tolerant multi-replica serving front — N supervised engine
+replicas behind ONE submit/poll surface (ROADMAP item 2(d), built
+through the robustness lens: scale and fault tolerance as one design).
+
+- **Routing**: least-loaded replica, gated by a deadline FEASIBILITY
+  check (load x smoothed step time vs time-to-deadline — an estimate,
+  never a guarantee; an infeasible deadline is rejected at the door
+  with ``retry_after_s=0`` rather than admitted to fail).
+- **QoS admission**: per-tenant classes (`scheduler.QOS_CLASSES`).
+  At frontend capacity, a guaranteed request displaces the youngest
+  sheddable in-flight request (cancelled — the engine releases its KV
+  slot immediately — and finished as evicted/"shed"); anything else
+  gets a structured `Backpressure` (queue depth + retry-after floor).
+- **Failover**: a dead replica is restarted with in-flight
+  resubmission by its supervisor; once its restart budget is spent
+  (``failed``) the frontend drains its in-flight submissions and
+  re-routes them to surviving replicas. Stable ids + pinned seeds make
+  both paths regenerate token-identical streams.
+- **Hedged dispatch**: a guaranteed-class request with no result past
+  its TTFT budget is duplicated to a second replica; first terminal
+  result wins, the loser is cancelled. Hedging bounds TAIL latency
+  against a slow/wedged replica — it does NOT add capacity (it spends
+  it), and both executions produce the same tokens by construction, so
+  the race has one observable winner and zero observable variance.
+- **Degraded modes**: sustained overload walks ``normal → shedding →
+  degraded`` (and back). Shedding cancels sheddable-class load first;
+  degraded additionally caps new admissions' ``max_new_tokens`` to the
+  `DegradeProfile` and (when the engine factory accepts
+  ``cache_dtype``) restarts future replicas on the quantized-KV
+  profile — pressure relief instead of hard failure. EVERY transition
+  is banked as a JSON event through `ServingMetrics.transition`.
+
+Drive modes mirror `ReplicaSupervisor`: `start()` + threaded
+replicas for production/bench, `pump()` inline for deterministic
+tier-1 drills. `pump` is also the supervision tick in threaded mode
+(watchdogs, restarts, hedges, mode transitions, result collection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from apex1_tpu.serving.engine import Engine, RequestResult, \
+    derive_request_seed
+from apex1_tpu.serving.metrics import ServingMetrics
+from apex1_tpu.serving.replica import (ReplicaConfig, ReplicaSupervisor,
+                                       Submission)
+from apex1_tpu.serving.scheduler import (Backpressure, new_request_id,
+                                         qos_rank)
+
+#: overload modes, escalation order
+MODES = ("normal", "shedding", "degraded")
+
+
+@dataclasses.dataclass
+class DegradeProfile:
+    """The pressure-relief admission profile: what the frontend trades
+    away under sustained overload instead of hard-failing."""
+
+    max_new_tokens_cap: int = 32
+    cache_dtype: Optional[object] = None   # e.g. jnp.int8 — applied to
+    #  replicas (re)built while degraded, when make_engine accepts
+    #  cache_dtype (the int8-KV machinery of ops/quantized.py rides the
+    #  pool's existing dtype knob); None = length-cap only
+
+
+@dataclasses.dataclass
+class FrontendConfig:
+    """Router + admission knobs. Load fractions are measured against
+    ``n_alive_replicas * capacity_per_replica`` (in-flight requests a
+    replica absorbs: engine slots + queue)."""
+
+    n_replicas: int = 2
+    capacity_per_replica: int = 16
+    seed: int = 0                  # base for derived per-request seeds
+    hedge_after_s: float = 0.25    # guaranteed-class TTFT budget before
+    #                                a hedge fires (None disables)
+    enter_shed: float = 0.75       # load fraction -> shedding
+    enter_degraded: float = 0.95   # load fraction -> degraded
+    exit_overload: float = 0.5     # load fraction to step back down
+    sustain_rounds: int = 3        # consecutive pump rounds to flip
+    degrade: DegradeProfile = dataclasses.field(
+        default_factory=DegradeProfile)
+    replica: ReplicaConfig = dataclasses.field(
+        default_factory=ReplicaConfig)
+    retry_after_s: float = 0.05    # frontend 429 backoff floor base
+
+
+class ServingFrontend:
+    """N supervised replicas behind one submit/poll surface.
+
+    ``make_engine() -> Engine`` builds ONE replica's engine (fresh per
+    restart). Give every replica the same params/config — routing and
+    failover assume replicas are interchangeable. If the factory
+    accepts a ``cache_dtype`` kwarg, degraded-mode restarts pass the
+    profile's quantized-KV dtype through it.
+    """
+
+    def __init__(self, make_engine: Callable[..., Engine],
+                 config: Optional[FrontendConfig] = None, *,
+                 metrics: Optional[ServingMetrics] = None,
+                 fault=None):
+        self.cfg = cfg = config or FrontendConfig()
+        if cfg.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.metrics = metrics or ServingMetrics()
+        self._make_engine = make_engine
+        self._takes_cache_dtype = "cache_dtype" in \
+            inspect.signature(make_engine).parameters
+        self.mode = "normal"
+        self._above = 0                      # sustained-overload counters
+        self._below = 0
+        self.replicas: List[ReplicaSupervisor] = [
+            ReplicaSupervisor(self._build_engine, i, config=cfg.replica,
+                              metrics=self.metrics, fault=fault,
+                              seed=cfg.seed)
+            for i in range(cfg.n_replicas)]
+        self._subs: Dict[int, Submission] = {}      # all accepted, by id
+        self._live: set = set()                     # accepted, not terminal
+        self._route: Dict[int, List[int]] = {}      # rid -> replica ids
+        self._shed_rids: set = set()                # relabel cancelled->shed
+        self._hedged: set = set()
+        self._terminal: Dict[int, RequestResult] = {}
+        self._threaded = False
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ServingFrontend":
+        """Spawn every replica's serve thread (production mode); keep
+        calling `pump()` as the supervision tick."""
+        self._threaded = True
+        for rep in self.replicas:
+            rep.start()
+        return self
+
+    def stop(self) -> None:
+        for rep in self.replicas:
+            rep.stop()
+
+    # ---- submission -----------------------------------------------------
+
+    def submit(self, tokens, max_new_tokens: int, *,
+               qos: str = "best_effort", tenant: Optional[str] = None,
+               deadline: Optional[float] = None, prefix=None,
+               seed: Optional[int] = None,
+               req_id: Optional[int] = None) -> int:
+        """Admit + route one request; returns its id (poll with it).
+        Raises `Backpressure` (structured) when admission control says
+        no: frontend at capacity with nothing sheddable, sheddable
+        class refused while shedding/degraded, or no replica can
+        feasibly meet the deadline."""
+        qos_rank(qos)                        # validate loudly
+        now = time.monotonic()
+        rid = new_request_id() if req_id is None else int(req_id)
+        if seed is None:
+            # pinned HERE, not per engine: failover must regenerate the
+            # identical stream on ANY replica
+            seed = derive_request_seed(self.cfg.seed, rid)
+        seed = int(seed) & 0x7FFFFFFF    # int32 counter-key contract
+        if self.mode in ("shedding", "degraded") and qos == "sheddable":
+            raise Backpressure(
+                f"{self.mode}: sheddable admissions refused",
+                queue_depth=self.total_inflight,
+                retry_after_s=self._retry_after())
+        if self.mode == "degraded":
+            capped = min(int(max_new_tokens),
+                         self.cfg.degrade.max_new_tokens_cap)
+            if capped < int(max_new_tokens):
+                self.metrics.incr("degraded_admissions")
+            max_new_tokens = capped
+        # feasibility BEFORE displacement: an admission that is going
+        # to be rejected as infeasible must not first evict an
+        # innocent sheddable victim for nothing (review finding)
+        rep = self._pick_replica(max_new_tokens, deadline, now)
+        if rep is None:
+            raise Backpressure(
+                "no replica can feasibly meet the deadline",
+                queue_depth=self.total_inflight, retry_after_s=0.0)
+        if self.total_inflight >= self.capacity:
+            if qos == "guaranteed" and self._displace_sheddable():
+                pass                         # freed a unit of capacity
+            else:
+                raise Backpressure(
+                    f"frontend at capacity ({self.capacity})",
+                    queue_depth=self.total_inflight,
+                    retry_after_s=self._retry_after())
+        sub = Submission(
+            tokens=np.asarray(tokens, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens), req_id=rid,
+            seed=int(seed), prefix=prefix, deadline=deadline, qos=qos,
+            tenant=tenant, submitted_at=now)
+        self._subs[rid] = sub
+        self._live.add(rid)
+        self._route[rid] = [rep.replica_id]
+        rep.submit_sub(sub)
+        return rid
+
+    def cancel(self, req_id: int) -> bool:
+        if req_id in self._terminal:
+            return False
+        routed = self._route.get(req_id)
+        if not routed:
+            return False
+        for r in routed:
+            self.replicas[r].cancel(req_id)
+        return True
+
+    # ---- results --------------------------------------------------------
+
+    def poll(self, req_id: int) -> Optional[RequestResult]:
+        """Terminal result, or None while in flight. (Collection
+        happens in `pump`; poll only reads.)"""
+        return self._terminal.get(req_id)
+
+    def pop_result(self, req_id: int) -> Optional[RequestResult]:
+        """Remove and return a terminal result, dropping every trace of
+        the request — the long-running server's pressure valve (pair
+        with `metrics.drain()`); `_terminal`/`_subs` are otherwise
+        bounded only by requests ever served."""
+        res = self._terminal.pop(req_id, None)
+        if res is not None:
+            self._subs.pop(req_id, None)
+            self._shed_rids.discard(req_id)
+            self._hedged.discard(req_id)
+            self._route.pop(req_id, None)
+        return res
+
+    @property
+    def results(self) -> Dict[int, RequestResult]:
+        return dict(self._terminal)
+
+    # ---- the supervision tick -------------------------------------------
+
+    def pump(self, rounds: int = 1) -> None:
+        """One supervision round x ``rounds``: drive replicas (inline
+        mode), fire watchdogs, restart/fail-over dead replicas, collect
+        results, hedge blown TTFT budgets, walk the overload ladder."""
+        for _ in range(rounds):
+            for rep in self.replicas:
+                if self._threaded:
+                    rep.check()
+                elif rep.state in ("new", "alive"):
+                    rep.pump(1)
+            self._recover_dead()
+            self._collect()
+            self._hedge_blown_budgets()
+            self._update_mode()
+            if self._threaded:
+                time.sleep(0.001)            # supervision cadence, not
+        #                                      the serve loop's
+
+    def run_until_drained(self, *, timeout_s: float = 60.0,
+                          max_rounds: int = 100_000
+                          ) -> Dict[int, RequestResult]:
+        """Pump until every accepted request is terminal (drills /
+        benches). Raises on timeout — a drained=False return would just
+        get asserted anyway."""
+        t0 = time.monotonic()
+        for _ in range(max_rounds):
+            if not self._live:
+                return self.results
+            if time.monotonic() - t0 > timeout_s:
+                break
+            self.pump()
+        if self._live:
+            raise TimeoutError(
+                f"undrained after {time.monotonic() - t0:.1f}s "
+                f"(budget {timeout_s}s/{max_rounds} rounds): "
+                f"{sorted(self._live)} "
+                f"(states: {[r.state for r in self.replicas]})")
+        return self.results
+
+    # ---- internals ------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        n_live = sum(r.state in ("new", "alive") for r in self.replicas)
+        return max(1, n_live) * self.cfg.capacity_per_replica
+
+    @property
+    def total_inflight(self) -> int:
+        return len(self._live)
+
+    def _retry_after(self) -> float:
+        return self.cfg.retry_after_s * max(
+            1.0, self.total_inflight / self.capacity)
+
+    def _build_engine(self) -> Engine:
+        prof = self.cfg.degrade
+        if (self.mode == "degraded" and self._takes_cache_dtype
+                and prof.cache_dtype is not None):
+            return self._make_engine(cache_dtype=prof.cache_dtype)
+        return self._make_engine()
+
+    def _alive(self) -> List[ReplicaSupervisor]:
+        return [r for r in self.replicas if r.state in ("new", "alive")]
+
+    def _pick_replica(self, max_new_tokens: int,
+                      deadline: Optional[float], now: float
+                      ) -> Optional[ReplicaSupervisor]:
+        """Least-loaded alive replica passing the deadline-feasibility
+        estimate; least-loaded overall when the deadline is None or no
+        replica has timing history yet."""
+        alive = self._alive()
+        if not alive:
+            return None
+        ranked = sorted(alive, key=lambda r: (r.load, r.replica_id))
+        if deadline is None:
+            return ranked[0]
+        left = deadline - now
+        for rep in ranked:
+            est = (rep.load + 1) * max_new_tokens * rep.step_ewma
+            if rep.step_ewma == 0.0 or est <= left:
+                return rep
+        return None
+
+    def _displace_sheddable(self) -> bool:
+        """Shed the YOUNGEST in-flight sheddable request to admit a
+        guaranteed one — the QoS contract's teeth: sheddable capacity
+        is borrowed, guaranteed capacity is owed. A victim already
+        being shed (cancelled, result not yet collected) is skipped —
+        it must not 'free' the same unit of capacity twice under a
+        guaranteed burst (review finding)."""
+        victim = None
+        for rid in self._live:
+            sub = self._subs[rid]
+            if sub.qos != "sheddable" or rid in self._shed_rids:
+                continue
+            if victim is None or sub.submitted_at > victim.submitted_at:
+                victim = sub
+        if victim is None:
+            return False
+        self._shed(victim, "shed (displaced by guaranteed)")
+        return True
+
+    def _shed(self, sub: Submission, reason: str):
+        self._shed_rids.add(sub.req_id)
+        self.metrics.incr("sheds")
+        self.metrics.transition("shed", req=sub.req_id, qos=sub.qos,
+                                reason=reason)
+        for r in self._route.get(sub.req_id, []):
+            self.replicas[r].cancel(sub.req_id)
+
+    def _recover_dead(self):
+        for rep in self.replicas:
+            if rep.state != "dead":
+                continue
+            if not rep.restart():
+                # budget spent: fail over its in-flight work
+                subs = rep.drain_inflight()
+                targets = self._alive()
+                for sub in subs:
+                    # a hedge leg may already be running elsewhere —
+                    # re-routing would double-decode the same id on
+                    # one engine; dropping the failed leg suffices
+                    others = [r for r in self._route.get(sub.req_id, [])
+                              if r != rep.replica_id
+                              and self.replicas[r].state
+                              in ("new", "alive")]
+                    if others:
+                        continue
+                    if not targets:
+                        self._terminal[sub.req_id] = RequestResult(
+                            req_id=sub.req_id, status="evicted",
+                            tokens=np.zeros((0,), np.int32),
+                            reason="no surviving replicas")
+                        self._live.discard(sub.req_id)
+                        continue
+                    tgt = min(targets,
+                              key=lambda r: (r.load, r.replica_id))
+                    self._route.setdefault(sub.req_id, []).append(
+                        tgt.replica_id)
+                    tgt.submit_sub(sub)
+                    self.metrics.incr("retries")
+                self.metrics.transition(
+                    "failover", source=rep.replica_id,
+                    rerouted=[s.req_id for s in subs])
+
+    def _collect(self):
+        # sweep settled hedge/cancel races: a loser leg publishes its
+        # cancelled result an iteration AFTER the winner was collected —
+        # keep draining until every leg has either yielded its result
+        # or provably never will (nothing pending in that supervisor),
+        # THEN drop the route entry; deleting earlier would strand the
+        # late result in the supervisor's dict forever (review finding)
+        for rid in [r for r in self._route if r in self._terminal]:
+            if all(self.replicas[r].pop_result(rid) is not None
+                   or not self.replicas[r].pending(rid)
+                   for r in self._route[rid]):
+                del self._route[rid]
+        for rid in list(self._live):
+            for r in self._route.get(rid, []):
+                res = self.replicas[r].pop_result(rid)
+                if res is None:
+                    continue
+                if rid in self._shed_rids and res.status == "cancelled":
+                    res = dataclasses.replace(
+                        res, status="evicted", reason="shed (overload)")
+                self._terminal[rid] = res
+                self._live.discard(rid)
+                # hedge race settled: cancel the other leg(s)
+                for other in self._route.get(rid, []):
+                    if other != r:
+                        self.replicas[other].cancel(rid)
+                        self.replicas[other].pop_result(rid)
+                if rid in self._hedged and r != self._route[rid][0]:
+                    self.metrics.incr("hedges_won")
+                break
+
+    def _hedge_blown_budgets(self):
+        if self.cfg.hedge_after_s is None:
+            return
+        now = time.monotonic()
+        for rid in list(self._live):
+            sub = self._subs[rid]
+            if sub.qos != "guaranteed" or rid in self._hedged:
+                continue
+            if now - sub.submitted_at <= self.cfg.hedge_after_s:
+                continue
+            routed = set(self._route[rid])
+            # the budget is a TTFT budget: a primary that has already
+            # streamed the first token is slow-but-healthy, and a
+            # duplicate full decode would burn the very capacity
+            # hedging protects — hedge only while NO leg has produced
+            # a first token (review finding)
+            if any(self.replicas[r].first_token_seen(rid)
+                   for r in routed):
+                continue
+            # exclude EVERY replica already on the route (a failover
+            # may have appended the survivor) — hedging onto a replica
+            # that already serves the request would double-decode it
+            # (review finding)
+            primary = self._route[rid][0]
+            others = [r for r in self._alive()
+                      if r.replica_id not in routed]
+            if not others:
+                continue
+            tgt = min(others, key=lambda r: (r.load, r.replica_id))
+            self._hedged.add(rid)
+            self._route[rid].append(tgt.replica_id)
+            tgt.submit_sub(sub)
+            self.metrics.incr("hedges_fired")
+            self.metrics.transition("hedge", req=rid, primary=primary,
+                                    secondary=tgt.replica_id)
+
+    def _update_mode(self):
+        """The overload ladder. Escalation requires the load fraction
+        to hold above the threshold for ``sustain_rounds`` consecutive
+        pump rounds (a burst is not an overload); de-escalation is
+        symmetric. Every flip is banked."""
+        frac = self.total_inflight / self.capacity
+        cfg = self.cfg
+        enter = (cfg.enter_shed if self.mode == "normal"
+                 else cfg.enter_degraded)
+        if self.mode != "degraded" and frac >= enter:
+            self._above += 1
+        else:
+            self._above = 0
+        if self.mode != "normal" and frac <= cfg.exit_overload:
+            self._below += 1
+        else:
+            self._below = 0
+        if self._above >= cfg.sustain_rounds:
+            nxt = MODES[MODES.index(self.mode) + 1]
+            self._flip_mode(nxt, frac)
+            self._above = 0
+            if nxt == "shedding":
+                # first relief valve: sheddable-class load goes first
+                for rid in list(self._live):
+                    sub = self._subs[rid]
+                    if (sub.qos == "sheddable"
+                            and rid not in self._shed_rids):
+                        self._shed(sub, "shed (overload)")
+        elif self._below >= cfg.sustain_rounds:
+            self._flip_mode("normal", frac)
+            self._below = 0
+
+    def _flip_mode(self, new_mode: str, frac: float):
+        old, self.mode = self.mode, new_mode
+        fields = dict(frm=old, to=new_mode, load_fraction=round(frac, 4),
+                      inflight=self.total_inflight,
+                      capacity=self.capacity)
+        if new_mode == "degraded":
+            fields["max_new_tokens_cap"] = \
+                self.cfg.degrade.max_new_tokens_cap
+            fields["cache_dtype"] = str(self.cfg.degrade.cache_dtype)
+        self.metrics.transition("mode", **fields)
+
+    # ---- introspection --------------------------------------------------
+
+    def replica_states(self) -> List[str]:
+        return [r.state for r in self.replicas]
+
+    def summary(self) -> dict:
+        s = self.metrics.summary()
+        s["mode"] = self.mode
+        s["replicas"] = {
+            r.replica_id: {"state": r.state, "restarts": r.restarts,
+                           "generation": r.generation,
+                           "engines_built": r.engines_built,
+                           "steps": r.steps}
+            for r in self.replicas}
+        return s

@@ -12,7 +12,15 @@ p50/p99 time-to-first-token, and mean slot occupancy from it.
 
 Schema (`docs/serving.md` § Engine): every event line is
 ``{"event", "req", "t", **fields}``; per-step samples are
-``{"event": "step", "t", "active", "queue_depth", "occupancy"}``.
+``{"event": "step", "t", "active", "queue_depth", "occupancy"}``;
+SYSTEM transitions (degraded-mode flips, replica restarts — no single
+request owns them) are ``{"event", "t", **fields}`` with no ``req``
+key, banked through `transition` and kept in ``transitions`` for the
+drills to assert on.
+
+Failure-path counters (`incr`) ride `summary()["counters"]`: retries,
+hedges fired/won, sheds, evictions, replica restarts — the numbers an
+operator pages on, always present (0 when the path never fired).
 """
 
 from __future__ import annotations
@@ -28,6 +36,10 @@ from apex1_tpu.utils.observability import MetricsLogger
 
 #: terminal request states
 TERMINAL = ("done", "evicted", "cancelled", "rejected")
+
+#: failure-path counters always present in summary()["counters"]
+FAILURE_COUNTERS = ("retries", "hedges_fired", "hedges_won", "sheds",
+                    "evictions", "replica_restarts")
 
 
 @dataclasses.dataclass
@@ -71,6 +83,8 @@ class ServingMetrics:
     def __init__(self, logger: Optional[MetricsLogger] = None):
         self.logger = logger
         self.records: Dict[int, RequestRecord] = {}
+        self.counters: Dict[str, int] = {}
+        self.transitions: list = []
         # step samples fold into RUNNING aggregates (count / occupancy
         # sum / peak queue) — a long-lived engine steps indefinitely,
         # so per-step dicts would leak host memory (review finding);
@@ -130,6 +144,28 @@ class ServingMetrics:
                                  k: v for k, v in fields.items()}})
         return rec
 
+    def incr(self, name: str, n: int = 1) -> None:
+        """Bump a failure-path counter (see `FAILURE_COUNTERS`; other
+        names are allowed — they appear in the counters dict too)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def transition(self, name: str, now: Optional[float] = None,
+                   **fields) -> dict:
+        """Bank a SYSTEM event (no owning request): degraded-mode
+        flips, replica deaths/restarts, hedge dispatches. Every
+        transition is a JSON line when a logger is wired AND kept in
+        ``transitions`` — the overload drill asserts each degradation
+        step left a banked record."""
+        now = time.monotonic() if now is None else now
+        rec = {"event": str(name), "t": now - self._t0, **fields}
+        with self._lock:
+            self.transitions.append(rec)
+            if self.logger is not None:
+                self._event_seq += 1
+                self.logger.log(self._event_seq, rec)
+        return rec
+
     def step_sample(self, active: int, max_slots: int,
                     queue_depth: int) -> None:
         """One engine-step occupancy sample (drives mean occupancy and
@@ -164,8 +200,10 @@ class ServingMetrics:
         the engine's wall clock, TTFT percentiles, occupancy."""
         with self._lock:
             recs = list(self.records.values())
+            counters = dict(self.counters)
         done = [r for r in recs if r.status == "done"]
         ttfts = sorted(r.ttft for r in recs if r.ttft is not None)
+        lats = sorted(r.latency for r in recs if r.latency is not None)
         gen = sum(r.n_generated for r in recs)
         wall = max(time.monotonic() - self._t0, 1e-9)
         out = {
@@ -177,10 +215,17 @@ class ServingMetrics:
             "generated_tokens": int(gen),
             "tokens_per_sec": gen / wall,
             "steps": self._step_n,
+            # the failure-path record: named counters are ALWAYS
+            # present (0 = the path never fired — an asserted property,
+            # not missing data); ad-hoc incr() names ride along
+            "counters": {**{k: 0 for k in FAILURE_COUNTERS}, **counters},
         }
         if ttfts:
             out["ttft_p50_ms"] = 1e3 * float(np.percentile(ttfts, 50))
             out["ttft_p99_ms"] = 1e3 * float(np.percentile(ttfts, 99))
+        if lats:
+            out["latency_p50_ms"] = 1e3 * float(np.percentile(lats, 50))
+            out["latency_p99_ms"] = 1e3 * float(np.percentile(lats, 99))
         if self._step_n:
             out["mean_occupancy"] = self._occ_sum / self._step_n
             out["peak_queue_depth"] = self._peak_queue

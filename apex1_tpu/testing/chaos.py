@@ -23,11 +23,25 @@ Fault classes (one helper per class, composable):
   `resilience.TransientError` for its first N calls — verifies
   retry/backoff policies actually retry, back off, and give up on
   schedule.
+- **serving faults** (`ServingFault` family, host): hooks the
+  `serving.replica.ReplicaSupervisor` calls at its submit/step
+  boundaries — `ReplicaKill` (crash at an exact step), `ReplicaHang`
+  (stall past the watchdog), `SlowReplica` (straggler injecting
+  per-step delay), `PoisonPill` (a marked request whose ADMISSION
+  kills the replica, every time, on every replica — the quarantine
+  fixture). `kill_schedule` derives (replica, step) picks from a seed
+  for the bench's chaos-on mode. `toy_decoder` is the matching
+  fixture model: a deterministic history-dependent cached decoder that
+  compiles in milliseconds, so multi-replica drills stay cheap.
 
 ``python -m apex1_tpu.testing.chaos --smoke`` runs the two headline
-recoveries end-to-end (injected-NaN rollback + corrupt-checkpoint
-fallback scan) in <30 s on CPU — the ``== chaos smoke ==`` step in
-``tools/check_all.sh``.
+TRAINING recoveries end-to-end (injected-NaN rollback +
+corrupt-checkpoint fallback scan) in <30 s on CPU — the
+``== chaos smoke ==`` step in ``tools/check_all.sh``;
+``--serve-smoke`` runs the SERVING headline (2-replica frontend,
+replica killed mid-stream → every request completes token-identical
+to an uninterrupted run + poison-pill quarantine) in <10 s — the
+``== serving chaos smoke ==`` step.
 """
 
 from __future__ import annotations
@@ -44,6 +58,8 @@ from apex1_tpu.resilience.retry import TransientError, _mix32
 __all__ = [
     "poison_at_steps", "poison_tree_at_steps", "truncate_checkpoint",
     "bitflip_checkpoint", "sigterm_self_at", "Flaky", "TransientError",
+    "ServingFault", "ChaosSchedule", "ReplicaKill", "ReplicaHang",
+    "SlowReplica", "PoisonPill", "kill_schedule", "toy_decoder",
 ]
 
 
@@ -163,6 +179,179 @@ class Flaky:
         return self.fn(*args, **kwargs)
 
 
+# -- serving faults ---------------------------------------------------------
+
+class ServingFault:
+    """Hook surface `serving.replica.ReplicaSupervisor` calls at its
+    two fault boundaries. The base class is a no-op; subclasses raise
+    or sleep at EXACT (replica, step) coordinates — deterministic, so
+    "kill a replica mid-stream, every token bit-identical" is an
+    assertable property, not a flaky one."""
+
+    def on_step(self, replica_id: int, step: int) -> None:
+        """Called once per serve iteration, before the engine step."""
+
+    def on_submit(self, replica_id: int, sub) -> None:
+        """Called just before a submission is admitted to the engine
+        (``sub`` is a `serving.replica.Submission`)."""
+
+
+class ChaosSchedule(ServingFault):
+    """Compose several faults; each sees every hook."""
+
+    def __init__(self, faults: Sequence[ServingFault]):
+        self.faults = list(faults)
+
+    def on_step(self, replica_id, step):
+        for f in self.faults:
+            f.on_step(replica_id, step)
+
+    def on_submit(self, replica_id, sub):
+        for f in self.faults:
+            f.on_submit(replica_id, sub)
+
+
+class ReplicaKill(ServingFault):
+    """Crash replica ``replica`` at its serve step ``at_step`` — once
+    (the restarted generation starts its step count fresh but the
+    fault has already fired; ``repeat=True`` kills every generation,
+    the crash-loop fixture)."""
+
+    def __init__(self, replica: int, at_step: int, *,
+                 repeat: bool = False):
+        self.replica = int(replica)
+        self.at_step = int(at_step)
+        self.repeat = bool(repeat)
+        self.fired = 0
+
+    def on_step(self, replica_id, step):
+        if replica_id != self.replica or step != self.at_step:
+            return
+        if self.fired and not self.repeat:
+            return
+        self.fired += 1
+        from apex1_tpu.serving.replica import ReplicaKilled
+        raise ReplicaKilled(
+            f"chaos: killed replica {replica_id} at step {step}")
+
+
+class ReplicaHang(ServingFault):
+    """Stall replica ``replica`` at step ``at_step`` for ``hang_s``
+    (once) — the watchdog-path fixture: the step eventually returns,
+    but past the supervision deadline, which is exactly the signature
+    of a wedged-then-recovered decode the supervisor must NOT trust."""
+
+    def __init__(self, replica: int, at_step: int, *,
+                 hang_s: float = 0.2):
+        self.replica = int(replica)
+        self.at_step = int(at_step)
+        self.hang_s = float(hang_s)
+        self.fired = 0
+
+    def on_step(self, replica_id, step):
+        if (replica_id == self.replica and step == self.at_step
+                and not self.fired):
+            self.fired += 1
+            import time
+            time.sleep(self.hang_s)
+
+
+class SlowReplica(ServingFault):
+    """Straggler model: ``delay_s`` injected into every step of
+    ``replica`` in ``[from_step, to_step)`` — below the watchdog
+    threshold, so the replica stays 'healthy' while its latency blows
+    hedging budgets (the hedged-dispatch fixture)."""
+
+    def __init__(self, replica: int, *, delay_s: float = 0.02,
+                 from_step: int = 0, to_step: Optional[int] = None):
+        self.replica = int(replica)
+        self.delay_s = float(delay_s)
+        self.from_step = int(from_step)
+        self.to_step = to_step
+
+    def on_step(self, replica_id, step):
+        if replica_id != self.replica or step < self.from_step:
+            return
+        if self.to_step is not None and step >= self.to_step:
+            return
+        import time
+        time.sleep(self.delay_s)
+
+
+class PoisonPill(ServingFault):
+    """A request whose ADMISSION deterministically kills the replica —
+    every admission, every replica, every restart: the fixture for the
+    supervisor's quarantine ladder (resubmit -> kill again -> evicted
+    as poisoned instead of crash-looping forever). Marked by a token:
+    any request whose prompt contains ``poison_token`` is the pill."""
+
+    def __init__(self, poison_token: int):
+        self.poison_token = int(poison_token)
+        self.fired = 0
+
+    def on_submit(self, replica_id, sub):
+        if self.poison_token in np.asarray(sub.tokens).tolist():
+            self.fired += 1
+            from apex1_tpu.serving.replica import PoisonedRequest
+            raise PoisonedRequest(
+                f"chaos: poison token {self.poison_token} in request "
+                f"{sub.req_id}", req_id=sub.req_id)
+
+
+def kill_schedule(seed: int, *, n_replicas: int, lo: int, hi: int
+                  ) -> ReplicaKill:
+    """Seed-derived `ReplicaKill`: replica and step picked by the same
+    avalanche hash the rest of the chaos harness uses, so a bench's
+    ``--chaos`` run is reproducible from its seed alone."""
+    if hi <= lo:
+        raise ValueError(f"need hi > lo, got [{lo}, {hi})")
+    replica = _mix32(seed ^ 0xC0FFEE) % int(n_replicas)
+    step = lo + _mix32(seed ^ 0xDEAD10C) % (hi - lo)
+    return ReplicaKill(replica, step)
+
+
+def toy_decoder(vocab_size: int = 61):
+    """A deterministic cached toy decoder ``(apply_fn, make_cache,
+    params)`` with the `models.generate` decoder contract — history-
+    dependent logits (an avalanche hash of the causal prefix sum), so
+    stale-cache and lost-stream bugs change tokens, but compiles in
+    milliseconds: multi-replica chaos drills pay supervisor cost, not
+    XLA cost. The cache stores one small integer per position, so the
+    int8 ``cache_dtype`` profile is EXACT here (values < 128)."""
+    import jax
+    import jax.numpy as jnp
+
+    def make_cache(batch: int, max_len: int, dtype=None):
+        dt = jnp.float32 if dtype is None else dtype
+        return {"toy": {"h": jnp.zeros((batch, 1, max_len, 1), dt)}}
+
+    def apply_fn(params, tokens, cache, cache_index, positions=None,
+                 chunk_decode=False):
+        h = cache["toy"]["h"]                       # (B, 1, Smax, 1)
+        B, S = tokens.shape
+        idx = jnp.asarray(cache_index, jnp.int32)
+        vals = (tokens + 1).astype(h.dtype).reshape(B, 1, S, 1)
+        zero = jnp.int32(0)
+        h = jax.lax.dynamic_update_slice(h, vals, (zero, zero, idx, zero))
+        # causal-prefix sum per query: pos <= idx + j (the chunk-verify
+        # horizon), over the UPDATED cache so each query sees itself —
+        # pad/stale residue beyond the horizon never enters
+        pos = jnp.arange(h.shape[2], dtype=jnp.int32)
+        qpos = idx + jnp.arange(S, dtype=jnp.int32)
+        mask = (pos[None, :] <= qpos[:, None]).astype(jnp.float32)
+        hv = h[:, 0, :, 0].astype(jnp.float32)
+        s = jnp.einsum("bp,sp->bs", hv, mask)       # (B, S)
+        su = (s.astype(jnp.uint32) * params["w"].astype(jnp.uint32))
+        v = jnp.arange(vocab_size, dtype=jnp.uint32)
+        logits = -(((su[..., None] * jnp.uint32(2654435761)
+                     + (v + 1) * jnp.uint32(40499))
+                    % jnp.uint32(977)).astype(jnp.float32))
+        return logits, {"toy": {"h": h}}
+
+    params = {"w": jnp.ones((), jnp.uint32)}
+    return apply_fn, make_cache, params
+
+
 # -- smoke entry point (check_all.sh `== chaos smoke ==`) -------------------
 
 def _smoke() -> int:
@@ -231,15 +420,110 @@ def _smoke() -> int:
     return 0
 
 
+def _serve_smoke() -> int:
+    """The serving headline recoveries, toy decoder, CPU, <10 s:
+    (1) 2-replica frontend, replica killed mid-stream → restarted with
+    a fresh engine (exactly two executables per generation), in-flight
+    requests resubmitted → every request completes TOKEN-IDENTICAL to
+    an uninterrupted single-engine run, at temperature > 0 (the pinned
+    per-request seed, not greedy luck); (2) a poison-pill request that
+    kills its replica on every admission is quarantined after the
+    configured threshold instead of crash-looping."""
+    from apex1_tpu.testing import (enable_persistent_compilation_cache,
+                                   force_virtual_cpu_devices)
+
+    force_virtual_cpu_devices(1)
+    # every fresh engine (replica, restart, reference) re-traces the
+    # same two tiny executables; the persistent cache collapses the
+    # repeat XLA compiles so the drill's cost is supervision, not XLA
+    enable_persistent_compilation_cache()
+
+    from apex1_tpu.serving import (Engine, EngineConfig, FrontendConfig,
+                                   ReplicaConfig, ServingFrontend)
+
+    apply_fn, make_cache, params = toy_decoder()
+    ecfg = EngineConfig(max_slots=3, max_len=48, prefill_chunk=4,
+                        vocab_size=61, temperature=0.8, seed=7)
+
+    def make_engine():
+        return Engine(apply_fn, make_cache, params, ecfg)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 61, (n,)).astype(np.int32)
+               for n in (3, 7, 5, 9, 4, 6)]
+
+    kill = kill_schedule(seed=20260804, n_replicas=2, lo=4, hi=9)
+    front = ServingFrontend(
+        make_engine,
+        FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                       hedge_after_s=None,
+                       replica=ReplicaConfig(watchdog_s=30.0)),
+        fault=kill)
+    rids = [front.submit(p, max_new_tokens=6 + i % 4)
+            for i, p in enumerate(prompts)]
+    front.run_until_drained(timeout_s=60.0)
+
+    ref = make_engine()
+    for i, (p, rid) in enumerate(zip(prompts, rids)):
+        sub = front._subs[rid]
+        assert front.poll(rid).status == "done", front.poll(rid)
+        rr = ref.submit(p, max_new_tokens=sub.max_new_tokens,
+                        seed=sub.seed)
+        ref.run(max_steps=100)
+        got, want = front.poll(rid).tokens, ref.results[rr].tokens
+        assert np.array_equal(got, want), \
+            f"req {rid}: {got} != uninterrupted {want}"
+    restarts = front.metrics.summary()["counters"]["replica_restarts"]
+    assert kill.fired == 1 and restarts == 1, (kill.fired, restarts)
+    for rep in front.replicas:
+        assert rep.trace_counts() == {"prefill": 1, "decode": 1}, \
+            (rep.replica_id, rep.trace_counts())
+    print(f"serving chaos smoke [1/2] OK: replica {kill.replica} killed "
+          f"at step {kill.at_step} -> restarted (fresh 2-executable "
+          f"engine), {len(rids)} streams token-identical to the "
+          f"uninterrupted run at temperature 0.8")
+
+    # (2) poison-pill quarantine: admission kills the replica every
+    # time; after poison_threshold deaths the request is evicted as
+    # poisoned and the replica serves on
+    pill = PoisonPill(poison_token=60)
+    front2 = ServingFrontend(
+        make_engine,
+        FrontendConfig(n_replicas=1, capacity_per_replica=8,
+                       hedge_after_s=None,
+                       replica=ReplicaConfig(watchdog_s=30.0,
+                                             max_restarts=5,
+                                             poison_threshold=1)),
+        fault=pill)
+    good = front2.submit(prompts[0], max_new_tokens=5)
+    bad = front2.submit(np.asarray([60, 1, 2], np.int32),
+                        max_new_tokens=5)
+    front2.run_until_drained(timeout_s=60.0)
+    assert front2.poll(good).status == "done"
+    res = front2.poll(bad)
+    assert res.status == "evicted" and "poisoned" in res.reason, res
+    assert pill.fired == 2, pill.fired      # threshold + 1 admissions
+    print(f"serving chaos smoke [2/2] OK: poison pill killed its "
+          f"replica {pill.fired}x -> quarantined ('{res.reason}'), "
+          f"good request still served")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
-                    help="run the two headline recovery paths (CPU, <30s)")
+                    help="run the two headline training recovery paths "
+                         "(CPU, <30s)")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    help="run the serving recovery paths: replica-kill "
+                         "token parity + poison quarantine (CPU, <10s)")
     args = ap.parse_args(argv)
     if args.smoke:
         return _smoke()
+    if args.serve_smoke:
+        return _serve_smoke()
     ap.print_help()
     return 0
 

@@ -295,6 +295,10 @@ class TestGradAccumulation:
         assert seen["a1"] == seen["a4"]
 
 
+@pytest.mark.slow  # 870s-cap headroom (~10s): packed-data x gpt2-train
+# COMPOSITION; halves pinned tier-1 — pack_documents plan/fill units
+# (test_runtime) and gpt2 train-step parity (TestEndToEnd);
+# check_all --all
 def test_gpt2_packed_equals_separate():
     """GPT-2 packed batches (segment ids + per-row learned positions)
     reproduce each document's standalone forward — ≙ fmha cu_seqlens."""

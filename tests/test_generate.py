@@ -96,6 +96,11 @@ class TestGPT2Generate:
 
 
 class TestT5Generate:
+    @pytest.mark.slow  # 870s-cap headroom (~24s): T5 x generate full
+    # cached-decode parity COMPOSITION; halves pinned tier-1 — T5 model
+    # kernel parity (test_t5::test_pallas_xla_parity), T5 prefill-vs-
+    # uncached parity (test_multi_token_prefill_matches_uncached), and
+    # cached-decode parity on gpt2/llama; full run via check_all --all
     def test_cached_decode_matches_full_forward(self):
         from apex1_tpu.models.generate import t5_generate
         from apex1_tpu.models.t5 import T5, T5Config
@@ -168,6 +173,9 @@ class TestT5Generate:
                                    np.asarray(full[:, -1]),
                                    rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow  # 870s-cap headroom (~15s): beam x T5
+    # COMPOSITION; halves pinned tier-1 — beam search on gpt2
+    # (TestBeamSearch) and T5 decode parity above; check_all --all
     def test_beam_matches_hand_built_beam_path(self):
         """t5_generate(num_beams=K) must equal beam_search driven
         through an INDEPENDENTLY constructed cached-decode closure
@@ -446,6 +454,11 @@ class TestRaggedGenerate:
                         vocab_size=cfg.vocab_size)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.slow  # 870s-cap headroom (~11s): MoE x beam
+    # COMPOSITION; halves pinned tier-1 — beam-1==greedy on dense
+    # (TestBeamSearch::test_beam1_equals_greedy family) and MoE
+    # generate via test_ragged_moe_pad_content_invariance;
+    # check_all --all
     def test_moe_beam1_equals_greedy(self):
         """docs/serving.md matrix: MoE x beam — num_beams=1 beam search
         over the MoE decoder reduces to its greedy decode."""
@@ -536,7 +549,12 @@ class TestPrefixCaching:
     many generations from it — tokens must equal the flat (prefix +
     prompt in one go) decode exactly."""
 
-    @pytest.mark.parametrize("family", ["gpt2", "llama"])
+    # llama variant to @slow for 870s-cap headroom (~19s): prefix-cache
+    # x llama COMPOSITION; halves pinned tier-1 — the gpt2 variant
+    # (same prefix machinery) and llama GQA cached-decode parity
+    # (TestLlamaGenerate); full run via check_all --all
+    @pytest.mark.parametrize("family", [
+        "gpt2", pytest.param("llama", marks=pytest.mark.slow)])
     def test_continuation_matches_flat_prompt(self, family):
         if family == "gpt2":
             cfg = GPT2Config.tiny(policy=get_policy("O0"), max_seq_len=64)

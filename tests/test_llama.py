@@ -94,6 +94,10 @@ def test_tp_sharded_forward_matches_single(devices):
                                np.asarray(out_single), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # 870s-cap headroom (~10s): llama x context-parallel
+# COMPOSITION; halves pinned tier-1 — ring-attention parity/grads on
+# the virtual mesh (test_ring_attention, incl. cp=2) and llama solo
+# loss/grads; the 4-axis dryrun + check_all --all run the composition
 def test_context_parallel_matches_global(devices):
     """Llama block with ring attention over cp=4 ≡ unsharded model."""
     cfg = LlamaConfig.tiny()

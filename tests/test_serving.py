@@ -18,8 +18,10 @@ from apex1_tpu.core.policy import get_policy
 from apex1_tpu.models.generate import generate, gpt2_decoder
 from apex1_tpu.models.gpt2 import GPT2, GPT2Config
 from apex1_tpu.runtime import RequestFeeder
-from apex1_tpu.serving import (Backpressure, Engine, EngineConfig, KVPool,
-                               Request, Scheduler)
+from apex1_tpu.serving import (Backpressure, Engine, EngineConfig,
+                               FrontendConfig, KVPool, ReplicaConfig,
+                               Request, Scheduler, ServingFrontend,
+                               ServingMetrics)
 
 
 @pytest.fixture(scope="module")
@@ -95,6 +97,13 @@ class TestContinuousBatching:
         want = full[:list(full).index(eos) + 1]
         np.testing.assert_array_equal(res.tokens, want)
 
+    @pytest.mark.slow  # 870s-cap headroom (~13s): prefix-page x engine
+    # full-parity COMPOSITION; halves pinned tier-1 — page refcount
+    # machinery (TestPrefixRefcounts + TestCancelReleasesImmediately),
+    # the prefix install/admission path with token parity
+    # (test_tail_chunk_pad_never_clamps_past_max_len submits via
+    # prefix=), and generate-level prefix caching
+    # (test_generate::TestPrefixCaching); full run via check_all --all
     def test_prefix_sharing_token_identical_and_counted(self, tiny, rng):
         """Sharers of a system prompt must decode exactly as if the
         full (prefix + own) prompt had been prefilled solo, while the
@@ -293,6 +302,312 @@ class TestScheduler:
             Scheduler(policy="lifo")
 
 
+class TestSchedulerQoS:
+    def _req(self, n, **kw):
+        return Request(tokens=np.arange(1, n + 1), max_new_tokens=4, **kw)
+
+    def test_pop_priority_with_intra_class_fairness(self):
+        """Cross-class: guaranteed before best_effort before sheddable.
+        Intra-class: arrival order untouched (fifo) — the class lattice
+        must never reorder same-class tenants."""
+        s = Scheduler(max_queue=8)
+        b1 = s.submit(self._req(3, qos="best_effort", tenant="t1"))
+        sh = s.submit(self._req(2, qos="sheddable"))
+        g1 = s.submit(self._req(9, qos="guaranteed"))
+        b2 = s.submit(self._req(5, qos="best_effort", tenant="t2"))
+        g2 = s.submit(self._req(4, qos="guaranteed"))
+        assert [r.req_id for r in s.pop(5)] == [g1, g2, b1, b2, sh]
+
+    def test_sjf_applies_within_class(self):
+        s = Scheduler(max_queue=8, policy="sjf")
+        b_long = s.submit(self._req(9))
+        g_long = s.submit(self._req(7, qos="guaranteed"))
+        b_short = s.submit(self._req(2))
+        g_short = s.submit(self._req(3, qos="guaranteed"))
+        assert [r.req_id for r in s.pop(4)] == [g_short, g_long,
+                                                b_short, b_long]
+
+    def test_full_queue_sheds_weakest_youngest_first(self):
+        """A stronger-class submit on a full queue sheds the weakest
+        class's YOUNGEST request (it waited least); the victim surfaces
+        via drain_shed, never silently."""
+        s = Scheduler(max_queue=3)
+        s.submit(self._req(3, qos="sheddable"), now=1.0)
+        sh_young = s.submit(self._req(3, qos="sheddable"), now=2.0)
+        s.submit(self._req(3, qos="best_effort"), now=3.0)
+        b = s.submit(self._req(3, qos="best_effort"), now=4.0)
+        assert [r.req_id for r in s.drain_shed()] == [sh_young]
+        assert s.depth == 3 and not s.drain_shed()
+        # the displaced best_effort is still queued; a guaranteed
+        # arrival sheds the remaining sheddable, then best_effort
+        g = s.submit(self._req(3, qos="guaranteed"), now=5.0)
+        (v1,) = s.drain_shed()
+        assert v1.qos == "sheddable"
+        g2 = s.submit(self._req(3, qos="guaranteed"), now=6.0)
+        (v2,) = s.drain_shed()
+        assert v2.qos == "best_effort" and v2.req_id == b
+        assert {g, g2} < set(s.snapshot())
+
+    def test_guaranteed_never_shed_while_sheddable_present(self):
+        """The QoS contract's core: no arrival ever sheds an equal or
+        stronger class — a full queue of guaranteed work rejects even
+        another guaranteed request rather than shed one."""
+        s = Scheduler(max_queue=2)
+        s.submit(self._req(3, qos="guaranteed"))
+        s.submit(self._req(3, qos="sheddable"))
+        s.submit(self._req(3, qos="guaranteed"))     # sheds the sheddable
+        (v,) = s.drain_shed()
+        assert v.qos == "sheddable"
+        with pytest.raises(Backpressure) as ei:      # only guaranteed left
+            s.submit(self._req(3, qos="guaranteed"))
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after_s > 0
+        assert all(r.qos == "guaranteed"
+                   for r in [self._lookup(s, i) for i in s.snapshot()])
+
+    @staticmethod
+    def _lookup(s, rid):
+        return next(r for r in s._queue if r.req_id == rid)
+
+    def test_expire_orders_class_then_deadline(self):
+        s = Scheduler(max_queue=8)
+        t = time.monotonic()
+        b = s.submit(self._req(3, qos="best_effort", deadline=t + 1))
+        g_late = s.submit(self._req(3, qos="guaranteed", deadline=t + 2))
+        sh = s.submit(self._req(3, qos="sheddable", deadline=t + 1))
+        g_early = s.submit(self._req(3, qos="guaranteed", deadline=t + 1))
+        live = s.submit(self._req(3, qos="sheddable", deadline=t + 99))
+        dead = s.expire(now=t + 10)
+        assert [r.req_id for r in dead] == [g_early, g_late, b, sh]
+        assert s.snapshot() == [live]
+
+    def test_structured_backpressure_fields(self):
+        s = Scheduler(max_queue=1, retry_after_s=0.2)
+        s.submit(self._req(3))
+        with pytest.raises(Backpressure) as full:
+            s.submit(self._req(3))
+        assert full.value.queue_depth == 1
+        assert full.value.retry_after_s == pytest.approx(0.2)
+        with pytest.raises(Backpressure) as dead:
+            Scheduler(max_queue=4).submit(
+                self._req(3, deadline=time.monotonic() - 1))
+        assert dead.value.retry_after_s == 0.0   # retrying is pointless
+
+    def test_unknown_qos_rejected_loudly(self):
+        with pytest.raises(ValueError, match="qos"):
+            self._req(3, qos="platinum")
+
+    def test_engine_submit_finishes_shed_victims(self, tiny, rng):
+        """The engine surfaces scheduler sheds as evicted results with
+        a shed reason + counter — shed load is observable load."""
+        cfg = tiny[0]
+        eng = _engine(tiny, max_slots=1, max_queue=1)
+        p = rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        shed_rid = eng.submit(p, max_new_tokens=4, qos="sheddable")
+        # full queue: the guaranteed arrival displaces the sheddable
+        g = eng.submit(p, max_new_tokens=4, qos="guaranteed")
+        res = eng.results[shed_rid]
+        assert res.status == "evicted" and "shed" in res.reason
+        assert eng.metrics.summary()["counters"]["sheds"] == 1
+        eng.run(max_steps=60)
+        assert eng.results[g].status == "done"
+
+
+class TestCancelReleasesImmediately:
+    def test_running_cancel_frees_slot_and_prefix_refcount_now(
+            self, tiny, rng):
+        """Satellite audit: cancelling an ADMITTED request must release
+        its KV slot and shared-prefix page refcount immediately — not
+        at the next step boundary (an idle engine would leak the slot
+        forever) and not at natural retirement."""
+        cfg = tiny[0]
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (6,)).tolist())
+        own = rng.integers(0, cfg.vocab_size, (3,)).tolist()
+        rid = eng.submit(own, max_new_tokens=20, prefix=sysp)
+        eng.step()                           # admitted + decoding
+        assert eng.n_active == 1 and eng.kv.n_free == 1
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 1
+        assert eng.cancel(rid)
+        # NO step() between cancel and these asserts — the release
+        # must already have happened
+        assert eng.kv.n_free == 2
+        assert eng.n_active == 0
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 0        # page released, evictable
+        assert eng.kv.evict_prefix(sysp) is True
+        res = eng.results[rid]
+        assert res.status == "cancelled" and res.tokens.size > 0
+
+
+class TestPerRequestSeeds:
+    """Sampling is a pure function of (params, prompt, seed): the
+    idempotent-resubmission contract the replica supervisor rides."""
+
+    def _toy_engine(self, **kw):
+        from apex1_tpu.testing.chaos import toy_decoder
+        apply_fn, make_cache, params = toy_decoder()
+        ekw = dict(max_slots=3, max_len=48, prefill_chunk=4,
+                   vocab_size=61, temperature=0.9, seed=5)
+        ekw.update(kw)
+        return Engine(apply_fn, make_cache, params, EngineConfig(**ekw))
+
+    def test_same_seed_same_stream_across_engines_and_batches(self):
+        """A sampled request regenerates bit-identically on a FRESH
+        engine, even when the two engines batch it with different
+        neighbors — seed + output position is the whole key."""
+        a = self._toy_engine()
+        ra = a.submit([7, 3, 9], max_new_tokens=10, seed=1234)
+        a.run(max_steps=60)
+
+        b = self._toy_engine()
+        # different batch composition on engine b
+        b.submit([1, 2, 3, 4, 5], max_new_tokens=6)
+        rb = b.submit([7, 3, 9], max_new_tokens=10, seed=1234)
+        b.submit([9, 9], max_new_tokens=4)
+        b.run(max_steps=80)
+        np.testing.assert_array_equal(a.results[ra].tokens,
+                                      b.results[rb].tokens)
+
+    def test_different_seeds_different_streams(self):
+        eng = self._toy_engine()
+        r1 = eng.submit([7, 3, 9], max_new_tokens=12, seed=1)
+        r2 = eng.submit([7, 3, 9], max_new_tokens=12, seed=2)
+        eng.run(max_steps=80)
+        assert not np.array_equal(eng.results[r1].tokens,
+                                  eng.results[r2].tokens)
+
+    def test_derived_seed_stable_for_stable_req_id(self):
+        """No explicit seed: the engine derives one from (engine seed,
+        request id) — a resubmission carrying the same id onto a fresh
+        engine regenerates the identical stream."""
+        from apex1_tpu.serving import new_request_id
+        rid = new_request_id()
+        a = self._toy_engine()
+        a.submit([5, 1, 2, 8], max_new_tokens=9, req_id=rid)
+        a.run(max_steps=60)
+        b = self._toy_engine()
+        b.submit([5, 1, 2, 8], max_new_tokens=9, req_id=rid)
+        b.run(max_steps=60)
+        np.testing.assert_array_equal(a.results[rid].tokens,
+                                      b.results[rid].tokens)
+        # ...and a different id draws a different stream
+        c = self._toy_engine()
+        rid2 = c.submit([5, 1, 2, 8], max_new_tokens=9)
+        c.run(max_steps=60)
+        assert not np.array_equal(a.results[rid].tokens,
+                                  c.results[rid2].tokens)
+
+
+class TestReplicaKillDrill:
+    def test_two_replica_kill_mid_stream_bit_identical(self, tiny, rng):
+        """THE acceptance drill on the real tiny GPT-2: 2-replica
+        frontend, one replica chaos-killed mid-stream. Every request
+        must complete with tokens BIT-IDENTICAL to the uninterrupted
+        solo-generate oracle, the dead replica restarts exactly once,
+        and every engine generation compiled exactly its two
+        executables."""
+        from apex1_tpu.testing.chaos import ReplicaKill
+        cfg, params, apply_fn, make_cache, solo = tiny
+
+        def make_engine():
+            return Engine(apply_fn, make_cache, params,
+                          EngineConfig(max_slots=2, max_len=48,
+                                       prefill_chunk=4,
+                                       vocab_size=cfg.vocab_size))
+
+        kill = ReplicaKill(replica=0, at_step=3)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=6,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=120.0)),
+            fault=kill)
+        lens = [3, 7, 5, 9, 4]
+        news = [6, 5, 7, 4, 6]
+        prompts = [rng.integers(0, cfg.vocab_size, (L,)).tolist()
+                   for L in lens]
+        rids = [front.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        front.run_until_drained(timeout_s=300.0)
+        assert kill.fired == 1
+        for p, n, rid in zip(prompts, news, rids):
+            res = front.poll(rid)
+            assert res.status == "done", (rid, res)
+            np.testing.assert_array_equal(res.tokens, solo(p, n))
+        # the dead replica restarted once, with a FRESH two-executable
+        # engine; the survivor kept its original pair
+        summ = front.summary()
+        assert summ["counters"]["replica_restarts"] == 1
+        assert summ["replicas"][0]["restarts"] == 1
+        assert summ["replicas"][0]["engines_built"] == 2
+        assert summ["replicas"][1]["engines_built"] == 1
+        for rep in front.replicas:
+            assert rep.trace_counts() == {"prefill": 1, "decode": 1}
+        # the death + restart are banked transitions
+        events = [t["event"] for t in front.metrics.transitions]
+        assert "replica_dead" in events and "replica_restart" in events
+
+
+class TestServingMetricsFailurePaths:
+    def test_counters_and_percentiles_on_synthetic_stream(self):
+        """Satellite: summary() carries the failure-path counters
+        (always, zeros included) and p50/p99 for BOTH TTFT and
+        end-to-end latency — asserted on a hand-built event stream
+        with exact timestamps."""
+        m = ServingMetrics()
+        # 10 requests: queued at t=i, first token at t=i+ttft,
+        # done at t=i+lat, with ttft = 10..100ms, lat = 2x ttft
+        for i in range(10):
+            ttft = 0.01 * (i + 1)
+            m.event(i, "queued", now=float(i), n_prompt=4)
+            m.event(i, "prefill", now=float(i))
+            m.event(i, "first_token", now=float(i) + ttft)
+            m.event(i, "done", now=float(i) + 2 * ttft,
+                    reason="length", n_generated=8)
+        m.incr("retries", 3)
+        m.incr("hedges_fired")
+        m.incr("hedges_won")
+        m.incr("sheds", 2)
+        m.incr("replica_restarts")
+        m.incr("custom_path")                    # ad-hoc names ride along
+        s = m.summary()
+        c = s["counters"]
+        assert c["retries"] == 3 and c["hedges_fired"] == 1
+        assert c["hedges_won"] == 1 and c["sheds"] == 2
+        assert c["replica_restarts"] == 1
+        assert c["evictions"] == 0               # present even when 0
+        assert c["custom_path"] == 1
+        ttfts_ms = [10.0 * (i + 1) for i in range(10)]
+        assert s["ttft_p50_ms"] == pytest.approx(
+            float(np.percentile(ttfts_ms, 50)), rel=1e-6)
+        assert s["ttft_p99_ms"] == pytest.approx(
+            float(np.percentile(ttfts_ms, 99)), rel=1e-6)
+        assert s["latency_p50_ms"] == pytest.approx(
+            float(np.percentile([2 * t for t in ttfts_ms], 50)),
+            rel=1e-6)
+        assert s["latency_p99_ms"] == pytest.approx(
+            float(np.percentile([2 * t for t in ttfts_ms], 99)),
+            rel=1e-6)
+
+    def test_transitions_banked_and_logged(self):
+        lines = []
+        from apex1_tpu.utils.observability import MetricsLogger
+        m = ServingMetrics(MetricsLogger(writer=lines.append,
+                                         n_chips=1))
+        m.transition("mode", frm="normal", to="shedding",
+                     load_fraction=0.9)
+        m.transition("replica_restart", replica=1, generation=2)
+        assert [t["event"] for t in m.transitions] == [
+            "mode", "replica_restart"]
+        assert m.transitions[0]["to"] == "shedding"
+        import json
+        recs = [json.loads(ln) for ln in lines]
+        assert recs[0]["event"] == "mode"
+        assert recs[1]["replica"] == 1
+
+
 class TestKVPool:
     def test_alloc_free_cycle(self, tiny):
         _, _, _, make_cache, _ = tiny
@@ -374,6 +689,31 @@ class TestRequestFeeder:
         assert all("retries exhausted" in r for _, r in feeder.dropped)
         assert feeder.counters["dropped_backpressure"] == 2
         assert feeder.counters["retries"] == 4       # 2 per item
+
+    def test_retry_after_hint_floors_the_backoff(self):
+        """Satellite: a structured rejection's retry_after_s is the
+        FLOOR on the feeder's next sleep — the exponential schedule may
+        wait longer, never shorter."""
+        calls = {"n": 0}
+        floor = 0.06
+
+        def submit(tokens, **kw):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise Backpressure("queue full", queue_depth=9,
+                                   retry_after_s=floor)
+            return kw["req_id"]
+
+        t0 = time.monotonic()
+        feeder = RequestFeeder([[1, 2]], lambda t: (t, {}), submit,
+                               retries=10, retry_wait_s=1e-4,
+                               retry_cap_s=1e-3).start()
+        feeder.join(timeout=10.0)
+        elapsed = time.monotonic() - t0
+        assert len(feeder.submitted) == 1 and not feeder.dropped
+        assert feeder.counters["retries"] == 2
+        # without the floor both sleeps are <= 1ms; with it, >= 2*floor
+        assert elapsed >= 2 * floor
 
     def test_backpressure_deadline_sheds_load(self):
         """Drop-after-deadline: an item must not stretch tail latency

@@ -1,0 +1,502 @@
+"""`apex1_tpu.serving.replica` + `serving.frontend` — the fault
+boundary of the serving tier, driven deterministically (pump mode; the
+chaos faults fire at exact (replica, step) coordinates).
+
+The model throughout is `testing.chaos.toy_decoder`: a deterministic
+history-dependent cached decoder that compiles in milliseconds, so
+these drills pay supervisor cost, not XLA cost. The REAL-model
+acceptance drill (tiny GPT-2, bit-parity vs solo generate) lives in
+``test_serving.py::TestReplicaKillDrill``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from apex1_tpu.serving import (Backpressure, DegradeProfile, Engine,
+                               EngineConfig, FrontendConfig,
+                               ReplicaConfig, ServingFrontend)
+from apex1_tpu.testing.chaos import (ChaosSchedule, PoisonPill,
+                                     ReplicaHang, ReplicaKill,
+                                     SlowReplica, kill_schedule,
+                                     toy_decoder)
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def toy():
+    return toy_decoder(VOCAB)
+
+
+def _make_engine_factory(toy, **ekw):
+    apply_fn, make_cache, params = toy
+    kw = dict(max_slots=3, max_len=48, prefill_chunk=4,
+              vocab_size=VOCAB, temperature=0.8, seed=7)
+    kw.update(ekw)
+
+    def make_engine(cache_dtype=None):
+        return Engine(apply_fn, make_cache, params, EngineConfig(**kw),
+                      cache_dtype=cache_dtype)
+
+    return make_engine
+
+
+def _reference(make_engine, front, rids):
+    """Uninterrupted single-engine run of each request (same seed)."""
+    ref = make_engine()
+    out = {}
+    for rid in rids:
+        sub = front._subs[rid]
+        rr = ref.submit(sub.tokens, max_new_tokens=sub.max_new_tokens,
+                        seed=sub.seed)
+        ref.run(max_steps=200)
+        out[rid] = ref.results[rr].tokens
+    return out
+
+
+def _submit_mix(front, rng, n, *, new=8, qos="best_effort"):
+    prompts = [rng.integers(0, VOCAB, (3 + i % 5,)).astype(np.int32)
+               for i in range(n)]
+    return [front.submit(p, max_new_tokens=new + i % 3, qos=qos)
+            for i, p in enumerate(prompts)]
+
+
+class TestSupervisorRecovery:
+    def test_watchdog_declares_hang_dead_then_restart_completes(
+            self, toy, rng):
+        """The watchdog path: a replica that stops making step progress
+        (hang > watchdog_s) is declared dead even though it never
+        raised; restart + resubmission completes every stream
+        token-identically."""
+        make_engine = _make_engine_factory(toy)
+        hang = ReplicaHang(replica=0, at_step=4, hang_s=0.25)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=1, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=0.1)),
+            fault=hang)
+        rids = _submit_mix(front, rng, 4)
+        front.run_until_drained(timeout_s=60.0)
+        assert hang.fired == 1
+        assert front.replicas[0].restarts == 1
+        assert front.replicas[0].engines_built == 2
+        want = _reference(make_engine, front, rids)
+        for rid in rids:
+            res = front.poll(rid)
+            assert res.status == "done"
+            np.testing.assert_array_equal(res.tokens, want[rid])
+        deaths = [t for t in front.metrics.transitions
+                  if t["event"] == "replica_dead"]
+        assert len(deaths) == 1 and "watchdog" in deaths[0]["error"]
+
+    def test_slow_replica_stays_alive_no_restart(self, toy, rng):
+        """A straggler below the watchdog threshold is degraded, not
+        dead: no restart, results correct — the case hedging (not
+        supervision) exists for."""
+        make_engine = _make_engine_factory(toy)
+        slow = SlowReplica(0, delay_s=0.01, from_step=0, to_step=20)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=1, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=5.0)),
+            fault=slow)
+        rids = _submit_mix(front, rng, 3)
+        front.run_until_drained(timeout_s=60.0)
+        assert front.replicas[0].restarts == 0
+        assert front.replicas[0].state == "alive"
+        assert all(front.poll(r).status == "done" for r in rids)
+
+    def test_failover_reroutes_when_restart_budget_spent(self, toy, rng):
+        """max_restarts=0: the killed replica goes straight to
+        ``failed``; the frontend drains its in-flight submissions and
+        re-routes them to the survivor — same ids, same seeds, so the
+        streams still come out token-identical."""
+        make_engine = _make_engine_factory(toy)
+        kill = ReplicaKill(replica=0, at_step=3)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0,
+                                                 max_restarts=0)),
+            fault=kill)
+        rids = _submit_mix(front, rng, 6)
+        front.run_until_drained(timeout_s=60.0)
+        assert front.replica_states() == ["failed", "alive"]
+        assert front.replicas[0].engines_built == 1   # never rebuilt
+        want = _reference(make_engine, front, rids)
+        for rid in rids:
+            res = front.poll(rid)
+            assert res.status == "done"
+            np.testing.assert_array_equal(res.tokens, want[rid])
+        fo = [t for t in front.metrics.transitions
+              if t["event"] == "failover"]
+        assert len(fo) == 1 and fo[0]["source"] == 0
+        assert len(fo[0]["rerouted"]) > 0
+
+    def test_poison_quarantine_bounds_crash_loop(self, toy, rng):
+        """A request whose admission kills the replica every time is
+        quarantined past poison_threshold instead of crash-looping;
+        innocent requests on the same replica still finish."""
+        make_engine = _make_engine_factory(toy)
+        pill = PoisonPill(poison_token=60)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=1, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0,
+                                                 max_restarts=5,
+                                                 poison_threshold=1)),
+            fault=pill)
+        # good prompts drawn BELOW the poison token — the pill must be
+        # the only pill
+        good = [front.submit(rng.integers(0, 59, (4 + i,)),
+                             max_new_tokens=6) for i in range(2)]
+        bad = front.submit(np.asarray([60, 4], np.int32),
+                           max_new_tokens=5)
+        front.run_until_drained(timeout_s=60.0)
+        res = front.poll(bad)
+        assert res.status == "evicted" and "poisoned" in res.reason
+        assert pill.fired == 2                    # threshold + 1
+        assert front.replicas[0].restarts == 2
+        assert front.replicas[0].state == "alive"  # budget NOT spent
+        assert all(front.poll(r).status == "done" for r in good)
+
+    def test_kill_schedule_is_seed_deterministic(self):
+        a = kill_schedule(42, n_replicas=4, lo=3, hi=11)
+        b = kill_schedule(42, n_replicas=4, lo=3, hi=11)
+        c = kill_schedule(43, n_replicas=4, lo=3, hi=11)
+        assert (a.replica, a.at_step) == (b.replica, b.at_step)
+        assert 0 <= a.replica < 4 and 3 <= a.at_step < 11
+        assert (a.replica, a.at_step) != (c.replica, c.at_step)
+
+
+class TestHedging:
+    def test_hedge_fires_on_blown_ttft_budget_and_hedge_leg_wins(
+            self, toy, rng):
+        """The hedge trigger is a TTFT budget: replica 0 dies BEFORE
+        producing the request's first token (kill at step 0), so the
+        budget blows and the request is duplicated to replica 1; a
+        second kill then delays the restarted primary further, so the
+        hedge leg finishes first — first answer wins, tokens identical
+        by construction, loser cancelled."""
+        make_engine = _make_engine_factory(toy)
+        kills = ChaosSchedule([ReplicaKill(replica=0, at_step=0),
+                               ReplicaKill(replica=0, at_step=2)])
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=0.0,    # any wait blows it
+                           replica=ReplicaConfig(watchdog_s=60.0)),
+            fault=kills)
+        p = rng.integers(0, VOCAB, (5,)).astype(np.int32)
+        rid = front.submit(p, max_new_tokens=10, qos="guaranteed")
+        front.run_until_drained(timeout_s=60.0)
+        res = front.poll(rid)
+        assert res.status == "done"
+        want = _reference(make_engine, front, [rid])[rid]
+        np.testing.assert_array_equal(res.tokens, want)
+        s = front.summary()["counters"]
+        assert s["hedges_fired"] == 1
+        assert s["hedges_won"] == 1               # the hedge leg won
+        hedges = [t for t in front.metrics.transitions
+                  if t["event"] == "hedge"]
+        assert len(hedges) == 1 and hedges[0]["req"] == rid
+
+    def test_streaming_primary_is_never_hedged(self, toy, rng):
+        """A slow-but-streaming primary must NOT trigger a hedge — the
+        budget is time-to-FIRST-token, not time-to-completion
+        (review finding: elapsed-time hedging doubled every long
+        guaranteed decode)."""
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=0.0,
+                           replica=ReplicaConfig(watchdog_s=60.0)))
+        p = rng.integers(0, VOCAB, (4,)).astype(np.int32)
+        rid = front.submit(p, max_new_tokens=12, qos="guaranteed")
+        front.run_until_drained(timeout_s=60.0)
+        assert front.poll(rid).status == "done"
+        # first token landed on the first pump; every later round was
+        # past the 0-second budget yet no hedge fired
+        assert front.summary()["counters"]["hedges_fired"] == 0
+
+    def test_best_effort_never_hedged(self, toy, rng):
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=0.0,
+                           replica=ReplicaConfig(watchdog_s=60.0)))
+        rids = _submit_mix(front, rng, 3, qos="best_effort")
+        front.run_until_drained(timeout_s=60.0)
+        assert front.summary()["counters"]["hedges_fired"] == 0
+        assert all(front.poll(r).status == "done" for r in rids)
+
+
+class TestOverloadDrill:
+    def _overloaded_front(self, toy, *, enter_shed=0.6,
+                          enter_degraded=0.9, cache_dtype=None,
+                          cap=3):
+        make_engine = _make_engine_factory(toy)
+        return make_engine, ServingFrontend(
+            make_engine,
+            FrontendConfig(
+                n_replicas=1, capacity_per_replica=4, seed=3,
+                hedge_after_s=None, enter_shed=enter_shed,
+                enter_degraded=enter_degraded, exit_overload=0.25,
+                sustain_rounds=2,
+                degrade=DegradeProfile(max_new_tokens_cap=cap,
+                                       cache_dtype=cache_dtype),
+                replica=ReplicaConfig(watchdog_s=60.0)))
+
+    def test_sheddable_shed_before_guaranteed_misses_deadline(
+            self, toy, rng):
+        """THE overload acceptance drill: at capacity, guaranteed
+        arrivals displace sheddable load (shed first, banked);
+        sustained overload flips the mode ladder with every transition
+        banked as a JSON metrics event; every guaranteed request
+        completes within its deadline; de-escalation back to normal is
+        banked too."""
+        make_engine, front = self._overloaded_front(toy)
+        shed_rids = _submit_mix(front, rng, 4, new=12, qos="sheddable")
+        deadline = time.monotonic() + 30.0
+        g_rids = [front.submit(
+            rng.integers(0, VOCAB, (4,)).astype(np.int32),
+            max_new_tokens=6, qos="guaranteed", deadline=deadline)
+            for _ in range(2)]
+        # displacement already happened at submit: capacity 4 held 4
+        # sheddable, 2 guaranteed arrivals shed the 2 youngest
+        assert front.summary()["counters"]["sheds"] >= 2
+        front.run_until_drained(timeout_s=60.0)
+        done_at = time.monotonic()
+        for rid in g_rids:
+            res = front.poll(rid)
+            assert res.status == "done", (rid, res)
+        assert done_at < deadline          # ...within the deadline
+        shed = [front.poll(r) for r in shed_rids]
+        assert all(r.status in ("evicted", "done") for r in shed)
+        assert any(r.status == "evicted" and "shed" in r.reason
+                   for r in shed)
+        # no guaranteed request was ever evicted or rejected
+        assert all(front.poll(r).status == "done" for r in g_rids)
+        events = front.metrics.transitions
+        mode_flips = [t for t in events if t["event"] == "mode"]
+        assert any(t["to"] == "shedding" for t in mode_flips)
+        sheds = [t for t in events if t["event"] == "shed"]
+        assert len(sheds) == front.summary()["counters"]["sheds"]
+        # drain -> de-escalation is banked as well
+        front.pump(6)
+        mode_flips = [t for t in front.metrics.transitions
+                      if t["event"] == "mode"]
+        assert mode_flips[-1]["to"] == "normal"
+        assert front.mode == "normal"
+
+    def test_degraded_mode_caps_admissions_and_rejects_sheddable(
+            self, toy, rng):
+        """Degraded mode is pressure relief, not failure: new
+        admissions keep flowing with max_new_tokens capped to the
+        profile; sheddable-class admissions get a structured 429."""
+        make_engine, front = self._overloaded_front(
+            toy, enter_shed=0.4, enter_degraded=0.5, cap=3)
+        rids = _submit_mix(front, rng, 3, new=12)   # 3/4 of capacity
+        front.pump(4)                      # sustain -> shedding -> degraded
+        assert front.mode == "degraded"
+        capped = front.submit(rng.integers(0, VOCAB, (4,)),
+                              max_new_tokens=12)
+        with pytest.raises(Backpressure, match="sheddable"):
+            front.submit(rng.integers(0, VOCAB, (3,)),
+                         max_new_tokens=4, qos="sheddable")
+        front.run_until_drained(timeout_s=60.0)
+        assert front.poll(capped).tokens.size == 3   # the cap, not 12
+        assert all(front.poll(r).status == "done" for r in rids)
+        flips = [t for t in front.metrics.transitions
+                 if t["event"] == "mode"]
+        deg = next(t for t in flips if t["to"] == "degraded")
+        assert deg["max_new_tokens_cap"] == 3
+        assert front.summary()["counters"]["degraded_admissions"] == 1
+
+    def test_degraded_restart_rides_quantized_kv_profile(self, toy,
+                                                         rng):
+        """A replica (re)built while degraded gets the profile's
+        cache_dtype (the int8-KV relief valve) — and the toy cache
+        stores small exact ints, so the resubmitted streams stay
+        token-identical across the dtype flip."""
+        import jax
+        import jax.numpy as jnp
+        make_engine, front = self._overloaded_front(
+            toy, enter_shed=0.4, enter_degraded=0.5,
+            cache_dtype=jnp.int8)
+        kill = ReplicaKill(replica=0, at_step=6)
+        front.replicas[0].fault = kill
+        rids = _submit_mix(front, rng, 4, new=10)
+        front.pump(4)
+        assert front.mode == "degraded"
+        leaf0 = jax.tree_util.tree_leaves(
+            front.replicas[0].engine.kv.cache)[0]
+        assert leaf0.dtype == jnp.float32         # built before the flip
+        front.run_until_drained(timeout_s=60.0)
+        assert kill.fired == 1 and front.replicas[0].restarts == 1
+        leaf1 = jax.tree_util.tree_leaves(
+            front.replicas[0].engine.kv.cache)[0]
+        assert leaf1.dtype == jnp.int8            # rebuilt ON the profile
+        want = _reference(make_engine, front, rids)
+        for rid in rids:
+            res = front.poll(rid)
+            assert res.status == "done"
+            np.testing.assert_array_equal(res.tokens, want[rid])
+
+
+class TestReviewRegressions:
+    def test_oversized_seed_folds_instead_of_crashing(self, toy):
+        """A 64-bit explicit seed must not pass admission and then
+        crash the engine step (under a supervisor that reads as a
+        replica crash loop) — it folds to int32 deterministically."""
+        apply_fn, make_cache, params = toy
+        kw = dict(max_slots=2, max_len=48, prefill_chunk=4,
+                  vocab_size=VOCAB, temperature=0.9)
+        big = 2 ** 31 + 12345
+        outs = []
+        for _ in range(2):
+            eng = Engine(apply_fn, make_cache, params,
+                         EngineConfig(**kw))
+            rid = eng.submit([7, 3, 9], max_new_tokens=8, seed=big)
+            eng.run(max_steps=40)
+            res = eng.results[rid]
+            assert res.status == "done"
+            outs.append(res.tokens)
+        np.testing.assert_array_equal(*outs)   # folded, still pinned
+
+    def test_cancel_pending_at_restart_is_not_resurrected(self, toy,
+                                                          rng):
+        """An acknowledged cancel sitting in the inbox when the
+        replica dies must survive the restart — resubmitting the
+        request from inflight would resurrect cancelled work."""
+        from apex1_tpu.serving import ReplicaSupervisor
+        make_engine = _make_engine_factory(toy)
+        sup = ReplicaSupervisor(make_engine, 0,
+                                config=ReplicaConfig(watchdog_s=60.0))
+        keep = sup.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=6)
+        dead = sup.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=20)
+        sup.pump(2)                        # both admitted + decoding
+        sup.cancel(dead)                   # acknowledged: in the inbox
+        sup._mark_dead(RuntimeError("chaos"))   # dies before next pump
+        sup.state = "dead"
+        assert sup.restart()
+        while sup.poll(keep) is None and sup.pump(1):
+            pass
+        assert sup.poll(keep).status == "done"
+        res = sup.poll(dead)
+        assert res is not None and res.status == "cancelled", res
+
+    def test_infeasible_guaranteed_does_not_displace_sheddable(
+            self, toy, rng):
+        """Feasibility is checked BEFORE displacement: a guaranteed
+        admission that will be rejected as infeasible must not first
+        shed an innocent victim for nothing."""
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            # overload ladder disabled (thresholds unreachable): this
+            # test isolates the DISPLACEMENT path at full capacity
+            FrontendConfig(n_replicas=1, capacity_per_replica=2,
+                           hedge_after_s=None, enter_shed=99.0,
+                           enter_degraded=99.0,
+                           replica=ReplicaConfig(watchdog_s=60.0)))
+        warm = front.submit(rng.integers(0, VOCAB, (4,)),
+                            max_new_tokens=4)
+        front.run_until_drained(timeout_s=60.0)   # seeds step_ewma
+        assert front.poll(warm).status == "done"
+        s1 = front.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=6, qos="sheddable")
+        front.submit(rng.integers(0, VOCAB, (4,)),
+                     max_new_tokens=6, qos="sheddable")
+        with pytest.raises(Backpressure, match="feasibly"):
+            front.submit(rng.integers(0, VOCAB, (3,)),
+                         max_new_tokens=5000, qos="guaranteed",
+                         deadline=time.monotonic() + 1e-5)
+        assert front.summary()["counters"]["sheds"] == 0
+        front.run_until_drained(timeout_s=60.0)
+        assert front.poll(s1).status == "done"    # nobody was shed
+
+
+class TestDeadlineFeasibilityRouting:
+    def test_infeasible_deadline_rejected_at_the_door(self, toy, rng):
+        """Once the router has timing history, a deadline no replica
+        can plausibly meet is rejected with retry_after_s=0 (retrying
+        won't help) instead of admitted to fail."""
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=1, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0)))
+        warm = front.submit(rng.integers(0, VOCAB, (4,)),
+                            max_new_tokens=4)
+        front.run_until_drained(timeout_s=60.0)
+        assert front.poll(warm).status == "done"
+        assert front.replicas[0].step_ewma > 0.0
+        with pytest.raises(Backpressure) as ei:
+            front.submit(rng.integers(0, VOCAB, (4,)),
+                         max_new_tokens=5000,
+                         deadline=time.monotonic() + 1e-5)
+        assert "feasibly" in ei.value.reason
+        assert ei.value.retry_after_s == 0.0
+        # a generous deadline on the same replica is admitted
+        ok = front.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=4,
+                          deadline=time.monotonic() + 60.0)
+        front.run_until_drained(timeout_s=60.0)
+        assert front.poll(ok).status == "done"
+
+
+class TestThreadedFrontend:
+    def test_threaded_replicas_drain_and_match_reference(self, toy,
+                                                         rng):
+        """The production drive mode: threaded serve loops under the
+        main-thread supervision tick. Streams still match the
+        uninterrupted reference bit-for-bit (per-request seeds make
+        parity independent of thread interleaving)."""
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0))
+        ).start()
+        try:
+            rids = _submit_mix(front, rng, 6)
+            front.run_until_drained(timeout_s=60.0)
+            want = _reference(make_engine, front, rids)
+            for rid in rids:
+                res = front.poll(rid)
+                assert res.status == "done"
+                np.testing.assert_array_equal(res.tokens, want[rid])
+        finally:
+            front.stop()
+        assert all(s in ("stopped", "alive")
+                   for s in front.replica_states())
+
+
+class TestChaosScheduleCompose:
+    def test_composed_faults_all_fire(self, toy, rng):
+        make_engine = _make_engine_factory(toy)
+        kill = ReplicaKill(replica=0, at_step=4)
+        slow = SlowReplica(1, delay_s=0.002, from_step=0, to_step=6)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0)),
+            fault=ChaosSchedule([kill, slow]))
+        rids = _submit_mix(front, rng, 5)
+        front.run_until_drained(timeout_s=60.0)
+        assert kill.fired == 1
+        assert all(front.poll(r).status == "done" for r in rids)

@@ -78,8 +78,13 @@ class TestSpeculativeGenerate:
         d_fn, d_cache = mk(drf)
         return (cfg_t, prompt, t_fn, pt, t_cache, d_fn, pd, d_cache)
 
-    @pytest.mark.parametrize("family", ["llama", "gpt2"])
-    @pytest.mark.parametrize("K", [1, 3, 4])
+    # [1-llama] to @slow for 870s-cap headroom (~11s): the K=1
+    # degenerate draft stays pinned on gpt2, llama spec stays pinned at
+    # K=3/4 (the multi-token verify paths); check_all --all
+    @pytest.mark.parametrize("family,K", [
+        pytest.param("llama", 1, marks=pytest.mark.slow),
+        ("llama", 3), ("llama", 4),
+        ("gpt2", 1), ("gpt2", 3), ("gpt2", 4)])
     def test_matches_target_greedy(self, family, K):
         (cfg, prompt, t_fn, pt, mk_t, d_fn, pd, mk_d) = \
             self._models(family)
@@ -409,6 +414,10 @@ class TestSpeculativeRaggedAndQuant:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         assert (np.asarray(rounds) >= 1).all()
 
+    @pytest.mark.slow  # 870s-cap headroom (~14s): ragged x speculative
+    # x int8-draft TRIPLE; pairwise halves pinned tier-1 —
+    # test_int8_draft_under_bf16_target and test_ragged_sampled_smoke;
+    # check_all --all
     def test_int8_draft_ragged(self):
         """The full composition: int8 draft + bf16 target + ragged batch,
         greedy — per-row token identity with solo decode."""

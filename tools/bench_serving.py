@@ -15,10 +15,25 @@ acceptance line (CPU proxy): decode is weight-streaming-bound, so the
 pooled step serves 8 rows for nearly the price of 1 — continuous
 batching converts that into throughput the serial loop leaves idle.
 
+``--replicas N [M ...]`` adds the multi-replica axis: the same offered
+load through a `serving.ServingFrontend` (threaded supervised
+replicas), reporting tokens/sec per replica count — the ROADMAP 2(d)
+near-linear-scaling observable. ``--chaos`` arms a seed-keyed
+replica-kill mid-sweep and reports GOODPUT (tokens of COMPLETED
+requests per second) across the kill + restart + resubmission cycle —
+the number that shows fault tolerance costing throughput, not
+correctness (every request still completes; parity is tier-1's job).
+
+``--out FILE`` banks the accumulating record via
+``manifest.atomic_write_json`` after EVERY sweep point (kill-safe,
+like bench.py --out): an interrupted sweep keeps each completed point.
+
 Usage::
 
   python tools/bench_serving.py                  # full sweep (1,2,4,8)
   python tools/bench_serving.py --smoke          # CPU-gate smoke (~1 min)
+  python tools/bench_serving.py --replicas 1 2 --chaos \
+      --out perf_results/bench_serving_replicas.json
 """
 
 import argparse
@@ -30,6 +45,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _bank(path, record):
+    """Kill-safe banking: temp-file + atomic rename on every call, so
+    an interrupted sweep keeps every completed point (the bench.py
+    --out contract)."""
+    if not path:
+        return
+    from apex1_tpu.resilience.manifest import atomic_write_json
+    atomic_write_json(path, record)
 
 
 def main():
@@ -54,6 +79,18 @@ def main():
     ap.add_argument("--stagger", type=int, default=2,
                     help="engine steps between arrivals")
     ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--replicas", type=int, nargs="*", default=[],
+                    help="multi-replica sweep points (ServingFrontend; "
+                         "empty = skip the replica axis)")
+    ap.add_argument("--slots-per-replica", type=int, default=4)
+    ap.add_argument("--chaos", action="store_true",
+                    help="kill one replica mid-sweep (seed-keyed, "
+                         "testing.chaos.kill_schedule) and measure "
+                         "goodput across restart + resubmission")
+    ap.add_argument("--chaos-seed", type=int, default=20260804)
+    ap.add_argument("--out", type=str, default=None,
+                    help="bank the record here (atomic write after "
+                         "every sweep point — kill-safe)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model + [1, 4] sweep for the CPU gate "
                          "(correctness/plumbing only: a dispatch-"
@@ -63,6 +100,8 @@ def main():
     if args.smoke:
         args.hidden, args.layers, args.vocab = 128, 2, 256
         args.new, args.loads = 16, [1, 4]
+        if args.replicas:
+            args.replicas = args.replicas[:2]
 
     # examples/tools convention: the env var must beat the container's
     # sitecustomize platform pin; default to CPU for a proxy-able bench
@@ -80,7 +119,8 @@ def main():
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.generate import generate, gpt2_decoder
     from apex1_tpu.models.gpt2 import GPT2, GPT2Config
-    from apex1_tpu.serving import Engine, EngineConfig, ServingMetrics
+    from apex1_tpu.serving import (Backpressure, Engine, EngineConfig,
+                                   ServingMetrics)
 
     max_slots = max(args.loads)
     n_req_max = args.requests_per_slot * max_slots
@@ -192,6 +232,92 @@ def main():
                   "prompt_len": args.prompt_len},
         "sweep": sweep,
     }
+    _bank(args.out, record)
+
+    # ---- replica axis: the same offered load through the supervised
+    # multi-replica frontend (threaded serve loops; the main thread is
+    # the supervision tick) — near-linear scaling is ROADMAP 2(d)'s
+    # acceptance observable, goodput-under-kill is PR 7's
+    if args.replicas:
+        from apex1_tpu.serving import (EngineConfig, FrontendConfig,
+                                       ReplicaConfig, ServingFrontend)
+        from apex1_tpu.testing.chaos import kill_schedule
+
+        slots = args.slots_per_replica
+        record["replica_sweep"] = []
+        for n_rep in args.replicas:
+            n_req = args.requests_per_slot * slots * n_rep
+            e_cfg = EngineConfig(max_slots=slots,
+                                 max_len=max_len,
+                                 prefill_chunk=args.chunk,
+                                 vocab_size=cfg.vocab_size,
+                                 max_queue=max(n_req, 8))
+
+            def make_engine():
+                return Engine(apply_fn, make_cache, params, e_cfg)
+
+            front = ServingFrontend(
+                make_engine,
+                FrontendConfig(
+                    n_replicas=n_rep,
+                    capacity_per_replica=slots + e_cfg.max_queue,
+                    hedge_after_s=None,
+                    # worst-case first step INCLUDES the fresh
+                    # engine's XLA compile — the watchdog must not
+                    # read a compile as a hang
+                    replica=ReplicaConfig(watchdog_s=600.0))).start()
+            # warm every replica's two executables off the clock
+            # (mirrors the engine sweep's warmup; a CHAOS restart's
+            # recompile stays IN the window — that is the honest cost
+            # of the kill)
+            warm = [front.submit(prompts[0], max_new_tokens=2)
+                    for _ in range(n_rep)]
+            front.run_until_drained(timeout_s=1800.0)
+            t0 = time.perf_counter()
+            k = 0
+            while k < n_req:
+                try:
+                    front.submit(prompts[k % len(prompts)],
+                                 max_new_tokens=args.new)
+                    k += 1
+                except Backpressure:
+                    front.pump()
+            fault = None
+            if args.chaos and n_rep > 1:
+                # armed only NOW, offset from the victim's CURRENT
+                # step count: supervisor steps tick on idle iterations
+                # too, so a pre-armed absolute step would fire inside
+                # the off-the-clock warmup and the "chaos" row would
+                # measure an uninterrupted sweep (review finding).
+                # With every request just accepted, the offset lands
+                # mid-decode — streams are genuinely in flight.
+                fault = kill_schedule(args.chaos_seed,
+                                      n_replicas=n_rep, lo=2,
+                                      hi=2 + args.new)
+                fault.at_step += front.replicas[fault.replica].steps
+                front.replicas[fault.replica].fault = fault
+            results = front.run_until_drained(timeout_s=1800.0)
+            dt = time.perf_counter() - t0
+            front.stop()
+            done = [r for rid, r in results.items()
+                    if r.status == "done" and rid not in warm]
+            good_tokens = sum(int(r.tokens.size) for r in done)
+            counters = front.metrics.summary()["counters"]
+            row = {
+                "replicas": n_rep,
+                "requests": n_req,
+                "completed": len(done),
+                "goodput_tokens_per_sec": round(good_tokens / dt, 1),
+                "chaos": bool(fault),
+                "replica_restarts": counters["replica_restarts"],
+            }
+            if fault is not None:
+                row["kill"] = {"replica": fault.replica,
+                               "step": fault.at_step,
+                               "fired": fault.fired}
+            record["replica_sweep"].append(row)
+            _bank(args.out, record)
+
     print(json.dumps(record), flush=True)
     # every sweep point already asserted (a) token parity against the
     # solo-generate oracle for every request and (b) exactly two traced

@@ -22,6 +22,8 @@ echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
 python tools/tune_kernels.py --validate
 echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --smoke
+echo "== serving chaos smoke (replica-kill token parity + poison quarantine, CPU) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --serve-smoke
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q

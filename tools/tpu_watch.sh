@@ -201,6 +201,12 @@ run tune_all        4800 python tools/tune_kernels.py --kernel all
 # record carries skipped_steps + final loss_scale)
 run bench_gpt2_fp16 1200 python bench.py --config gpt2_fp16 --timeout 1000
 run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
+# PR 7 multi-replica serving sweep BEHIND the existing entries: replica
+# scaling + goodput under a seed-keyed replica kill; record banked
+# atomically per sweep point so a dying tunnel keeps completed points
+run bench_serving_rep 1800 python tools/bench_serving.py --loads 8 \
+                         --replicas 1 2 --chaos \
+                         --out perf_results/bench_serving_replicas.json
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
 
 if [ "$MODE" = rehearse ]; then
