@@ -23,3 +23,6 @@ from apex1_tpu.ops.quantized import (  # noqa: F401
 from apex1_tpu.ops.stochastic import (  # noqa: F401
     fold_seed, fused_bias_dropout_add, fused_dropout_add_layer_norm,
     seed_from_key)
+from apex1_tpu.ops.fused_collective import (  # noqa: F401
+    all_gather_flash_attention, fused_all_gather_matmul,
+    fused_matmul_reduce_scatter)

@@ -80,7 +80,14 @@ def _grad_tile(s, t, lse, gcol, valid, smoothing, true_k, padding_idx, dl):
 def _fwd_kernel(x_ref, w_ref, t_ref, off_ref, *out_and_scratch,
                 smoothing, true_k, true_v, padding_idx, bv, n_v,
                 emit_stats):
-    if emit_stats:
+    # emit_stats: False = loss+lse outputs; True = four (bt, 1) stat
+    # outputs; "packed" = ONE (bt, 4) [m, l, tgt, sumx] output written
+    # by the final vocab tile (the fused-collective form: one stat
+    # stream to HBM instead of four, consumed by
+    # ops.fused_collective.fused_vocab_parallel_merge)
+    if emit_stats == "packed":
+        pk_ref = out_and_scratch[0]
+    elif emit_stats:
         m_ref, l_ref, tgt_ref, sx_ref = out_and_scratch[:4]
     else:
         loss_ref, lse_ref = out_and_scratch[:2]
@@ -112,7 +119,11 @@ def _fwd_kernel(x_ref, w_ref, t_ref, off_ref, *out_and_scratch,
 
     @pl.when(vi == n_v - 1)
     def _():
-        if emit_stats:
+        if emit_stats == "packed":
+            pk_ref[...] = jnp.concatenate(
+                [m_scr[:, :1], l_scr[:, :1], tgt_scr[:, :1],
+                 sx_scr[:, :1]], axis=1)
+        elif emit_stats:
             m_ref[...] = m_scr[:, :1]
             l_ref[...] = l_scr[:, :1]
             tgt_ref[...] = tgt_scr[:, :1]
@@ -284,6 +295,36 @@ def shard_stats(x2, w_shard, t2, *, col_offset=0, num_classes=None,
         interpret=interpret_mode(),
     )(xp, wp, tp, _off_array(col_offset))
     return tuple(o[:g["T"], 0] for o in outs)
+
+
+def shard_stats_packed(x2, w_shard, t2, *, col_offset=0, num_classes=None,
+                       block_t=None, block_v=None):
+    """`shard_stats` with the four per-shard stats PACKED into one
+    (T, 4) ``[m, l, tgt, sumx]`` output by the kernel's final vocab
+    tile — one stat stream to HBM instead of four, and the shape
+    `ops.fused_collective.fused_vocab_parallel_merge` consumes with a
+    single packed psum (two collectives total instead of four). The
+    packed values are bit-identical to `shard_stats`' (same scratch
+    reads, same tile). NOT differentiable on its own; the vocab-parallel
+    wrapper owns the VJP."""
+    xp, wp, tp, g = _prep(x2, w_shard, t2, block_t, block_v)
+    k = num_classes if num_classes is not None else g["V"]
+    x_spec, w_spec, stat_spec, off_spec = _specs(g)
+    pk_spec = pl.BlockSpec((g["bt"], 4), lambda i0, i1: (i0, 0),
+                           memory_space=pltpu.VMEM)
+    Tp = g["n_t"] * g["bt"]
+    packed = pl.pallas_call(
+        functools.partial(_fwd_kernel, smoothing=0.0, true_k=k,
+                          true_v=g["V"], padding_idx=None, bv=g["bv"],
+                          n_v=g["n_v"], emit_stats="packed"),
+        grid=(g["n_t"], g["n_v"]),
+        in_specs=[x_spec, w_spec, stat_spec, off_spec],
+        out_specs=pk_spec,
+        out_shape=out_struct((Tp, 4), jnp.float32, xp, wp, tp),
+        scratch_shapes=[pltpu.VMEM((g["bt"], _LANES), jnp.float32)] * 4,
+        interpret=interpret_mode(),
+    )(xp, wp, tp, _off_array(col_offset))
+    return packed[:g["T"]]
 
 
 def shard_grads(x2, w_shard, t2, lse, dloss, *, col_offset=0,

@@ -265,6 +265,25 @@ def _check_body_dependence(body, instrs, compute_idx, comps):
         detail="no compute op depends on an in-body collective-permute")
 
 
+def count_collectives(hlo_text, prefixes=("all-reduce",)):
+    """Count instructions whose opcode starts with any of ``prefixes``
+    across every computation (async pairs count once via their -start).
+    The structural pin for fusions that REDUCE the collective count
+    rather than overlap it — e.g. the fused vocab-parallel linear_xent
+    merge (2 all-reduces: one pmax + one packed psum) against its
+    decomposed 4-collective ladder (the falsifiable negative control:
+    the decomposed program must count higher)."""
+    comps = parse_computations(hlo_text)
+    n = 0
+    for instrs in comps.values():
+        for ins in instrs:
+            if any(ins.opcode.startswith(p) for p in prefixes):
+                if ins.opcode.endswith("-done"):
+                    continue  # its -start was already counted
+                n += 1
+    return n
+
+
 def check_collective_overlap(hlo_text):
     """Probe every while-loop body that carries both collective-permutes
     and compute ops. Returns a `ProbeReport`; ``ok`` iff at least one
@@ -368,6 +387,47 @@ def _self_check():
             "negative control failed: the serialized ring must FAIL the "
             f"overlap probe, got ok={srep.ok} bodies={len(srep.bodies)}")
     print("  OK   serialized ring FAILS the probe (negative control)")
+
+    # fused comm-kernels (ops.fused_collective): the SP-boundary fused
+    # matmuls must pass the same dependence probe (their ring hops are
+    # carry-only), and the serialized rotate-then-dot form must FAIL —
+    # the PR 9 additions to this gate
+    from jax.sharding import PartitionSpec as P2
+    from apex1_tpu.ops.fused_collective import (
+        fused_all_gather_matmul, fused_all_gather_matmul_serial,
+        fused_matmul_reduce_scatter)
+
+    tp_mesh = make_mesh(tp=4, dp=1, devices=jax.devices()[:4])
+    S_l, hid, ffn = 32, 16, 24
+    x = jnp.asarray(rng.normal(size=(S_l * 4, hid)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(hid, ffn)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(ffn, hid)), jnp.float32)
+
+    def fused_mlp(x, w1, w2):
+        h = fused_all_gather_matmul(x, w1, "tp", 0)
+        return fused_matmul_reduce_scatter(
+            h.astype(jnp.float32), w2, "tp", 0)
+
+    fsm = jax.shard_map(fused_mlp, mesh=tp_mesh,
+                        in_specs=(P2("tp"), P2(None, "tp"),
+                                  P2("tp", None)),
+                        out_specs=P2("tp"), check_vma=False)
+    rep = assert_collective_overlap(optimized_hlo(fsm, x, w1, w2),
+                                    expect_mode="dependence")
+    print(f"  OK   fused SP matmuls overlapped [{rep.mode}] "
+          f"{len(rep.bodies)} loop body(ies)")
+
+    ssm = jax.shard_map(
+        lambda x, w: fused_all_gather_matmul_serial(x, w, "tp", 0),
+        mesh=tp_mesh, in_specs=(P2("tp"), P2(None, "tp")),
+        out_specs=P2(None, "tp"), check_vma=False)
+    srep = check_collective_overlap(optimized_hlo(ssm, x, w1))
+    if srep.ok or not srep.bodies:
+        raise AssertionError(
+            "negative control failed: the serialized fused all-gather "
+            f"matmul must FAIL, got ok={srep.ok} "
+            f"bodies={len(srep.bodies)}")
+    print("  OK   serialized fused AG-matmul FAILS (negative control)")
     print("hlo_probe self-check PASSED")
 
 

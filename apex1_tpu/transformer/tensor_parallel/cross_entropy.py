@@ -140,11 +140,11 @@ def _xla_shard_grads(x2, w_shard, t2, lse, dloss, off, smoothing,
     return dx, dw
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _vp_fused(x2, w_shard, t2, axis_name, smoothing, padding_idx,
-              num_classes):
+              num_classes, fused_merge=False):
     return _vp_fused_fwd(x2, w_shard, t2, axis_name, smoothing,
-                         padding_idx, num_classes)[0]
+                         padding_idx, num_classes, fused_merge)[0]
 
 
 def _vp_merge(m, l, tgt, sumx, axis_name):
@@ -160,16 +160,33 @@ def _vp_k(w_shard, axis_name, num_classes):
 
 
 def _vp_fused_fwd(x2, w_shard, t2, axis_name, smoothing, padding_idx,
-                  num_classes):
+                  num_classes, fused_merge=False):
     k = _vp_k(w_shard, axis_name, num_classes)
     off = jax.lax.axis_index(axis_name) * w_shard.shape[0]
-    if use_pallas():
-        from apex1_tpu.ops.linear_xent import shard_stats
-        m, l, tgt, sumx = shard_stats(x2, w_shard, t2, col_offset=off,
-                                      num_classes=k)
+    if fused_merge:
+        # fused comm-kernel form (ops.fused_collective): the kernel's
+        # final vocab tile packs [m, l, tgt, sumx] into ONE stat stream
+        # and the cross-shard ladder collapses to pmax + one packed
+        # psum (2 collectives instead of 4) — bitwise the decomposed
+        # path's numbers (packed psum reduces lanes independently)
+        from apex1_tpu.ops.fused_collective import (
+            fused_vocab_parallel_merge)
+        if use_pallas():
+            from apex1_tpu.ops.linear_xent import shard_stats_packed
+            stats = shard_stats_packed(x2, w_shard, t2, col_offset=off,
+                                       num_classes=k)
+        else:
+            m, l, tgt, sumx = _xla_shard_stats(x2, w_shard, t2, off, k)
+            stats = jnp.stack([m, l, tgt, sumx], axis=-1)
+        lse, tgt, sumx = fused_vocab_parallel_merge(stats, axis_name)
     else:
-        m, l, tgt, sumx = _xla_shard_stats(x2, w_shard, t2, off, k)
-    lse, tgt, sumx = _vp_merge(m, l, tgt, sumx, axis_name)
+        if use_pallas():
+            from apex1_tpu.ops.linear_xent import shard_stats
+            m, l, tgt, sumx = shard_stats(x2, w_shard, t2, col_offset=off,
+                                          num_classes=k)
+        else:
+            m, l, tgt, sumx = _xla_shard_stats(x2, w_shard, t2, off, k)
+        lse, tgt, sumx = _vp_merge(m, l, tgt, sumx, axis_name)
     loss = ((1.0 - smoothing) * (lse - tgt)
             + smoothing * (lse - sumx / k))
     if padding_idx is not None:
@@ -178,7 +195,7 @@ def _vp_fused_fwd(x2, w_shard, t2, axis_name, smoothing, padding_idx,
 
 
 def _vp_fused_bwd(axis_name, smoothing, padding_idx, num_classes,
-                  res, dloss):
+                  fused_merge, res, dloss):
     x2, w_shard, t2, lse = res
     k = _vp_k(w_shard, axis_name, num_classes)
     off = jax.lax.axis_index(axis_name) * w_shard.shape[0]
@@ -206,7 +223,8 @@ def vocab_parallel_linear_cross_entropy(x, w_shard, labels, *,
                                         label_smoothing: float = 0.0,
                                         padding_idx: int | None = None,
                                         num_classes: int | None = None,
-                                        sequence_parallel_input=False):
+                                        sequence_parallel_input=False,
+                                        fused: bool = False):
     """CE of ``softmax(x @ global_Wᵀ)`` with W vocab-sharded over
     ``axis_name`` — on TPU, logits (even the local slice) never
     materialize. Runs inside ``shard_map``; shards must be equal-sized
@@ -227,6 +245,16 @@ def vocab_parallel_linear_cross_entropy(x, w_shard, labels, *,
 
     Returns per-token fp32 loss, identical on every rank.
     ``num_classes`` masks global lane-pad columns.
+
+    ``fused=True`` (opt-in, default off = the untouched legacy path):
+    the fused comm-kernel merge — per-shard stats packed into one
+    kernel output by the final vocab tile
+    (`ops.linear_xent.shard_stats_packed`) and the pmax/psum ladder
+    collapsed to TWO collectives
+    (`ops.fused_collective.fused_vocab_parallel_merge`). Bitwise the
+    same loss as ``fused=False`` (pinned by test_fused_collective;
+    structural 2-vs-4 collective count pinned via
+    `testing.hlo_probe.count_collectives`).
     """
     from apex1_tpu.transformer.tensor_parallel.mappings import (
         copy_to_tensor_model_parallel_region)
@@ -248,6 +276,6 @@ def vocab_parallel_linear_cross_entropy(x, w_shard, labels, *,
         raise ValueError(f"num_classes {num_classes} must be in "
                          f"(0, {vocab}]")
     loss = _vp_fused(x2, w_shard, t2, axis_name, float(label_smoothing),
-                     padding_idx, num_classes)
+                     padding_idx, num_classes, bool(fused))
     lead = labels.shape
     return loss.reshape(lead)

@@ -158,7 +158,8 @@ class VocabParallelEmbedding(nn.Module):
 def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
                            gather_output=False,
                            sequence_parallel_enabled=False,
-                           axis_name=AXIS_TP, overlap=False):
+                           axis_name=AXIS_TP, overlap=False,
+                           fused=False):
     """x: replicated (or seq-sharded under SP); kernel_shard: (in, out/tp).
 
     Reference fwd: ``copy_to_tensor_model_parallel_region`` (identity fwd /
@@ -169,8 +170,22 @@ def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
     `mappings.all_gather_matmul` ring so each ICI transfer hides behind
     a partial dot (fwd and bwd). Off by default — the legacy monolithic
     collective path is bit-for-bit untouched when ``overlap=False``.
+
+    ``fused`` (opt-in, SP path only, exclusive with ``overlap``): the
+    fused comm-kernel form — the same chunk-pipelined ring with each
+    per-chunk dot running in the `ops.fused_collective._chunk_matmul`
+    Pallas kernel (bitwise the ``overlap=True`` numbers on the CPU
+    mesh; see docs/parallel.md "Fused comm-kernels").
     """
-    if sequence_parallel_enabled and overlap:
+    if overlap and fused:
+        raise ValueError("overlap= and fused= are exclusive: fused IS "
+                         "the overlapped ring with the dot in a Pallas "
+                         "kernel — pick one")
+    if sequence_parallel_enabled and fused:
+        from apex1_tpu.ops.fused_collective import fused_all_gather_matmul
+        y = fused_all_gather_matmul(x, kernel_shard, axis_name, 0)
+        y = y.astype(x.dtype)
+    elif sequence_parallel_enabled and overlap:
         y = mp.all_gather_matmul(x, kernel_shard, axis_name, 0)
         y = y.astype(x.dtype)
     else:
@@ -191,7 +206,7 @@ def column_parallel_linear(x, kernel_shard, bias_shard=None, *,
 def row_parallel_linear(x_parallel, kernel_shard, bias=None, *,
                         input_is_parallel=True,
                         sequence_parallel_enabled=False,
-                        axis_name=AXIS_TP, overlap=False):
+                        axis_name=AXIS_TP, overlap=False, fused=False):
     """x_parallel: (..., in/tp); kernel_shard: (in/tp, out).
 
     ``overlap`` (opt-in, sequence-parallel path only): decompose the
@@ -199,11 +214,27 @@ def row_parallel_linear(x_parallel, kernel_shard, bias=None, *,
     `mappings.matmul_reduce_scatter` ring (transfers hidden behind the
     per-chunk partial dots, fwd and bwd). Off by default — legacy path
     bit-for-bit untouched when ``overlap=False``.
+
+    ``fused`` (opt-in, SP path only, exclusive with ``overlap``): the
+    fused comm-kernel reduce-scatter
+    (`ops.fused_collective.fused_matmul_reduce_scatter`) — the PR 4
+    travelling-accumulator ring with the per-chunk dot in a Pallas
+    kernel; bitwise the ``overlap=True`` numbers on the CPU mesh.
     """
+    if overlap and fused:
+        raise ValueError("overlap= and fused= are exclusive: fused IS "
+                         "the overlapped ring with the dot in a Pallas "
+                         "kernel — pick one")
     if not input_is_parallel:
         x_parallel = mp.scatter_to_tensor_model_parallel_region(
             x_parallel, axis_name)
-    if sequence_parallel_enabled and overlap:
+    if sequence_parallel_enabled and fused:
+        from apex1_tpu.ops.fused_collective import (
+            fused_matmul_reduce_scatter)
+        y = fused_matmul_reduce_scatter(x_parallel, kernel_shard,
+                                        axis_name, 0)
+        y = y.astype(x_parallel.dtype)
+    elif sequence_parallel_enabled and overlap:
         y = mp.matmul_reduce_scatter(x_parallel, kernel_shard,
                                      axis_name, 0)
         y = y.astype(x_parallel.dtype)

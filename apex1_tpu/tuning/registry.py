@@ -93,6 +93,33 @@ def _linear_xent_check(blocks, dims, es, budget):
     return ok, est
 
 
+def _cm_check(blocks, dims, es, budget):
+    """Fused-collective chunk matmul (`ops.fused_collective.
+    _chunk_matmul`, the tile loop of the ppermute-ring and RDMA
+    reduce-scatter forms): x (bm, Kp) and w (Kp, bn) operand blocks
+    (double-buffered, input dtype) + the fp32 (bm, bn) output block.
+    K is untiled by design (one MXU dot per output tile, no cross-grid
+    accumulation), so Kp itself bounds the frame."""
+    bm, bn = blocks["block_m"], blocks["block_n"]
+    kp = dims["Kp"]
+    est = _DB * es * (bm * kp + kp * bn) + _DB * 4 * bm * bn
+    return est <= budget, est
+
+
+def _agf_check(blocks, dims, es, budget):
+    """All-gather-fused flash attention (`ops.fused_collective.
+    _agf_kernel`): the flash frame plus the carried fp32 (prev_out,
+    prev_lse) merge operands and the fp32 merged output block the
+    epilogue writes (the plain kernel's output is input-dtype)."""
+    ok, est = _flash_check(blocks, dims, es, budget)
+    bq, dp = blocks["block_q"], dims["Dp"]
+    extra = (_DB * 4 * (bq * dp + bq * _LANES)   # prev_out, prev_lse in
+             + _DB * 4 * bq * dp                 # merged fp32 out
+             - _DB * es * bq * dp)               # replaces q-dtype out
+    est = est + extra
+    return est <= budget, est
+
+
 def _int8_check(blocks, dims, _es, budget):
     """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
     ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
@@ -124,6 +151,10 @@ SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
                                                    # never stored
     KernelSpec("linear_xent", ("block_t", "block_v"), ("Hp",), 16,
                _linear_xent_check),
+    KernelSpec("fused_collective_matmul", ("block_m", "block_n"),
+               ("Kp",), 16, _cm_check),
+    KernelSpec("fused_ag_flash", ("block_q", "block_k"), ("Dp", "Sb"),
+               16, _agf_check),
     KernelSpec("int8_matmul", ("block_n", "block_k"), ("N", "K"), 128,
                _int8_check),
 )}

@@ -99,14 +99,21 @@ def _remove_stale_libtpu_lockfile(path="/tmp/libtpu_lockfile"):
         os.close(fd)
 
 
-def timed_steps(train_step, state, batch, iters):
+def timed_steps(train_step, state, batch, iters, *, profile_dir=None):
     """(seconds/step, flops/step, final metrics, final state) with the
     loop in one dispatch.
 
     The many-step loop is AOT-lowered so ``cost_analysis`` can price one
     dispatch (→ MFU) without a second compile; the sync reduction covers
     every output leaf because on the tunneled backend reading back one
-    output does not imply the whole program ran."""
+    output does not imply the whole program ran.
+
+    ``profile_dir``: capture ONE extra (untimed) dispatch under
+    ``jax.profiler.trace`` into this directory after the measured run —
+    the ROADMAP-5 flywheel's trace-banking hook (a hardware window
+    leaves a per-op breakdown artifact next to every record instead of
+    a number alone). Profiling failure is swallowed: a trace must never
+    cost the measurement."""
 
     def many_steps(state):
         def body(_, carry):
@@ -140,6 +147,22 @@ def timed_steps(train_step, state, batch, iters):
     loss = float(metrics["loss"])
     if not math.isfinite(loss):
         raise RuntimeError(f"benchmark loss is not finite: {loss}")
+    if profile_dir:
+        try:
+            os.makedirs(profile_dir, exist_ok=True)
+            # the profiled dispatch runs on a COPY (donate_argnums=0
+            # would otherwise eat the state we return) and its outputs
+            # are discarded — the returned metrics/state and any banked
+            # checkpoint stay exactly the measured run's, profiled or
+            # not
+            state_copy = jax.tree_util.tree_map(jnp.copy, state)
+            with jax.profiler.trace(profile_dir):
+                prof_out = compiled(state_copy)
+                float(_reduce_all(prof_out))
+            del prof_out
+        except Exception as e:
+            print(f"WARNING: profile capture failed ({e}); record will "
+                  f"carry no artifact", file=sys.stderr, flush=True)
     # final metrics + state ride along so configs can surface state
     # evidence (fp16 O1: skipped_steps + final loss_scale) and bank a
     # resume checkpoint of the trained state (--ckpt-dir)
@@ -754,8 +777,21 @@ def main():
                     if resumed_from is not None:
                         state = jax.tree_util.tree_map(jnp.asarray,
                                                        host_restored)
+                # on-silicon runs bank a profiler trace as a PRODUCT of
+                # the window (ROADMAP item 5): one untimed dispatch
+                # under jax.profiler.trace, its directory stamped on the
+                # record as `profile_artifact`. APEX1_BENCH_PROFILE=0
+                # opts out; CPU smoke runs never profile.
+                pdir = None
+                if on_accel and os.environ.get(
+                        "APEX1_BENCH_PROFILE", "1") != "0":
+                    pdir = os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "perf_results", "profiles",
+                        f"{args.config}_b{b}_{int(time.time())}")
                 (per_step, flops_per_step, final_metrics,
-                 final_state) = timed_steps(step, state, batch, iters)
+                 final_state) = timed_steps(step, state, batch, iters,
+                                            profile_dir=pdir)
                 rate = units_per_step / per_step
                 if rate > best_rate:   # unrounded comparison
                     best_rate = rate
@@ -766,6 +802,11 @@ def main():
                         "unit": unit,
                         "vs_baseline": round(rate / proxy, 4),
                     }
+                    if pdir is not None and os.path.isdir(pdir) \
+                            and os.listdir(pdir):
+                        best["profile_artifact"] = os.path.relpath(
+                            pdir, os.path.dirname(
+                                os.path.abspath(__file__)))
                     if resumed_from:
                         # provenance: this number continued from a banked
                         # checkpoint, not a fresh init

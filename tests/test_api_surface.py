@@ -25,7 +25,12 @@ SURFACE = {
     "apex1_tpu.ops.stochastic": [
         "fused_bias_dropout_add", "fused_dropout_add_layer_norm",
         "seed_from_key", "fold_seed"],
-    "apex1_tpu.ops.linear_xent": ["linear_cross_entropy"],
+    "apex1_tpu.ops.linear_xent": ["linear_cross_entropy",
+                                  "shard_stats_packed"],
+    "apex1_tpu.ops.fused_collective": [
+        "fused_matmul_reduce_scatter", "fused_all_gather_matmul",
+        "fused_all_gather_matmul_serial", "all_gather_flash_attention",
+        "fused_vocab_parallel_merge", "matmul_reduce_scatter_rdma"],
     "apex1_tpu.parallel": [
         "DistributedDataParallel", "SyncBatchNorm",
         "convert_syncbn_model"],

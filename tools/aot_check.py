@@ -421,6 +421,144 @@ def main():
         probe(f"overlap probe: serialized ring (negative)",
               ring_serial_builder, expect_fail=True)
 
+        # --- fused comm-kernels (PR 9, ops.fused_collective): Mosaic
+        # lowering + async overlap probes for the forms tier-1 can only
+        # execute in interpret mode. Positive/negative pairs per the
+        # probe-falsifiability rule. The RDMA kernel below has NO
+        # XLA collective at all — its gate is the compile itself
+        # (numerics UNVERIFIED until the hardware window runs
+        # tools/bench_fused_comm.py --rdma).
+        from apex1_tpu.ops.fused_collective import (
+            all_gather_flash_attention, fused_all_gather_matmul,
+            fused_all_gather_matmul_serial, fused_matmul_reduce_scatter,
+            matmul_reduce_scatter_rdma)
+
+        tp_mesh3 = make_mesh(tp=n, dp=1, devices=list(topo.devices))
+        S_f, hid_f, ffn_f = 8192, 2048, 8192
+        ns3 = lambda spec: NamedSharding(tp_mesh3, spec)
+        fused_arrs = [
+            jax.ShapeDtypeStruct((S_f, hid_f), jnp.bfloat16,
+                                 sharding=ns3(P("tp"))),
+            jax.ShapeDtypeStruct((hid_f, ffn_f), jnp.bfloat16,
+                                 sharding=ns3(P(None, "tp"))),
+            jax.ShapeDtypeStruct((ffn_f, hid_f), jnp.bfloat16,
+                                 sharding=ns3(P("tp", None))),
+        ]
+
+        def fused_mlp_builder():
+            def local(x, w1, w2):
+                with force_impl("pallas"):
+                    h = fused_all_gather_matmul(x, w1, "tp", 0)
+                    return fused_matmul_reduce_scatter(
+                        h.astype(jnp.bfloat16), w2, "tp", 0)
+
+            f = jax.shard_map(
+                local, mesh=tp_mesh3,
+                in_specs=(P("tp"), P(None, "tp"), P("tp", None)),
+                out_specs=P("tp"), check_vma=False)
+            return f, fused_arrs
+
+        def fused_serial_builder():
+            def local(x, w1):
+                with force_impl("pallas"):
+                    return fused_all_gather_matmul_serial(x, w1, "tp", 0)
+
+            f = jax.shard_map(
+                local, mesh=tp_mesh3,
+                in_specs=(P("tp"), P(None, "tp")),
+                out_specs=P(None, "tp"), check_vma=False)
+            return f, fused_arrs[:2]
+
+        probe(f"overlap probe: fused SP matmuls tp={n}",
+              fused_mlp_builder)
+        probe("overlap probe: serialized fused AG-matmul (negative)",
+              fused_serial_builder, expect_fail=True)
+
+        def agf_builder():
+            # the 16k GQA llama_longctx target shape, merge fused into
+            # the kernel epilogue
+            def local(q, k, v):
+                with force_impl("pallas"):
+                    return all_gather_flash_attention(q, k, v, "cp",
+                                                      causal=True)
+            return jax.shard_map(local, mesh=cp_mesh,
+                                 in_specs=(cp_spec,) * 3,
+                                 out_specs=cp_spec,
+                                 check_vma=False), [
+                jax.ShapeDtypeStruct((1, 32, 16384, 64), jnp.bfloat16,
+                                     sharding=NamedSharding(cp_mesh,
+                                                            cp_spec)),
+                jax.ShapeDtypeStruct((1, 4, 16384, 64), jnp.bfloat16,
+                                     sharding=NamedSharding(cp_mesh,
+                                                            cp_spec)),
+                jax.ShapeDtypeStruct((1, 4, 16384, 64), jnp.bfloat16,
+                                     sharding=NamedSharding(cp_mesh,
+                                                            cp_spec))]
+
+        probe(f"overlap probe: fused AG-flash 16k GQA cp={n}",
+              agf_builder)
+
+        def agf_bwd_builder():
+            f, arrs = agf_builder()
+
+            def loss(q, k, v):
+                return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+
+            return jax.grad(loss, argnums=(0, 1, 2)), arrs
+
+        probe(f"overlap probe: fused AG-flash fwd+bwd cp={n}",
+              agf_bwd_builder)
+
+        def fused_vp_ce_builder():
+            # packed-stat kernel + 2-collective merge, Mosaic-lowered
+            from apex1_tpu.transformer.tensor_parallel.cross_entropy \
+                import vocab_parallel_linear_cross_entropy
+            T, Hd, V = 8192, 2048, 50432
+
+            def local(x, w, t):
+                with force_impl("pallas"):
+                    return vocab_parallel_linear_cross_entropy(
+                        x, w, t, axis_name="tp", fused=True,
+                        num_classes=V - 200)
+
+            f = jax.shard_map(local, mesh=tp_mesh3,
+                              in_specs=(P(), P("tp", None), P()),
+                              out_specs=P(), check_vma=False)
+            arrs = [jax.ShapeDtypeStruct((T, Hd), jnp.bfloat16,
+                                         sharding=ns3(P())),
+                    jax.ShapeDtypeStruct((V, Hd), jnp.bfloat16,
+                                         sharding=ns3(P("tp", None))),
+                    jax.ShapeDtypeStruct((T,), jnp.int32,
+                                         sharding=ns3(P()))]
+            return f, arrs
+
+        coll(f"fused vocab-parallel linear CE tp={n} (packed merge)",
+             fused_vp_ce_builder)
+
+        def rdma_builder():
+            def local(x, w):
+                with force_impl("pallas"):
+                    return matmul_reduce_scatter_rdma(x, w, "tp")
+
+            f = jax.shard_map(local, mesh=tp_mesh3,
+                              in_specs=(P(None, "tp"), P("tp", None)),
+                              out_specs=P("tp", None), check_vma=False)
+            # per-shard (S=1024, K=1024, N=512): chunk 256 -> frame =
+            # 2 send + 2 recv fp32 slots (2 MiB) + double-buffered
+            # x/w/out blocks ~ 6 MiB, inside the v5e budget. The
+            # kernel's VMEM rule (established BY this gate): the four
+            # fp32 chunk slots cost 16*chunk*N bytes — chunk*N above
+            # ~0.5M elements OOMs v5e alongside the operand blocks
+            # (chunk=512, K=1024, N=1024 measured RESOURCE_EXHAUSTED).
+            arrs = [jax.ShapeDtypeStruct((1024, 1024 * n), jnp.bfloat16,
+                                         sharding=ns3(P(None, "tp"))),
+                    jax.ShapeDtypeStruct((1024 * n, 512), jnp.bfloat16,
+                                         sharding=ns3(P("tp", None)))]
+            return f, arrs
+
+        coll(f"RDMA matmul->reduce-scatter kernel tp={n} (compile "
+             f"gate; numerics await hardware)", rdma_builder)
+
         def tp_overlap_builder():
             # chunk-pipelined decomposed collective matmuls (the
             # overlap= path of Column/RowParallelLinear under SP)

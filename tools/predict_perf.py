@@ -345,7 +345,72 @@ def predict_comms():
     return rows
 
 
-def render(step_rows, kernel_rows, comms_rows=()):
+def predict_comms_fused():
+    """Analytic ICI term for the Megatron-SP boundary matmul at a
+    llama-8B-ish MLP shape, priced across the THREE schedules the repo
+    now ships (docs/parallel.md "Fused comm-kernels"):
+
+    - ``serial``: the monolithic collective (or the rotate-then-dot
+      negative control) — every byte exposed.
+    - ``overlap``: PR 4's chunk-pipelined ppermute ring AND the fused
+      ppermute form (`ops.fused_collective.fused_matmul_reduce_scatter`,
+      same schedule with the dot in a Pallas kernel) — exposed = the
+      per-hop residual the chunk dot cannot cover. This is the
+      BEST-CASE number: it assumes the XLA scheduler actually hoists
+      every permute (hlo_probe pins the dependence shape, not the
+      achieved schedule).
+    - ``fused_rdma``: the single-kernel RDMA form
+      (`matmul_reduce_scatter_rdma`) — grid-sequenced overlap, so the
+      bound is STRUCTURAL, not scheduler-dependent: exposed ≈ the
+      prologue hop (pipeline fill) plus the same bandwidth residual;
+      on compute-rich shapes that is the prologue hop only.
+
+    bench.py's `roofline_ratio` prices a record's `ici_exposed_bytes`
+    at the per-link rate, so the three forms are scored honestly
+    against each other, not assumed free.
+    """
+    from apex1_tpu.core.capability import get_capability, ici_link_gbps
+
+    S, hid, ffn = 8192, 4096, 14336   # global seq, llama-8B MLP dims
+    rows = []
+    for gen in ("v5e", "v5p"):
+        cap = get_capability(gen)
+        link = ici_link_gbps(gen)
+        if not link:
+            print(f"  SKIP fused comms {gen}: no ici_gbps in capability "
+                  f"row", flush=True)
+            continue
+        for n in (4, 8):
+            # matmul->reduce-scatter at the row-parallel boundary:
+            # x (S, ffn/n) @ w (ffn/n, hid), travelling fp32 chunk acc
+            chunk_rows = S // n
+            hop = chunk_rows * hid * 4                    # fp32 acc hop
+            dot = 2 * chunk_rows * (ffn // n) * hid       # per-step MXU
+            t_hop = hop / (link * 1e9)
+            t_dot = dot / (cap.bf16_tflops * 1e12)
+            total = n * hop
+            resid = n * max(0.0, t_hop - t_dot) * (link * 1e9)
+            fused_exposed = hop + resid                  # prologue hop
+            rows.append(dict(
+                name=f"SP matmul_reduce_scatter tp={n}",
+                generation=gen, tp=n,
+                ici_bytes=float(total),
+                exposed_bytes_serial=float(total),
+                exposed_bytes_overlap=float(resid),
+                exposed_bytes_fused=float(fused_exposed),
+                t_serial_ms=n * t_hop * 1e3,
+                t_exposed_overlap_ms=(resid / (link * 1e9)) * 1e3,
+                t_exposed_fused_ms=(fused_exposed / (link * 1e9)) * 1e3,
+                source="analytic"))
+            print(f"  OK   fused comms {gen} tp={n}: hop "
+                  f"{hop / 2**20:.1f} MiB vs dot {t_dot * 1e3:.2f} ms "
+                  f"-> exposed serial {total / 2**20:.0f} / overlap "
+                  f"{resid / 2**20:.1f} / fused {fused_exposed / 2**20:.1f}"
+                  f" MiB", flush=True)
+    return rows
+
+
+def render(step_rows, kernel_rows, comms_rows=(), fused_rows=()):
     from apex1_tpu.core.capability import get_capability
     v5e, v5p = get_capability("v5e"), get_capability("v5p")
     lines = []
@@ -456,6 +521,31 @@ def render(step_rows, kernel_rows, comms_rows=()):
               f"| {r['t_serial_ms']:.2f} "
               f"| {r['t_exposed_overlap_ms']:.2f} |")
         w("")
+    if fused_rows:
+        w("## ICI comms term — fused comm-kernels at the SP boundary "
+          "(analytic)")
+        w("")
+        w("Three schedules for the same matmul+reduce-scatter "
+          "(`tools/predict_perf.py::predict_comms_fused`): `serial` "
+          "exposes every byte; `overlap` (PR 4's ppermute ring and the "
+          "fused ppermute form — same schedule, dot in a Pallas "
+          "kernel) exposes only the per-hop residual the chunk dot "
+          "cannot cover; `fused rdma` "
+          "(`ops.fused_collective.matmul_reduce_scatter_rdma`) "
+          "exposes ≈ the prologue hop only — tile-granular overlap "
+          "inside one kernel. `tools/bench_fused_comm.py` measures "
+          "the same three forms (queued as fused_comm_ab).")
+        w("")
+        w("| boundary | gen | tp | ICI MiB | exposed serial ms "
+          "| exposed overlap ms | exposed fused ms |")
+        w("|---|---|---|---|---|---|---|")
+        for r in fused_rows:
+            w(f"| {r['name']} | {r['generation']} | {r['tp']} "
+              f"| {r['ici_bytes'] / 2**20:,.1f} "
+              f"| {r['t_serial_ms']:.2f} "
+              f"| {r['t_exposed_overlap_ms']:.2f} "
+              f"| {r['t_exposed_fused_ms']:.2f} |")
+        w("")
     w("Validation protocol for the first hardware window: "
       "`tools/tpu_watch.sh`'s queue writes measured step_ms/MFU for "
       "every config above; divide measured by predicted and record the "
@@ -496,8 +586,11 @@ def main():
         kernel_rows = predict_kernels(topo)
     print("== ICI comms term (ring attention, analytic) ==", flush=True)
     comms_rows = predict_comms()
+    print("== ICI comms term (fused SP boundary, analytic) ==",
+          flush=True)
+    fused_rows = predict_comms_fused()
 
-    md = render(step_rows, kernel_rows, comms_rows)
+    md = render(step_rows, kernel_rows, comms_rows, fused_rows)
     for path in (args.out, args.json):
         d = os.path.dirname(path)
         if d:
@@ -506,11 +599,13 @@ def main():
         f.write(md)
     with open(args.json, "w") as f:
         json.dump({"topology": TOPOLOGY, "steps": step_rows,
-                   "kernels": kernel_rows, "comms": comms_rows},
+                   "kernels": kernel_rows, "comms": comms_rows,
+                   "comms_fused": fused_rows},
                   f, indent=1)
     print(f"wrote {args.out} + {args.json}", flush=True)
     failures = sum("error" in r
-                   for r in step_rows + kernel_rows + comms_rows)
+                   for r in step_rows + kernel_rows + comms_rows
+                   + fused_rows)
     print(f"{failures} failures" if failures else "ALL OK", flush=True)
     sys.exit(1 if failures else 0)
 

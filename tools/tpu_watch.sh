@@ -20,6 +20,10 @@
 #      the 16k llama_longctx shape (needs >= 2 devices; emits a
 #      skip record on a single-chip window), also BEFORE the
 #      llama_longctx re-bench                                 (~10 min)
+#   4c. fused_comm_ab: fused vs decomposed vs serialized comm
+#      kernels (SP boundary MLP + fused-merge ring attention +
+#      the RDMA reduce-scatter's first execution/parity datum),
+#      also BEFORE the llama_longctx re-bench                 (~10 min)
 #   5. llama_longctx re-bench; bert_dropout (PR5 fused in-kernel
 #      dropout — the headline BERT-pretrain config) AHEAD of the
 #      plain bert re-bench; remaining configs                (~25 min)
@@ -176,6 +180,12 @@ run tune_attention  1800 python tools/tune_kernels.py --kernel attention
 # llama_longctx re-bench (the overlap layer is the claimed fix for its
 # 0.36x roofline ratio — measure the claim before the headline number)
 run ring_overlap_ab 1800 python tools/bench_ring_ab.py
+# fused-vs-decomposed comm-kernel A/B (PR 9 ops.fused_collective): SP
+# boundary MLP + fused-merge ring attention + the RDMA kernel's first
+# execution/parity datum — AHEAD of the llama_longctx re-bench so the
+# 16k number rides whichever form wins (needs >= 2 devices; emits a
+# skip record on a single-chip window)
+run fused_comm_ab   1800 python tools/bench_fused_comm.py --rdma
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
 # dropout=0.1 bert variant FIRST (PR5: attention-probability dropout now
 # rides the flash kernel + fused dropout-add-LN epilogues — this is the

@@ -273,6 +273,76 @@ def case_bias_dropout_add(tiny):
     return _row_case("bias_dropout_add", tiny, build)
 
 
+def case_fused_matmul(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.fused_collective import _chunk_matmul
+    from apex1_tpu.tuning import padded_lanes
+
+    # the SP-boundary chunk shape (per-ring-step rows x hidden-shard):
+    # one ring step's dot is what the ppermute/RDMA forms launch
+    M, K, N = (64, 128, 128) if tiny else (1024, 1024, 4096)
+    cands = ([(32, 128), (64, 128)] if tiny else
+             [(128, 512), (256, 512), (256, 1024), (512, 512),
+              (512, 1024)])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, K)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(K, N)) * 0.02, jnp.bfloat16)
+
+    def make(blocks):
+        def f(x, w):
+            return _chunk_matmul(x, w, blocks["block_m"],
+                                 blocks["block_n"])
+        return f, (x, w)   # fwd-only: the ring VJP reuses the same
+                           # kernel through the dual's forward
+
+    return Case("fused_collective_matmul", {"Kp": padded_lanes(K)},
+                "bfloat16",
+                [dict(block_m=bm, block_n=bn) for bm, bn in cands
+                 if bm <= M], make, grad=False)
+
+
+def case_fused_ag_flash(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.fused_collective import _agf_call
+    from apex1_tpu.tuning import padded_lanes, seq_bucket
+
+    # one ring step of the 16k GQA target: attend a visiting K/V shard
+    # and fold the carried (out, lse) in the kernel epilogue (cp=4
+    # shard of the llama_longctx shape on hardware)
+    B, Hq, Hkv, S, D = (1, 2, 2, 256, 64) if tiny else (1, 32, 4, 4096,
+                                                        64)
+    cands = ([(128, 128), (256, 256)] if tiny else
+             [(256, 256), (256, 512), (512, 512), (512, 1024),
+              (1024, 1024)])
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, Hq, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.bfloat16)
+    out0 = jnp.zeros((B, Hq, S, D), jnp.float32)
+    lse0 = jnp.full((B, Hq, S), -1e30, jnp.float32)
+
+    def make(blocks):
+        def f(q, k, v):
+            # q_off=S, k_off=0: the query shard sits AFTER the visiting
+            # K/V shard, so the causal gate keeps every block live and
+            # the sweep times a full attend+merge (q_off=0/k_off=S
+            # would mask every grid point and time an attend-free
+            # kernel — the banked winner would be noise)
+            return _agf_call(q, k, v, None, None, S, 0, out0, lse0,
+                             1.0 / float(np.sqrt(D)), True, False,
+                             blocks["block_q"], blocks["block_k"])
+        return f, (q, k, v)
+
+    return Case("fused_ag_flash",
+                {"Dp": padded_lanes(D), "Sb": seq_bucket(S)}, "bfloat16",
+                [dict(block_q=bq, block_k=bk) for bq, bk in cands
+                 if bq <= S and bk <= S], make, grad=False)
+
+
 def case_int8(tiny):
     import jax.numpy as jnp
     import numpy as np
@@ -306,6 +376,8 @@ CASES = {
     "rope": case_rope,
     "xentropy": case_xentropy,
     "bias_dropout_add": case_bias_dropout_add,
+    "fused_matmul": case_fused_matmul,
+    "fused_ag_flash": case_fused_ag_flash,
     "int8": case_int8,
 }
 
@@ -363,6 +435,12 @@ def _sweep_case(case, iters, say, write):
         f"{'(interpret-mode plumbing run)' if tiny else ''} ==")
 
     runnable = []
+    # the per-candidate DEVICE-TIME BREAKDOWN banked with the winner
+    # (ROADMAP item 5's flywheel: every sweep's measurements persist
+    # next to the tuning tables instead of being discarded after the
+    # winner is picked — the (shape -> timing) corpus the analytic
+    # model's correction factors will be fitted from)
+    breakdown = []
     for blocks in case.candidates:
         ok, est = spec.check(blocks, case.dims, es, budget)
         if ok:
@@ -370,6 +448,8 @@ def _sweep_case(case, iters, say, write):
         else:
             say(f"  drop {blocks}: VMEM model {est / 2**20:.1f} MiB "
                 f"> budget {budget / 2**20:.0f} MiB")
+            breakdown.append({"blocks": dict(blocks), "status": "vmem",
+                              "vmem_est_bytes": int(est)})
     if len(runnable) < 2:
         say(f"  SKIP {case.kernel}: <2 runnable candidates")
         return None, [f"{case.kernel}: <2 runnable candidates"]
@@ -387,8 +467,13 @@ def _sweep_case(case, iters, say, write):
             say(f"  {blocks}  {dt * 1e3:9.3f} ms "
                 f"{'fwd+bwd' if case.grad else 'fwd'}")
             results.append((dt, blocks))
+            breakdown.append({"blocks": dict(blocks), "status": "timed",
+                              "time_ms": round(dt * 1e3, 4)})
         except Exception as e:
             say(f"  {blocks}: {type(e).__name__}: {str(e)[:140]}")
+            breakdown.append({"blocks": dict(blocks), "status": "error",
+                              "error": f"{type(e).__name__}: "
+                                       f"{str(e)[:140]}"})
     if not results:
         return None, [f"{case.kernel}: every candidate failed"]
 
@@ -396,8 +481,11 @@ def _sweep_case(case, iters, say, write):
     say(f"  WINNER {blocks}  {dt * 1e3:.3f} ms")
     if not write:
         return blocks, []
-    key, _entry = tuning.record(case.kernel, case.dims, case.dtype,
-                                blocks, time_ms=dt * 1e3)
+    key, _entry = tuning.record(
+        case.kernel, case.dims, case.dtype, blocks, time_ms=dt * 1e3,
+        extra={"sweep": {"iters": iters,
+                         "grad": bool(case.grad),
+                         "candidates": breakdown}})
     path = tuning.save(case.kernel)
     # earlier traces in THIS process baked the pre-sweep table values
     # into their executables — drop them before anyone re-traces
