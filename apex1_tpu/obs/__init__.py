@@ -1,0 +1,32 @@
+"""Observability subsystem — the measurement flywheel (ROADMAP item 5).
+
+Three cooperating layers, each usable alone:
+
+- `spine` — ONE run-scoped telemetry schema (spans, counters, gauges,
+  events) banked as JSONL. `bench.timed_steps`, the examples' training
+  loops (via `utils.observability.MetricsLogger`), `tools/tune_kernels`
+  sweeps, `serving.ServingMetrics`, and the resilience sentinel all emit
+  through it, so one run's records JOIN across subsystems instead of
+  each inventing a JSON shape. Activated by ``APEX1_OBS_DIR``; inert
+  (zero I/O) otherwise.
+- `xspace` — dependency-free parser for the ``*.xplane.pb`` traces
+  ``jax.profiler.trace`` writes, with per-op device-time aggregation
+  and Pallas-kernel / collective / XLA-op bucketing. The engine behind
+  ``tools/trace_report.py``: any banked ``profile_artifact`` becomes a
+  per-op breakdown persisted next to the record. CPU-rehearsable —
+  ``jax.profiler.trace`` works on the CPU backend.
+- `calibrate` — fits per-config / per-kernel correction factors from
+  the accumulated (predicted, measured) pairs across banked bench logs
+  and tuning tables, and feeds them back into
+  ``bench._attach_roofline`` / ``tools/predict_perf.py`` so roofline
+  ratios price what silicon actually did (CPU-proxy pairs are labelled
+  and never applied to on-silicon predictions).
+
+See docs/observability.md for the schema and contracts.
+"""
+
+from apex1_tpu.obs import calibrate, spine, xspace  # noqa: F401
+from apex1_tpu.obs.spine import (ObsRun, StopWatch,  # noqa: F401
+                                 default_run, emit, read_events)
+from apex1_tpu.obs.xspace import (TraceError, build_report,  # noqa: F401
+                                  parse_xspace, write_report)

@@ -1,0 +1,368 @@
+"""Dependency-free XSpace trace parser + per-op attribution.
+
+``jax.profiler.trace`` banks ``*.xplane.pb`` files — XSpace protobufs.
+The stock decoders (``tensorflow.tsl...xplane_pb2`` et al.) drag a
+multi-second TensorFlow import through import-location roulette that
+differs per image (`tools/profile_step.py` shipped a three-way probe
+for exactly this). The XSpace wire format itself is tiny, so this
+module reads it directly: a ~100-line protobuf wire-format walker over
+the four message types we need, validated field-for-field against the
+``xplane_pb2`` parse on this image (PR 10). No imports beyond stdlib —
+usable from tests, tools, and the check_all smoke without jax or TF.
+
+Field numbers (tensorflow/tsl/profiler/protobuf/xplane.proto)::
+
+    XSpace:  planes = 1
+    XPlane:  id = 1, name = 2, lines = 3, event_metadata = 4 (map)
+    XLine:   id = 1, name = 2, timestamp_ns = 3, events = 4,
+             display_name = 11
+    XEvent:  metadata_id = 1, offset_ps = 2, duration_ps = 3,
+             num_occurrences = 5
+    XEventMetadata: id = 1, name = 2
+
+Every malformed input path (truncated varint, over-long length prefix,
+unknown wire type, bad gzip, empty dir) raises the typed `TraceError` —
+a corrupt banked trace yields a diagnosable error, never a traceback
+from the middle of a byte walker (and never a silently-empty report).
+
+Attribution model:
+
+- **device rows** — on TPU/GPU traces, per-op events live on device
+  planes (name contains ``/device:`` or ``TPU``) in the "XLA Ops"
+  lines. On CPU-backend traces there is no device plane; the XLA
+  runtime's per-op events live on the host plane's
+  ``tf_XLATfrtCpuClient/...`` executor lines instead, and the report
+  is labelled ``plane_class: "host-xla-proxy"`` — op *shares* are
+  meaningful there, absolute times are host wall-clock (see
+  docs/observability.md, "What CPU-proxy numbers mean").
+- **buckets** — each op name lands in exactly one of ``collective``
+  (the ICI ops: exposed-collective time is directly readable),
+  ``pallas`` (custom-call/Mosaic kernels — the HLO cost model's blind
+  spot), or ``xla`` (everything else). Name-based and best-effort, the
+  rules are in `bucket_of`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import os
+import re
+import zlib
+from typing import Iterator, Optional
+
+REPORT_SCHEMA = "apex1-trace-report-v1"
+REPORT_NAME = "trace_report.json"
+
+BUCKETS = ("pallas", "collective", "xla")
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all|collective-broadcast|ppermute|send|recv)\b", re.I)
+_PALLAS_RE = re.compile(
+    r"(custom-call|custom_call|tpu_custom_call|pallas|mosaic)", re.I)
+
+
+class TraceError(RuntimeError):
+    """Typed failure for unreadable/corrupt/empty traces — callers get
+    ``.path`` and ``.reason``, never a byte-walker traceback."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = os.fspath(path)
+        self.reason = reason
+        super().__init__(f"unreadable trace at {self.path}: {reason}")
+
+
+# -- protobuf wire-format walker -------------------------------------------
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = 0
+    val = 0
+    n = len(buf)
+    while True:
+        if i >= n:
+            raise ValueError("truncated varint")
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overlong")
+
+
+def _fields(buf: bytes) -> Iterator[tuple[int, int, object]]:
+    """Yield ``(field_no, wire_type, value)`` over one message's bytes.
+    Length-delimited values come back as bytes; varints as ints."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:                      # varint
+            val, i = _varint(buf, i)
+        elif wt == 2:                    # length-delimited
+            ln, i = _varint(buf, i)
+            if i + ln > n:
+                raise ValueError("truncated length-delimited field")
+            val = buf[i:i + ln]
+            i += ln
+        elif wt == 5:                    # fixed32
+            if i + 4 > n:
+                raise ValueError("truncated fixed32")
+            val = buf[i:i + 4]
+            i += 4
+        elif wt == 1:                    # fixed64
+            if i + 8 > n:
+                raise ValueError("truncated fixed64")
+            val = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, val
+
+
+@dataclasses.dataclass
+class Event:
+    metadata_id: int
+    duration_ps: int
+    occurrences: int        # num_occurrences when aggregated, else 1
+
+
+@dataclasses.dataclass
+class Line:
+    name: str
+    events: list            # [Event]
+
+
+@dataclasses.dataclass
+class Plane:
+    name: str
+    lines: list             # [Line]
+    event_names: dict       # metadata_id -> op name
+
+
+def _parse_event(buf: bytes) -> Event:
+    mid = dur = 0
+    occ = 1
+    for fno, wt, val in _fields(buf):
+        if wt != 0:
+            continue
+        if fno == 1:
+            mid = val
+        elif fno == 3:
+            dur = val
+        elif fno == 5:
+            occ = val
+    return Event(metadata_id=mid, duration_ps=dur, occurrences=occ)
+
+
+def _parse_line(buf: bytes) -> Line:
+    name = ""
+    events = []
+    for fno, wt, val in _fields(buf):
+        if fno == 2 and wt == 2:
+            name = val.decode("utf-8", "replace")
+        elif fno == 4 and wt == 2:
+            events.append(_parse_event(val))
+    return Line(name=name, events=events)
+
+
+def _parse_emeta_entry(buf: bytes) -> tuple[int, str]:
+    key = 0
+    name = ""
+    for fno, wt, val in _fields(buf):
+        if fno == 1 and wt == 0:
+            key = val
+        elif fno == 2 and wt == 2:       # XEventMetadata
+            for f2, w2, v2 in _fields(val):
+                if f2 == 2 and w2 == 2:
+                    name = v2.decode("utf-8", "replace")
+    return key, name
+
+
+def _parse_plane(buf: bytes) -> Plane:
+    name = ""
+    lines = []
+    emeta: dict[int, str] = {}
+    for fno, wt, val in _fields(buf):
+        if fno == 2 and wt == 2:
+            name = val.decode("utf-8", "replace")
+        elif fno == 3 and wt == 2:
+            lines.append(_parse_line(val))
+        elif fno == 4 and wt == 2:
+            k, v = _parse_emeta_entry(val)
+            emeta[k] = v
+    return Plane(name=name, lines=lines, event_names=emeta)
+
+
+def parse_xspace(path: str | os.PathLike) -> list[Plane]:
+    """Parse one ``*.xplane.pb`` (``.gz`` transparently) into planes.
+    Raises `TraceError` on any unreadable/corrupt input."""
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        if path.endswith(".gz"):
+            data = gzip.decompress(data)
+    # zlib.error: a valid gzip HEADER over a corrupt deflate body —
+    # BadGzipFile alone misses it and the typed-error contract breaks
+    except (OSError, gzip.BadGzipFile, EOFError, zlib.error) as e:
+        raise TraceError(path, f"cannot read: {e}") from e
+    planes = []
+    try:
+        for fno, wt, val in _fields(data):
+            if fno == 1 and wt == 2:
+                planes.append(_parse_plane(val))
+    except ValueError as e:
+        raise TraceError(path, f"corrupt protobuf: {e}") from e
+    if not planes:
+        raise TraceError(path, "no XPlane messages (empty or foreign file)")
+    return planes
+
+
+def find_xplane_files(trace_dir: str | os.PathLike) -> list[str]:
+    """Every ``*.xplane.pb[.gz]`` under ``trace_dir`` (the layout
+    ``jax.profiler.trace`` writes: ``plugins/profile/<ts>/...``)."""
+    trace_dir = os.fspath(trace_dir)
+    out = []
+    for pat in ("*.xplane.pb", "*.xplane.pb.gz"):
+        out += glob.glob(os.path.join(trace_dir, "**", pat),
+                         recursive=True)
+    return sorted(out)
+
+
+# -- attribution -----------------------------------------------------------
+
+def bucket_of(op_name: str) -> str:
+    """``collective`` | ``pallas`` | ``xla`` for one op name.
+    Name-based, best-effort: collectives first (a fused
+    collective-permute must read as ICI time even if spelled inside a
+    custom call wrapper), then the custom-call/Mosaic family, then
+    everything else."""
+    if _COLLECTIVE_RE.search(op_name):
+        return "collective"
+    if _PALLAS_RE.search(op_name):
+        return "pallas"
+    return "xla"
+
+
+def _is_device_plane(name: str) -> bool:
+    return "/device:" in name or "TPU" in name or "gpu" in name.lower()
+
+
+def _is_op_line(line_name: str, *, device: bool) -> bool:
+    if device:
+        return "XLA Ops" in line_name or "XLA Op" in line_name \
+            or line_name.startswith("XLA")
+    # CPU backend: the XLA executor threads carry the per-op events
+    return line_name.startswith("tf_XLA")
+
+
+def op_totals(planes: list) -> tuple[dict, str]:
+    """Aggregate per-op ``{name: [total_ps, count]}`` over the op lines.
+    Returns ``(totals, plane_class)`` where plane_class is ``"device"``
+    (real accelerator planes) or ``"host-xla-proxy"`` (CPU backend —
+    shares meaningful, absolute times are host wall-clock)."""
+    for device in (True, False):
+        totals: dict[str, list] = {}
+        for plane in planes:
+            if _is_device_plane(plane.name) != device:
+                continue
+            for line in plane.lines:
+                if not _is_op_line(line.name, device=device):
+                    continue
+                for ev in line.events:
+                    name = plane.event_names.get(
+                        ev.metadata_id, str(ev.metadata_id))
+                    a = totals.setdefault(name, [0, 0])
+                    a[0] += ev.duration_ps
+                    a[1] += max(int(ev.occurrences), 1)
+        if totals:
+            return totals, ("device" if device else "host-xla-proxy")
+    return {}, "none"
+
+
+def build_report(trace_dir: str | os.PathLike, *,
+                 steps: Optional[int] = None,
+                 top: int = 200) -> dict:
+    """Per-op device-time breakdown for one banked trace directory.
+
+    Raises `TraceError` when the dir holds no xplane files, none
+    parses, or no op events were found (an empty report would read as
+    "nothing ran" when the truth is "nothing was attributable")."""
+    trace_dir = os.fspath(trace_dir)
+    paths = find_xplane_files(trace_dir)
+    if not paths:
+        raise TraceError(trace_dir, "no *.xplane.pb files under dir")
+    planes = []
+    for p in paths:
+        planes += parse_xspace(p)
+    totals, plane_class = op_totals(planes)
+    if not totals:
+        lines = sorted({(pl.name, ln.name)
+                        for pl in planes for ln in pl.lines})
+        raise TraceError(
+            trace_dir, "no per-op events on any known op line; "
+            f"planes/lines seen: {lines[:12]}")
+    total_ps = sum(ps for ps, _n in totals.values())
+    buckets = {b: 0 for b in BUCKETS}
+    ops = []
+    for name, (ps, n) in sorted(totals.items(), key=lambda kv: -kv[1][0]):
+        b = bucket_of(name)
+        buckets[b] += ps
+        ops.append({"name": name, "bucket": b,
+                    "ms": round(ps / 1e9, 6), "count": int(n),
+                    "share": round(ps / total_ps, 4) if total_ps else 0.0})
+    report = {
+        "schema": REPORT_SCHEMA,
+        "trace_dir": trace_dir,
+        "plane_class": plane_class,
+        "total_op_ms": round(total_ps / 1e9, 6),
+        "buckets": {b: {"ms": round(buckets[b] / 1e9, 6),
+                        "share": (round(buckets[b] / total_ps, 4)
+                                  if total_ps else 0.0)}
+                    for b in BUCKETS},
+        "n_ops": len(ops),
+        "ops": ops[:top],
+    }
+    if steps:
+        report["steps"] = int(steps)
+        report["per_step_ms"] = round(total_ps / 1e9 / steps, 6)
+    return report
+
+
+def write_report(trace_dir: str | os.PathLike, *,
+                 report: Optional[dict] = None,
+                 steps: Optional[int] = None,
+                 path: Optional[str] = None) -> str:
+    """Build (unless given) and atomically persist the report NEXT TO
+    the trace it describes (``<trace_dir>/trace_report.json``), so a
+    banked ``profile_artifact`` directory carries its own breakdown."""
+    from apex1_tpu.resilience.manifest import atomic_write_json
+
+    if report is None:
+        report = build_report(trace_dir, steps=steps)
+    if path is None:
+        path = os.path.join(os.fspath(trace_dir), REPORT_NAME)
+    atomic_write_json(path, report)
+    return path
+
+
+def format_report(report: dict, top: int = 25) -> str:
+    """Human-readable rendering (the trace_report/profile_step CLIs)."""
+    lines = [f"plane class: {report['plane_class']}   "
+             f"total op time: {report['total_op_ms']:.3f} ms"
+             + (f"   ({report['per_step_ms']:.3f} ms/step x "
+                f"{report['steps']})" if report.get("steps") else "")]
+    bk = report["buckets"]
+    lines.append("buckets: " + "  ".join(
+        f"{b}={bk[b]['ms']:.3f}ms ({bk[b]['share'] * 100:.1f}%)"
+        for b in BUCKETS))
+    for op in report["ops"][:top]:
+        lines.append(f"{op['ms']:10.3f} ms {op['count']:6d}x "
+                     f"{op['share'] * 100:5.1f}%  [{op['bucket']:10s}] "
+                     f"{op['name'][:100]}")
+    return "\n".join(lines)

@@ -242,6 +242,12 @@ class Sentinel:
                     f"{record['action']}.json")
             atomic_write_json(os.path.join(ddir, name), record)
             record["path"] = os.path.join(ddir, name)
+        # mirror into the telemetry spine (APEX1_OBS_DIR): divergence
+        # diagnostics join the same run stream as the loop's metrics,
+        # so a skipped/rolled-back step is visible NEXT TO the loss
+        # curve it interrupted (docs/observability.md)
+        from apex1_tpu.obs import spine
+        spine.emit("event", "sentinel.diagnostic", **record)
         return record
 
     def poll(self, s: SentinelState, *, force: bool = False

@@ -3,10 +3,14 @@
 Every request walks the state machine
 ``queued → prefill → decode → {done | evicted | cancelled}`` (or is
 ``rejected`` at the door); each transition is an EVENT with a
-monotonic timestamp. Events stream through
+monotonic timestamp (`obs.spine.monotonic` — the one clock every
+subsystem stamps with). Events stream through
 `utils.observability.MetricsLogger` as JSON lines when a logger is
 supplied (the same sink the training loop uses, so one log carries
-both), and always accumulate in memory for `summary()` — the
+both), mirror into the telemetry spine's run file when
+``APEX1_OBS_DIR`` is set (``serving.request`` / ``serving.transition``
+events — docs/observability.md), and always accumulate in memory for
+`summary()` — the
 offered-load sweep in ``tools/bench_serving.py`` reads tokens/sec,
 p50/p99 time-to-first-token, and mean slot occupancy from it.
 
@@ -27,11 +31,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from apex1_tpu.obs import spine
 from apex1_tpu.utils.observability import MetricsLogger
 
 #: terminal request states
@@ -93,7 +97,7 @@ class ServingMetrics:
         self._occ_sum = 0.0
         self._peak_queue = 0
         self._event_seq = 0
-        self._t0 = time.monotonic()
+        self._t0 = spine.monotonic()
         # submit (and its queued/rejected events) may run on an ingest
         # thread (`runtime.RequestFeeder`) while the engine loop logs
         # token/terminal events — same cross-thread pattern the
@@ -104,7 +108,7 @@ class ServingMetrics:
 
     def event(self, req_id: int, name: str, now: Optional[float] = None,
               **fields) -> RequestRecord:
-        now = time.monotonic() if now is None else now
+        now = spine.monotonic() if now is None else now
         with self._lock:
             return self._event_locked(req_id, name, now, fields)
 
@@ -134,14 +138,25 @@ class ServingMetrics:
                                              rec.n_generated))
         else:
             raise ValueError(f"unknown lifecycle event {name!r}")
-        if self.logger is not None and name != "token":
+        if name != "token":
             # per-token lines would dominate the log; counts ride the
-            # terminal event instead
-            self._event_seq += 1
-            self.logger.log(self._event_seq,
-                            {"event": name, "req": int(req_id),
-                             "t": now - self._t0, **{
-                                 k: v for k, v in fields.items()}})
+            # terminal event instead. Lifecycle events also mirror into
+            # the telemetry spine (APEX1_OBS_DIR) so serving joins the
+            # same run stream as bench/training/tuning. The spine
+            # stamps its own run-relative `t` (ONE time axis across
+            # emitters); this object's engine-relative clock rides
+            # along as `t_serving` — passing it as `t` would put two
+            # unrecorded origins on the shared axis.
+            spine.emit("event", "serving.request", event=name,
+                       req=int(req_id), t_serving=now - self._t0,
+                       **fields)
+            if self.logger is not None:
+                self._event_seq += 1
+                self.logger.log(self._event_seq,
+                                {"event": name, "req": int(req_id),
+                                 "t": now - self._t0, **{
+                                     k: v for k, v in fields.items()}},
+                                _obs_name=None)
         return rec
 
     def incr(self, name: str, n: int = 1) -> None:
@@ -157,13 +172,18 @@ class ServingMetrics:
         transition is a JSON line when a logger is wired AND kept in
         ``transitions`` — the overload drill asserts each degradation
         step left a banked record."""
-        now = time.monotonic() if now is None else now
+        now = spine.monotonic() if now is None else now
         rec = {"event": str(name), "t": now - self._t0, **fields}
+        # rec's engine-relative "t" must NOT land on spine.emit's `t`
+        # parameter (run-relative axis) — same origin rule as above
+        spine.emit("event", "serving.transition", event=rec["event"],
+                   t_serving=rec["t"],
+                   **{k: v for k, v in fields.items() if k != "t"})
         with self._lock:
             self.transitions.append(rec)
             if self.logger is not None:
                 self._event_seq += 1
-                self.logger.log(self._event_seq, rec)
+                self.logger.log(self._event_seq, rec, _obs_name=None)
         return rec
 
     def step_sample(self, active: int, max_slots: int,
@@ -205,7 +225,7 @@ class ServingMetrics:
         ttfts = sorted(r.ttft for r in recs if r.ttft is not None)
         lats = sorted(r.latency for r in recs if r.latency is not None)
         gen = sum(r.n_generated for r in recs)
-        wall = max(time.monotonic() - self._t0, 1e-9)
+        wall = max(spine.monotonic() - self._t0, 1e-9)
         out = {
             "requests": len(recs),
             "done": len(done),

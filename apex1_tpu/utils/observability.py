@@ -17,6 +17,14 @@ TPU-native equivalents:
   ``apex/transformer`` ``timers`` contract.
 - `MetricsLogger` — per-step structured metrics (loss, grad-norm,
   loss-scale, skip-count, tokens/sec/chip — the BASELINE.json metric).
+
+Since PR 10 both sit on the telemetry spine (`apex1_tpu.obs.spine`):
+`Timers` is a thin adapter over the spine's `StopWatch` span primitive
+(the ONE host-side timing implementation — serving and bench use the
+same one), and `MetricsLogger` keeps its public surface but MIRRORS
+every record into the run-scoped JSONL sink when ``APEX1_OBS_DIR`` is
+set, so the examples' training loops join the same event stream as
+bench/tuning/serving without touching their call sites.
 """
 
 from __future__ import annotations
@@ -28,6 +36,8 @@ from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
+
+from apex1_tpu.obs import spine
 
 
 @contextlib.contextmanager
@@ -65,47 +75,43 @@ def flops_per_step(fn: Callable, *args, **kwargs) -> float:
 class Timers:
     """Named cumulative timers (``timers("fwd").start()/.stop()``) — the
     calling convention ``apex/transformer`` schedules expect. ``stop``
-    blocks on ``sync`` trees so device work is attributed correctly."""
+    blocks on ``sync`` trees so device work is attributed correctly.
+    Each timer IS a spine `StopWatch` (same primitive as
+    `bench.timed_steps` and the serving clock), and ``log`` mirrors the
+    read-out as spine counters when ``APEX1_OBS_DIR`` is set."""
 
-    class _Timer:
-        def __init__(self):
-            self.elapsed_ = 0.0
-            self.count = 0
-            self._t0: Optional[float] = None
-
-        def start(self):
-            self._t0 = time.perf_counter()
-
-        def stop(self, sync: Any = None):
-            if sync is not None:
-                jax.block_until_ready(sync)
-            self.elapsed_ += time.perf_counter() - self._t0
-            self.count += 1
-            self._t0 = None
-
-        def elapsed(self, reset: bool = False) -> float:
-            e = self.elapsed_
-            if reset:
-                self.elapsed_, self.count = 0.0, 0
-            return e
+    #: the spine primitive, re-exported under the historical name
+    _Timer = spine.StopWatch
 
     def __init__(self):
-        self._timers: dict[str, Timers._Timer] = {}
+        self._timers: dict[str, spine.StopWatch] = {}
 
-    def __call__(self, name: str) -> "Timers._Timer":
-        return self._timers.setdefault(name, Timers._Timer())
+    def __call__(self, name: str) -> spine.StopWatch:
+        return self._timers.setdefault(name, spine.StopWatch())
 
     def log(self, names=None, *, reset: bool = True) -> dict[str, float]:
         names = list(self._timers) if names is None else names
-        return {n: self._timers[n].elapsed(reset=reset) for n in names
-                if n in self._timers}
+        out = {}
+        for n in names:
+            if n not in self._timers:
+                continue
+            t = self._timers[n]
+            count = t.count
+            out[n] = t.elapsed(reset=reset)
+            spine.emit("counter", f"timer.{n}", value=round(out[n], 6),
+                       count=count)
+        return out
 
 
 class MetricsLogger:
     """Structured per-step metrics with tokens/sec/chip derivation.
 
     ``log(step, metrics, tokens=...)`` fetches scalars (one small transfer)
-    and emits a JSON line via ``print`` or a supplied writer."""
+    and emits a JSON line via ``print`` or a supplied writer. Every
+    record is ALSO mirrored into the telemetry spine's run file when
+    ``APEX1_OBS_DIR`` is set (kind ``event``, name ``metrics``) — the
+    training loops, serving lifecycle, and bench records then share one
+    joinable stream (docs/observability.md)."""
 
     def __init__(self, writer: Optional[Callable[[str], None]] = None,
                  n_chips: Optional[int] = None):
@@ -114,8 +120,12 @@ class MetricsLogger:
         self._last_t: Optional[float] = None
         self._last_step: Optional[int] = None
 
-    def log(self, step: int, metrics: dict, *, tokens: Optional[int] = None
-            ) -> dict:
+    def log(self, step: int, metrics: dict, *,
+            tokens: Optional[int] = None,
+            _obs_name: Optional[str] = "metrics") -> dict:
+        # _obs_name: spine event name for the mirror; None = caller
+        # already emitted a structured spine event for this record
+        # (serving.ServingMetrics) — suppress the generic one
         now = time.perf_counter()
         rec = {"step": int(step)}
         for k, v in metrics.items():
@@ -142,4 +152,6 @@ class MetricsLogger:
                     tokens * steps / dt / self.n_chips)
         self._last_t, self._last_step = now, step
         self.writer(json.dumps(rec))
+        if _obs_name:
+            spine.emit("event", _obs_name, **rec)
         return rec

@@ -140,10 +140,17 @@ def timed_steps(train_step, state, batch, iters, *, profile_dir=None):
     state, metrics = compiled(state)           # warmup (same executable)
     float(_reduce_all((state, metrics)))       # compiles the sync too
 
-    t0 = time.perf_counter()
+    # the spine StopWatch is the repo's ONE host-side timing primitive
+    # (same machinery as utils.observability.Timers and the serving
+    # clock); the full-tree float() reduction above IS the hard sync,
+    # so no sync tree is passed here
+    from apex1_tpu.obs import spine
+    sw = spine.StopWatch().start()
     state, metrics = compiled(state)           # n loop iters + 1 leading
     float(_reduce_all((state, metrics)))       # hard sync, full tree
-    dt = time.perf_counter() - t0
+    dt = sw.stop()
+    spine.emit("span", "bench.timed_steps", dur_s=round(dt, 6),
+               iters=iters, step_s=round(dt / iters, 6))
     loss = float(metrics["loss"])
     if not math.isfinite(loss):
         raise RuntimeError(f"benchmark loss is not finite: {loss}")
@@ -618,7 +625,16 @@ def _attach_roofline(record, config, results_dir=None):
     tools/predict_perf.py) to a record with a nonzero value. ON-SILICON
     records only: a cpu smoke run measures tiny auto-shrunk shapes, so
     a ratio against the accelerator-shape prediction would be noise
-    dressed as a score."""
+    dressed as a score.
+
+    When a banked calibration table exists (``apex1_tpu.obs.calibrate``
+    — perf_results/calibration.json, TPU-backed factors only), the
+    record ALSO carries ``calibrated_predicted`` (the analytic rate
+    corrected by the config's fitted slowdown) and
+    ``calibrated_ratio`` (value / calibrated_predicted — ≈1.0 means
+    "performing as banked silicon history says"; a drop below ~0.9 is
+    a REGRESSION signal even when the raw ratio looks normal). The raw
+    ``roofline_ratio`` keeps its absolute-localizer meaning."""
     try:
         metric = record.get("metric", "")
         if "[cpu]" in metric or "[unreachable]" in metric:
@@ -629,6 +645,17 @@ def _attach_roofline(record, config, results_dir=None):
                 and math.isfinite(val):
             record["predicted"] = round(pred, 1)
             record["roofline_ratio"] = round(val / pred, 4)
+            try:
+                from apex1_tpu.obs.calibrate import step_slowdown
+                cal = step_slowdown(config, results_dir)
+                if cal:
+                    cal_pred = pred / cal["slowdown"]
+                    record["calibrated_predicted"] = round(cal_pred, 1)
+                    record["calibrated_ratio"] = round(val / cal_pred, 4)
+                    record["calibration"] = {
+                        "slowdown": cal["slowdown"], "n": cal["n"]}
+            except Exception:
+                pass  # calibration is metadata on metadata
     except Exception:
         pass  # metadata only — never break the always-emit contract
     return record
@@ -861,7 +888,14 @@ def main():
             except Exception as e:  # banking must not eat the record
                 print(f"WARNING: checkpoint banking failed: {e}",
                       file=sys.stderr, flush=True)
-        _emit(_attach_roofline(best, args.config), args.out)
+        best = _attach_roofline(best, args.config)
+        try:   # mirror the headline record into the telemetry spine
+            from apex1_tpu.obs import spine
+            spine.emit("event", "bench.record", config=args.config,
+                       **best)
+        except Exception:
+            pass
+        _emit(best, args.out)
     except Exception as e:  # the line must still print on any failure
         signal.alarm(0)
         fallback["metric"] = f"{unit} {args.config} [{backend}]"

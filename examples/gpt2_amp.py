@@ -20,6 +20,11 @@ banks a final synchronous checkpoint and exits `EXIT_RESUMABLE` (75)
 so `tools/tpu_watch.sh` re-queues instead of recording a failure.
 ``APEX1_CHAOS_SIGTERM_STEP=<n>`` self-injects the preemption at step n
 (the chaos harness's kill-and-resume drill).
+
+``--obs-dir <dir>`` (or ``APEX1_OBS_DIR``) banks the run through the
+telemetry spine (`apex1_tpu.obs`, docs/observability.md): every
+`MetricsLogger` line, sentinel diagnostic, and checkpoint event lands
+in one run-scoped JSONL file, joinable with bench/tuning/serving runs.
 """
 
 import argparse
@@ -60,7 +65,16 @@ def main():
     ap.add_argument("--resume", default="auto", choices=("auto", "never"),
                     help="auto: continue from the newest VALID "
                     "checkpoint under --ckpt-dir")
+    ap.add_argument("--obs-dir", default=None,
+                    help="bank run telemetry (metrics, sentinel "
+                    "diagnostics) as JSONL through apex1_tpu.obs; "
+                    "equivalent to setting APEX1_OBS_DIR")
     args = ap.parse_args()
+
+    if args.obs_dir:
+        # the spine's default run resolves this lazily at first emit,
+        # so setting it before the loop wires every MetricsLogger line
+        os.environ["APEX1_OBS_DIR"] = args.obs_dir
 
     policy = get_policy(args.opt_level)
     cfg = (GPT2Config.tiny(policy=policy) if args.tiny

@@ -120,6 +120,13 @@ SURFACE = {
         "abstract_state", "from_llama_params", "reshape_chunks",
         "combine_grads"],
     "apex1_tpu.utils.observability": ["MetricsLogger", "Timers"],
+    "apex1_tpu.obs": ["ObsRun", "StopWatch", "default_run", "emit",
+                      "read_events", "TraceError", "build_report",
+                      "parse_xspace", "write_report"],
+    "apex1_tpu.obs.calibrate": [
+        "collect_pairs", "fit", "build_calibration", "load_calibration",
+        "step_slowdown", "kernel_slowdown", "newest_prediction_path",
+        "roofline_ms"],
     "apex1_tpu.testing": [
         "force_virtual_cpu_devices", "enable_persistent_compilation_cache",
         "honor_jax_platforms_env", "distributed_mesh", "standalone_gpt",

@@ -24,6 +24,8 @@ echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --smoke
 echo "== serving chaos smoke (replica-kill token parity + poison quarantine, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --serve-smoke
+echo "== obs smoke (CPU trace -> per-op report -> calibration fit, non-empty) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.obs --smoke
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q

@@ -410,7 +410,31 @@ def predict_comms_fused():
     return rows
 
 
-def render(step_rows, kernel_rows, comms_rows=(), fused_rows=()):
+def annotate_calibration(step_rows):
+    """Stamp each step row with the banked TPU-fitted slowdown factor
+    (`apex1_tpu.obs.calibrate` — perf_results/calibration.json) and the
+    calibrated v5e prediction: ``calibrated = analytic x slowdown`` in
+    time terms. Fail-safe: no table, or no factor for a config, leaves
+    the row untouched — the analytic prediction stands alone, as it did
+    before any silicon was measured."""
+    from apex1_tpu.obs.calibrate import load_calibration
+
+    doc = load_calibration()
+    if doc is None:
+        return None
+    for r in step_rows:
+        if "error" in r:
+            continue
+        f = doc.get("factors", {}).get(f"step:{r['name']}")
+        if isinstance(f, dict) and isinstance(f.get("slowdown"),
+                                              (int, float)):
+            r["calibration_slowdown"] = f["slowdown"]
+            r["calibration_n"] = f.get("n")
+    return doc
+
+
+def render(step_rows, kernel_rows, comms_rows=(), fused_rows=(),
+           calibration=None):
     from apex1_tpu.core.capability import get_capability
     v5e, v5p = get_capability("v5e"), get_capability("v5p")
     lines = []
@@ -467,6 +491,37 @@ def render(step_rows, kernel_rows, comms_rows=(), fused_rows=()):
       "the table is GPT-2, whose only measurement (round 1, pre-tuning) "
       "was 42,027 tok/s.")
     w("")
+    cal_rows = [r for r in step_rows if r.get("calibration_slowdown")]
+    if cal_rows:
+        w("## Calibrated predictions (banked silicon history applied)")
+        w("")
+        w("Factors from `perf_results/calibration.json` "
+          "(`apex1_tpu.obs.calibrate` — TPU-fitted slowdown = analytic "
+          "rate / measured rate over the banked bench logs"
+          + (f", {calibration.get('n_pairs')} pairs"
+             if calibration else "") + "). `calibrated ms` = analytic "
+          "x slowdown: what the NEXT run of this config should "
+          "actually take if nothing regressed — the planner-facing "
+          "number. cpu-proxy factors are never applied here.")
+        w("")
+        w("| config | slowdown (n) | v5e analytic ms | v5e calibrated "
+          "ms | calibrated rate |")
+        w("|---|---|---|---|---|")
+        # priced through the SAME function the factors were fitted
+        # against (calibrate.predicted_step_rate, comms term included)
+        # — _roofline alone would drop a multichip row's exposed-ICI
+        # term and overstate the calibrated rate by exactly that share
+        from apex1_tpu.obs.calibrate import predicted_step_rate
+        for r in cal_rows:
+            rate = predicted_step_rate(r, "v5e")
+            if not rate:
+                continue
+            te = r["units_per_step"] / rate
+            s = r["calibration_slowdown"]
+            w(f"| {r['name']} | {s:.2f}x ({r.get('calibration_n')}) "
+              f"| {te * 1e3:.1f} | {te * s * 1e3:.1f} "
+              f"| {r['units_per_step'] / (te * s):,.0f} {r['unit']} |")
+        w("")
     w("DECODE-ROW CAVEAT: the cost model counts the scanned decode "
       "loop's loop-invariant weight buffers ONCE, not once per decode "
       "step, so the decode/decode_int8 bytes — and their HBM-bound "
@@ -590,7 +645,13 @@ def main():
           flush=True)
     fused_rows = predict_comms_fused()
 
-    md = render(step_rows, kernel_rows, comms_rows, fused_rows)
+    print("== calibration annotation (banked factors) ==", flush=True)
+    cal_doc = annotate_calibration(step_rows)
+    print("  applied" if cal_doc else
+          "  no banked calibration.json — analytic only", flush=True)
+
+    md = render(step_rows, kernel_rows, comms_rows, fused_rows,
+                calibration=cal_doc)
     for path in (args.out, args.json):
         d = os.path.dirname(path)
         if d:
@@ -600,7 +661,12 @@ def main():
     with open(args.json, "w") as f:
         json.dump({"topology": TOPOLOGY, "steps": step_rows,
                    "kernels": kernel_rows, "comms": comms_rows,
-                   "comms_fused": fused_rows},
+                   "comms_fused": fused_rows,
+                   "calibration": ({"source": "perf_results/"
+                                    "calibration.json",
+                                    "generated_unix":
+                                    cal_doc.get("generated_unix")}
+                                   if cal_doc else None)},
                   f, indent=1)
     print(f"wrote {args.out} + {args.json}", flush=True)
     failures = sum("error" in r

@@ -1,20 +1,25 @@
 """Capture a jax.profiler trace of one bench config's train step on the
-current backend and print per-op device-time totals (top N).
+current backend and print the per-op device-time breakdown.
+
+Since PR 10 the parsing/attribution lives in `apex1_tpu.obs.xspace`
+(a dependency-free XSpace wire-format walker — the old three-way
+``xplane_pb2`` import-location roulette is gone) and the breakdown is
+ALSO persisted as ``trace_report.json`` next to the trace, same format
+as ``tools/trace_report.py`` banks for every bench `profile_artifact`.
 
 Usage: python tools/profile_step.py [--config gpt2] [--top 40]
 """
 
 import argparse
-import glob
-import gzip
 import os
 import sys
 import tempfile
-from collections import defaultdict
 
 import jax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apex1_tpu.obs import xspace  # noqa: E402
 
 
 def build_step(config):
@@ -27,44 +32,6 @@ def build_step(config):
     out = jstep(state, *batch)
     jax.block_until_ready(out)
     return jstep, state, batch
-
-
-def parse_xspace(path):
-    """Walk the XSpace proto: planes -> lines -> events; return
-    [(plane_name, line_name, event_name, total_ps, count)] aggregated."""
-    # import-location roulette across TF/profiler versions; this image
-    # ships it under tensorflow.tsl (verified in the r4 CPU rehearsal —
-    # the first two locations exist but are empty namespace dirs)
-    xplane_pb2 = None
-    for modname in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
-                    "tensorboard_plugin_profile.protobuf.xplane_pb2",
-                    "xprof.protobuf.xplane_pb2"):
-        try:
-            import importlib
-            xplane_pb2 = importlib.import_module(modname)
-            break
-        except ImportError:
-            continue
-    if xplane_pb2 is None:
-        raise ImportError("no xplane_pb2 proto module found")
-    data = open(path, "rb").read()
-    if path.endswith(".gz"):
-        data = gzip.decompress(data)
-    space = xplane_pb2.XSpace()
-    space.ParseFromString(data)
-    rows = []
-    for plane in space.planes:
-        emeta = {m.id: m.name for m in plane.event_metadata.values()}
-        agg = defaultdict(lambda: [0, 0])
-        for line in plane.lines:
-            for ev in line.events:
-                name = emeta.get(ev.metadata_id, str(ev.metadata_id))
-                a = agg[(line.name, name)]
-                a[0] += ev.duration_ps
-                a[1] += 1
-        for (ln, name), (ps, n) in agg.items():
-            rows.append((plane.name, ln, name, ps, n))
-    return rows
 
 
 def main():
@@ -99,32 +66,14 @@ def main():
             out = jstep(state, *batch)
         jax.block_until_ready(out)
 
-    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
-    print(f"trace files: {paths}", flush=True)
-    rows = []
-    for p in paths:
-        rows.extend(parse_xspace(p))
-
-    # device planes only; aggregate across lines by event name
-    dev = defaultdict(lambda: [0, 0])
-    total = 0
-    for plane, line, name, ps, n in rows:
-        if "TPU" in plane or "/device:" in plane or "gpu" in plane.lower():
-            if "XLA Ops" in line or "XLA Op" in line or line.startswith("XLA"):
-                dev[name][0] += ps
-                dev[name][1] += n
-                total += ps
-    if not dev:
-        # fallback: dump line names so we can adapt
-        seen = sorted({(p, l) for p, l, *_ in rows})
-        for p, l in seen[:50]:
-            print("plane/line:", p, "|", l)
-        return
-    print(f"total device op time: {total/1e9/args.steps:.2f} ms/step")
-    items = sorted(dev.items(), key=lambda kv: -kv[1][0])
-    for name, (ps, n) in items[:args.top]:
-        print(f"{ps/1e9/args.steps:9.3f} ms  {n//args.steps:5d}x  "
-              f"{ps/total*100:5.1f}%  {name[:110]}")
+    try:
+        report = xspace.build_report(tmp, steps=args.steps)
+    except xspace.TraceError as e:
+        print(f"trace unreadable: {e.reason}", flush=True)
+        sys.exit(1)
+    path = xspace.write_report(tmp, report=report)
+    print(xspace.format_report(report, top=args.top), flush=True)
+    print(f"report banked at {path}", flush=True)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,12 @@
 #      Weak #8) BEHIND the sweeps                            (~10 min)
 #   8. full hw_numerics re-sweep                             (~20 min)
 #
+# calibrate_refresh entries run AFTER each bench group (and last):
+# python -m apex1_tpu.obs.calibrate re-fits the predicted-vs-measured
+# correction factors from whatever the window banked so far, and
+# trace_reports turns every stamped profile_artifact into a per-op
+# trace_report.json (docs/observability.md — the measurement flywheel).
+#
 # Every phase tees its log to perf_results/ AS IT RUNS (stdbuf line
 # buffered), so a tunnel that dies mid-phase still leaves the lines that
 # printed — no phase buffers results to the end.
@@ -172,6 +178,11 @@ run hw_num_new       600 python tools/hw_numerics.py --only bias,int8 \
                          --timeout 480 "${CPUQ[@]}"
 run bench_llama_blk 1800 python bench.py --config llama_block --timeout 1500
 run bench_bert_lg   1500 python bench.py --config bert_large --timeout 1200
+# calibrate_refresh AFTER each bench group (ROADMAP-5 flywheel): re-fit
+# the predicted-vs-measured correction factors the moment new silicon
+# records bank, so later entries' calibrated_ratio prices THIS window's
+# history, not last round's
+run calibrate_refresh1 300 python -m apex1_tpu.obs.calibrate
 # the flash block sweep (in-process, winners persisted to
 # perf_results/tuning/) runs AHEAD of the llama_longctx re-bench: the
 # 16k config measured 0.36x its roofline and the sweep is the localizer
@@ -198,7 +209,13 @@ run bench_t5        1500 python bench.py --config t5 --timeout 1200
 run bench_gpt2_b24  1200 python bench.py --config gpt2 --batch 24 --timeout 1000
 run bench_decode    1200 python bench.py --config decode --timeout 1000
 run bench_dec_int8  1200 python bench.py --config decode_int8 --timeout 1000
+# re-fit after the re-bench group (bert/resnet/t5/gpt2_b24/decode rows)
+run calibrate_refresh2 300 python -m apex1_tpu.obs.calibrate
 run profile_gpt2    1200 python tools/profile_step.py --config gpt2 --top 40
+# per-op breakdowns for every profile_artifact the benches above
+# stamped — the trace -> attribution leg of the flywheel, banked next
+# to each artifact as trace_report.json
+run trace_reports    900 python tools/trace_report.py --all
 run cond_elision     900 python tools/cond_elision_probe.py
 # A/B wall-clock of the PRODUCTION cond skips (pipeline bubble-skip +
 # ring causal-skip) — executable-verified since r4, first timing
@@ -210,6 +227,10 @@ run tune_all        4800 python tools/tune_kernels.py --kernel all
 # fp16 is half the reference's reason to exist, zero hardware evidence;
 # record carries skipped_steps + final loss_scale)
 run bench_gpt2_fp16 1200 python bench.py --config gpt2_fp16 --timeout 1000
+# re-fit after the sweep/kernel group: tune_all just banked measured
+# per-kernel timings WITH their analytic predicted.ms — the first
+# silicon-backed kernel factors
+run calibrate_refresh3 300 python -m apex1_tpu.obs.calibrate
 run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 # PR 7 multi-replica serving sweep BEHIND the existing entries: replica
 # scaling + goodput under a seed-keyed replica kill; record banked
@@ -217,6 +238,9 @@ run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 run bench_serving_rep 1800 python tools/bench_serving.py --loads 8 \
                          --replicas 1 2 --chaos \
                          --out perf_results/bench_serving_replicas.json
+# final re-fit: the window's complete corpus (all bench groups + the
+# tuning sweeps) becomes the calibration the NEXT session commits
+run calibrate_refresh4 300 python -m apex1_tpu.obs.calibrate
 echo "queue done $(date -u +%H:%M:%S)" >> "$RES/status.log"
 
 if [ "$MODE" = rehearse ]; then

@@ -50,13 +50,40 @@ sys.path.insert(0, _TOOLS)   # for bench_kernels (shared timeit)
 @dataclasses.dataclass
 class Case:
     """One kernel sweep: candidates (dicts of block params) + a factory
-    returning (timed_fn, args) for a candidate."""
+    returning (timed_fn, args) for a candidate. ``flops``/``nbytes``
+    are the ANALYTIC cost of one timed invocation at the sweep shape
+    (formulas mirror tools/predict_perf.py::_kernel_cases) — banked
+    beside the winner as ``predicted.ms`` so `apex1_tpu.obs.calibrate`
+    can pair every measured sweep against its own roofline. None =
+    unpriced (the entry then never feeds calibration)."""
     kernel: str                   # registry name (keys the table)
     dims: dict                    # padded dims for the table key
     dtype: str                    # canonical dtype for the table key
     candidates: Sequence[dict]
     make: Callable                # blocks -> (fn, args)
     grad: bool                    # fwd+bwd (training path) vs fwd-only
+    flops: float = None           # analytic flops per timed invocation
+    nbytes: float = None          # analytic min HBM bytes per invocation
+
+
+def _flash_cost(B, Hq, Hkv, S, D, causal=True, grad=False):
+    """Analytic (flops, min HBM bytes) for one flash invocation —
+    predict_perf's formula, incl. the 4.5x fwd+bwd factor for the
+    SHIPPED two-pass backward (7 bwd matmuls, not the fused-5)."""
+    f = 4 * B * Hq * S * S * D * (0.5 if causal else 1.0)
+    if grad:
+        f *= 4.5
+    qb = B * Hq * S * D * 2
+    kvb = 2 * B * Hkv * S * D * 2
+    byt = qb + kvb + qb            # q, k, v in; o out
+    if grad:
+        byt += 2 * qb + kvb + qb   # dq out, dk/dv out, do in
+    return float(f), float(byt)
+
+
+def _elemwise_cost(n_elem, passes, itemsize, fpe):
+    """Bandwidth-bound row kernels: bytes = per-pass element traffic."""
+    return float(fpe * n_elem), float(passes * n_elem * itemsize)
 
 
 def _grad_of_sum(f, argnums):
@@ -94,11 +121,12 @@ def _attention_case(B, Hq, Hkv, S, D, cands):
                               block_k=blocks["block_k"])
         return _grad_of_sum(f, (0, 1, 2)), (q, k, v)
 
+    fl, by = _flash_cost(B, Hq, Hkv, S, D, causal=True, grad=True)
     return Case("flash_attention",
                 {"Dp": padded_lanes(D), "Sb": seq_bucket(S)}, "bfloat16",
                 [dict(block_q=bq, block_k=bk) for bq, bk in cands
                  if bq <= S and bk <= S],
-                make, grad=True)
+                make, grad=True, flops=fl, nbytes=by)
 
 
 def case_attention(tiny):
@@ -139,18 +167,20 @@ def case_linear_xent(tiny):
 
     return Case("linear_xent", {"Hp": padded_lanes(H)}, "bfloat16",
                 [dict(block_t=bt, block_v=bv) for bt, bv in cands],
-                make, grad=True)
+                make, grad=True,
+                flops=float(6 * T * H * V),              # fwd + dX + dW
+                nbytes=float(2 * (3 * V * H + 2 * T * H + V * H)))
 
 
 def _row_case(kernel, tiny, build, tiny_cands=(32, 64),
               cands=(64, 128, 256, 336, 512)):
     from apex1_tpu.tuning import padded_lanes
 
-    fn_factory, lanes, dtype = build(tiny)
+    fn_factory, lanes, dtype, fl, by = build(tiny)
     brs = tiny_cands if tiny else cands
     return Case(kernel, {"lanes": padded_lanes(lanes)}, dtype,
                 [dict(block_rows=br) for br in brs], fn_factory,
-                grad=True)
+                grad=True, flops=fl, nbytes=by)
 
 
 def case_softmax(tiny):
@@ -170,7 +200,8 @@ def case_softmax(tiny):
                     x, scale=0.125, block_rows=blocks["block_rows"])
             return _grad_of_sum(f, 0), (x,)
 
-        return make, S, "float32"
+        return make, S, "float32", *_elemwise_cost(
+            B * H * S * S // 2, 4, 4, 8)   # causal half, f+b
 
     return _row_case("fused_softmax", tiny, build)
 
@@ -194,7 +225,7 @@ def case_layer_norm(tiny):
                                   block_rows=blocks["block_rows"])
             return _grad_of_sum(f, 0), (x,)
 
-        return make, H, "bfloat16"
+        return make, H, "bfloat16", *_elemwise_cost(R * H, 4, 2, 8)
 
     return _row_case("layer_norm", tiny, build)
 
@@ -218,7 +249,8 @@ def case_rope(tiny):
                     x, cos, sin, block_rows=blocks["block_rows"])
             return _grad_of_sum(f, 0), (x,)
 
-        return make, D // 2, "bfloat16"
+        return make, D // 2, "bfloat16", *_elemwise_cost(
+            B * S * H * D, 4, 2, 6)
 
     return _row_case("rope", tiny, build)
 
@@ -242,7 +274,8 @@ def case_xentropy(tiny):
                     block_rows=blocks["block_rows"])
             return _grad_of_sum(f, 0), (x,)
 
-        return make, V, "float32"
+        return make, V, "float32", *_elemwise_cost(
+            T * V, 3, 4, 8)   # recompute-bwd: x, x, dx
 
     return _row_case("xentropy", tiny, build,
                      tiny_cands=(32, 64), cands=(8, 16, 32))
@@ -268,7 +301,9 @@ def case_bias_dropout_add(tiny):
                     block_rows=blocks["block_rows"])
             return _grad_of_sum(f, (0, 1)), (x, r)
 
-        return make, H, "bfloat16"
+        # fwd: x, r in + out; bwd: dout in + dx, dr out — 6 passes of
+        # (R, H) bf16; ~10 flops/elem covers the hash + mask + muladd
+        return make, H, "bfloat16", *_elemwise_cost(R * H, 6, 2, 10)
 
     return _row_case("bias_dropout_add", tiny, build)
 
@@ -300,7 +335,9 @@ def case_fused_matmul(tiny):
     return Case("fused_collective_matmul", {"Kp": padded_lanes(K)},
                 "bfloat16",
                 [dict(block_m=bm, block_n=bn) for bm, bn in cands
-                 if bm <= M], make, grad=False)
+                 if bm <= M], make, grad=False,
+                flops=float(2 * M * K * N),
+                nbytes=float(M * K * 2 + K * N * 2 + M * N * 4))
 
 
 def case_fused_ag_flash(tiny):
@@ -337,10 +374,17 @@ def case_fused_ag_flash(tiny):
                              blocks["block_q"], blocks["block_k"])
         return f, (q, k, v)
 
+    # full (uncausal-equivalent) attend of one visiting shard + the
+    # fp32 (out, lse) carry read+written in the epilogue
+    qb = B * Hq * S * D * 2
+    kvb = 2 * B * Hkv * S * D * 2
+    carry = 2 * (B * Hq * S * D * 4 + B * Hq * S * 4)
     return Case("fused_ag_flash",
                 {"Dp": padded_lanes(D), "Sb": seq_bucket(S)}, "bfloat16",
                 [dict(block_q=bq, block_k=bk) for bq, bk in cands
-                 if bq <= S and bk <= S], make, grad=False)
+                 if bq <= S and bk <= S], make, grad=False,
+                flops=float(4 * B * Hq * S * S * D),
+                nbytes=float(qb + kvb + carry))
 
 
 def case_int8(tiny):
@@ -365,7 +409,9 @@ def case_int8(tiny):
 
     return Case("int8_matmul", {"N": N, "K": K}, "int8",
                 [dict(block_n=bn, block_k=bk) for bn, bk in cands],
-                make, grad=False)
+                make, grad=False,
+                flops=float(2 * T * N * K),
+                nbytes=float(N * K + N * 4 + T * K * 2 + T * N * 2))
 
 
 CASES = {
@@ -423,6 +469,7 @@ def _sweep_case(case, iters, say, write):
 
     from apex1_tpu import tuning
     from apex1_tpu.core.capability import vmem_budget
+    from apex1_tpu.obs import calibrate, spine
     from apex1_tpu.ops._common import force_impl, on_tpu
     from apex1_tpu.tuning.registry import SPECS
 
@@ -458,6 +505,21 @@ def _sweep_case(case, iters, say, write):
     # lazy import so jax initializes only after --backend takes effect
     from bench_kernels import timeit
 
+    # analytic roofline for ONE timed invocation at the sweep shape —
+    # banked as `predicted.ms` beside the winner so obs.calibrate can
+    # pair every sweep measurement against its own prediction (the
+    # (shape -> timing) corpus ROADMAP-5 fits correction factors from).
+    # Keyed to the same generation the table entry lands under.
+    gen = tuning.canonical_generation(None)
+    pred_ms = None
+    if case.flops is not None and case.nbytes is not None:
+        pred_ms = round(calibrate.roofline_ms(case.flops, case.nbytes,
+                                              gen), 6)
+        say(f"  predicted {pred_ms:.4f} ms roofline ({gen}; interpret "
+            f"timings will sit far above it — plumbing, not silicon)"
+            if tiny else
+            f"  predicted {pred_ms:.4f} ms roofline ({gen})")
+
     results = []
     for blocks in runnable:
         fn, args = case.make(blocks)
@@ -469,23 +531,35 @@ def _sweep_case(case, iters, say, write):
             results.append((dt, blocks))
             breakdown.append({"blocks": dict(blocks), "status": "timed",
                               "time_ms": round(dt * 1e3, 4)})
+            spine.emit("event", "tune.candidate", kernel=case.kernel,
+                       blocks=dict(blocks), status="timed",
+                       time_ms=round(dt * 1e3, 4))
         except Exception as e:
             say(f"  {blocks}: {type(e).__name__}: {str(e)[:140]}")
             breakdown.append({"blocks": dict(blocks), "status": "error",
                               "error": f"{type(e).__name__}: "
                                        f"{str(e)[:140]}"})
+            spine.emit("event", "tune.candidate", kernel=case.kernel,
+                       blocks=dict(blocks), status="error")
     if not results:
         return None, [f"{case.kernel}: every candidate failed"]
 
     dt, blocks = min(results, key=lambda r: r[0])
     say(f"  WINNER {blocks}  {dt * 1e3:.3f} ms")
+    spine.emit("event", "tune.winner", kernel=case.kernel,
+               blocks=dict(blocks), time_ms=round(dt * 1e3, 4),
+               predicted_ms=pred_ms)
     if not write:
         return blocks, []
+    extra = {"sweep": {"iters": iters,
+                       "grad": bool(case.grad),
+                       "candidates": breakdown}}
+    if pred_ms is not None:
+        extra["predicted"] = {"ms": pred_ms, "flops": case.flops,
+                              "bytes": case.nbytes, "generation": gen}
     key, _entry = tuning.record(
         case.kernel, case.dims, case.dtype, blocks, time_ms=dt * 1e3,
-        extra={"sweep": {"iters": iters,
-                         "grad": bool(case.grad),
-                         "candidates": breakdown}})
+        extra=extra)
     path = tuning.save(case.kernel)
     # earlier traces in THIS process baked the pre-sweep table values
     # into their executables — drop them before anyone re-traces
