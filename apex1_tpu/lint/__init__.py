@@ -16,8 +16,13 @@ Entry points::
     res.unsuppressed()        # -> [Finding]  (gate on this)
     res.as_dict()             # -> the --json payload
 
-CLI: ``python tools/lint.py [--json] [--changed] [paths...]``.
-Rule catalogue + suppression grammar: ``docs/lint.md``.
+``kernels=True`` additionally runs the APX2xx kernel/collective
+analyzer (``lint.kernels``: the Pallas semaphore/DMA protocol
+model-checker, mesh/axis consistency, and the shared-VMEM budget
+pass) — the surface tier-1 can never execute.
+
+CLI: ``python tools/lint.py [--json] [--changed] [--kernels]
+[paths...]``. Rule catalogue + suppression grammar: ``docs/lint.md``.
 
 The lint machinery is stdlib ``ast`` only — no new deps, no jax, no
 device touch; the whole repo lints in ~1s. (``tools/lint.py`` loads
@@ -50,6 +55,7 @@ class LintResult:
     findings: List[Finding]
     n_files: int
     unused: List[Tuple[str, int, str]]   # (path, line, rules) — info only
+    kernels: bool = False                # APX2xx family included?
 
     def unsuppressed(self) -> List[Finding]:
         return [f for f in self.findings if not f.suppressed]
@@ -65,10 +71,14 @@ class LintResult:
         per_rule: Dict[str, int] = {}
         for f in self.unsuppressed():
             per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        rules = list(RULES)
+        if self.kernels:
+            from apex1_tpu.lint.kernels import KERNEL_RULES
+            rules = rules + list(KERNEL_RULES)
         return {
             "tool": "graftlint",
             "rules": {r.code: {"slug": r.slug, "summary": r.summary}
-                      for r in RULES},
+                      for r in rules},
             "n_files": self.n_files,
             "ok": self.ok,
             "counts": {"unsuppressed": len(self.unsuppressed()),
@@ -136,8 +146,8 @@ def _display_path(path: str, root: Optional[str]) -> str:
     return path if rel.startswith("..") else rel
 
 
-def lint_files(files: Sequence[str],
-               root: Optional[str] = None) -> LintResult:
+def lint_files(files: Sequence[str], root: Optional[str] = None,
+               kernels: bool = False) -> LintResult:
     named: Dict[str, Tuple[str, str]] = {}
     unreadable: List[Finding] = []
     for f in files:
@@ -150,19 +160,22 @@ def lint_files(files: Sequence[str],
                                       f"cannot read file: {e}"))
             continue
         named[disp] = (module_name_for(f, root), text)
-    res = lint_sources(named)
+    res = lint_sources(named, kernels=kernels)
     res.findings.extend(unreadable)
     return res
 
 
-def lint_paths(paths: Sequence[str],
-               root: Optional[str] = None) -> LintResult:
-    return lint_files(collect_files(paths, root), root)
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               kernels: bool = False) -> LintResult:
+    return lint_files(collect_files(paths, root), root,
+                      kernels=kernels)
 
 
-def lint_sources(named_sources: Dict[str, Tuple[str, str]]) -> LintResult:
+def lint_sources(named_sources: Dict[str, Tuple[str, str]],
+                 kernels: bool = False) -> LintResult:
     """``{path: (modname, text)}`` -> LintResult. The in-memory entry
-    point the tests drive fixtures through."""
+    point the tests drive fixtures through. ``kernels=True`` adds the
+    APX2xx kernel/collective analyzer to the run."""
     project = build_project(named_sources)
     by_path: Dict[str, ModuleSource] = {m.path: m
                                         for m in project.modules}
@@ -171,6 +184,9 @@ def lint_sources(named_sources: Dict[str, Tuple[str, str]]) -> LintResult:
         findings.extend(mod.errors)
     for rule in RULES:
         findings.extend(rule.check(project))
+    if kernels:
+        from apex1_tpu.lint.kernels import check_kernels
+        findings.extend(check_kernels(project))
     out: List[Finding] = []
     for f in findings:
         mod = by_path.get(f.path)
@@ -183,4 +199,4 @@ def lint_sources(named_sources: Dict[str, Tuple[str, str]]) -> LintResult:
         for s in unused_suppressions(mod):
             unused.append((mod.path, s.line, ",".join(s.rules)))
     return LintResult(findings=out, n_files=len(project.modules),
-                      unused=unused)
+                      unused=unused, kernels=kernels)

@@ -50,6 +50,17 @@ RULE_SLUGS: Dict[str, str] = {
     "APX103": "prng-reuse",
     "APX104": "donation",
     "APX105": "compat-spelling",
+    # APX2xx: the kernel/collective analyzer (lint/kernels/, opt-in
+    # via lint_*(kernels=True) / `tools/lint.py --kernels`)
+    "APX201": "sem-protocol",
+    "APX202": "dma-race",
+    "APX203": "kernel-hang",
+    "APX204": "ring-guard",
+    "APX205": "ppermute-perm",
+    "APX206": "axis-binding",
+    "APX207": "exclusive-knobs",
+    "APX208": "vmem-budget",
+    "APX209": "kernel-binding",
 }
 
 _SLUG_TO_CODE = {v: k for k, v in RULE_SLUGS.items()}

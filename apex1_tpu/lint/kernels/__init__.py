@@ -1,0 +1,165 @@
+"""graftlint kernel analyzer — the APX2xx rule family.
+
+The APX1xx rules gate the *host-side* JAX hazards tier-1 can at least
+partially execute. This package gates the compiled-TPU-only surface
+tier-1 can NEVER execute: Pallas kernel bodies and the shard_map
+collective layer. Three cooperating analyses (all stdlib-``ast``, no
+jax, no device):
+
+- **protocol** (APX201–203): a micro-model-checker over each kernel's
+  ``semaphore_signal``/``semaphore_wait``/``make_async_remote_copy``
+  schedule, exhaustively simulated for ring sizes n=1..6 — the machine
+  version of the manual "recount it for n=2..5" proof PR 9's review
+  performed on the RDMA reduce-scatter (both of that review's races
+  are regression fixtures in tests/test_lint_kernels.py);
+- **mesh** (APX204–207): ppermute bijections, axis-name binding,
+  ``overlap=``/``fused=`` exclusivity, ring-size guards before
+  remote-DMA dispatch;
+- **budget** (APX208–209): static VMEM lower bounds against the
+  ``apex1_tpu.vmem_model`` planning budget (the ONE sizing model
+  shared with ``tuning.registry`` and ``tools/aot_check.py``) and
+  pallas_call<->kernel wiring sanity.
+
+Entry points: ``tools/lint.py --kernels`` (the ``== graftlint kernels
+==`` check_all step), ``lint_paths(..., kernels=True)``, and the
+tier-1 repo self-check. The APX1xx suppression grammar and exit-code
+contract apply unchanged: ``# graftlint: allow(APX202) -- reason``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import Project
+from apex1_tpu.lint.kernels import budget as _budget
+from apex1_tpu.lint.kernels import mesh as _mesh
+from apex1_tpu.lint.kernels.extract import (ExtractError,
+                                            extract_schedule,
+                                            is_protocol_kernel,
+                                            pallas_sites)
+from apex1_tpu.lint.kernels.protocol import (RING_SIZES,
+                                             check_schedules)
+
+__all__ = ["KERNEL_RULES", "KernelRule", "check_kernels"]
+
+
+class KernelRule(NamedTuple):
+    code: str
+    slug: str
+    summary: str
+
+
+#: catalogue (the check functions are pass-level, not per-rule —
+#: docs/lint.md documents each)
+KERNEL_RULES = [
+    KernelRule("APX201", "sem-protocol",
+               "semaphore/DMA protocol defect: unpaired signal/wait, "
+               "semaphore nonzero at kernel exit, or an unmodelable "
+               "protocol kernel"),
+    KernelRule("APX202", "dma-race",
+               "DMA data race: a slot write not ordered after the "
+               "wait licensing it, or a read observing "
+               "schedule-dependent payloads"),
+    KernelRule("APX203", "kernel-hang",
+               "kernel can deadlock at some ring size n=1..6 "
+               "(all devices blocked, nothing in flight)"),
+    KernelRule("APX204", "ring-guard",
+               "remote-DMA kernel dispatched without a ring-size "
+               "guard (n==1 is an in-kernel hang)"),
+    KernelRule("APX205", "ppermute-perm",
+               "ppermute permutation is not a bijection over the "
+               "named axis"),
+    KernelRule("APX206", "axis-binding",
+               "collective axis name bound by no mesh, shard_map, or "
+               "function contract"),
+    KernelRule("APX207", "exclusive-knobs",
+               "overlap=/fused= both reachable (mutually exclusive "
+               "by design)"),
+    KernelRule("APX208", "vmem-budget",
+               "statically provable VMEM frame exceeds the planning "
+               "budget (shared apex1_tpu.vmem_model)"),
+    KernelRule("APX209", "kernel-binding",
+               "pallas_call<->kernel wiring mismatch: ref arity, "
+               "index_map arity, or semaphore/buffer role confusion"),
+]
+
+
+def _protocol_findings(project: Project, sites) -> List[Finding]:
+    guarded = _mesh.guarded_kernel_nodes(project, sites)
+    findings: List[Finding] = []
+    protocol_infos = [info for info in project.functions.values()
+                      if is_protocol_kernel(project, info)]
+
+    def mkey(info):
+        return info.mod.modname or info.mod.path
+
+    # Selection: `is_protocol_kernel` uses ast.walk, so a DISPATCH
+    # function with a nested kernel def satisfies it too — but the
+    # kernel, not its wrapper, is what must be simulated. Any protocol
+    # function that strictly ENCLOSES a pallas_call-referenced kernel
+    # is a wrapper and is excluded; of the rest, only the outermost are
+    # kernels (their nested `pl.when` closures and helpers are
+    # interpreted inline as part of the enclosing schedule).
+    site_kernel_scopes = {
+        (mkey(s.kernel), s.kernel.scope) for s in sites
+        if s.kernel is not None
+        and is_protocol_kernel(project, s.kernel)}
+    wrappers = set()
+    for info in protocol_infos:
+        m = mkey(info)
+        if any(ms == m and len(info.scope) < len(sc)
+               and sc[:len(info.scope)] == info.scope
+               for ms, sc in site_kernel_scopes):
+            wrappers.add((m, info.scope))
+    scopes = {(mkey(info), info.scope)
+              for info in protocol_infos} - wrappers
+    seen = set()
+    for info in protocol_infos:
+        m = mkey(info)
+        if (m, info.scope) in wrappers:
+            continue
+        if any((m, info.scope[:k]) in scopes
+               for k in range(1, len(info.scope))):
+            continue
+        if id(info.node) in seen:
+            continue
+        seen.add(id(info.node))
+        # a ring-size-guarded kernel is unreachable at n == 1 by
+        # construction: simulating it there would only re-prove the
+        # guard's reason
+        sizes = tuple(n for n in RING_SIZES
+                      if n > 1 or id(info.node) not in guarded)
+        schedules = {}
+        try:
+            for n in sizes:
+                schedules[n] = extract_schedule(project, info.mod,
+                                                info, n)
+        except ExtractError as e:
+            findings.append(Finding(
+                "APX201", info.mod.path, e.line or info.line, 0,
+                f"protocol kernel {info.name!r} cannot be "
+                f"model-checked: {e} — keep semaphore/DMA kernels "
+                f"inside the modelable fragment (docs/lint.md) or "
+                f"suppress with a reason"))
+            continue
+        for issue in check_schedules(schedules):
+            findings.append(Finding(
+                issue.code, info.mod.path,
+                issue.line or info.line, 0,
+                f"[{info.name}, ring n={_fmt_ns(issue.ns)}] "
+                f"{issue.msg}"))
+    return findings
+
+
+def _fmt_ns(ns) -> str:
+    return ",".join(str(n) for n in sorted(ns))
+
+
+def check_kernels(project: Project) -> List[Finding]:
+    """All APX2xx findings for a built project."""
+    sites = pallas_sites(project)
+    findings = _protocol_findings(project, sites)
+    findings.extend(_mesh.check(project, sites))
+    findings.extend(_budget.check(project, sites))
+    return findings

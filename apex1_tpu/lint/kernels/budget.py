@@ -1,0 +1,231 @@
+"""VMEM/BlockSpec budget pass (APX208) + kernel-binding sanity (APX209).
+
+APX208 prices every ``pallas_call``'s statically evaluable frame —
+VMEM ``scratch_shapes`` with literal shapes/dtypes, plus BlockSpec
+block shapes (double-buffered, floored at 1 byte/element when the
+operand dtype is unknowable from the AST) — against the **conservative
+v5e planning budget**, the same ``core.capability.vmem_budget`` figure
+the block planners and ``tuning.registry`` gate with, through the ONE
+shared sizing module ``apex1_tpu.vmem_model``. Everything unpriceable
+contributes zero, so the estimate is a LOWER bound: a finding is a
+proof the kernel cannot fit, never a heuristic. (The registry's
+per-kernel formulas stay the richer model for tuned kernels; this pass
+is the backstop for the kernels nothing registered — exactly the ones
+a planner or sweep will emit unreviewed.)
+
+APX209 checks the wiring between a ``pallas_call`` and its kernel
+function, the part Mosaic only diagnoses with a cryptic arity error at
+compile time on real hardware:
+
+- kernel positional-parameter count == num_scalar_prefetch + inputs +
+  outputs + scratch entries (when all four are statically countable);
+- each BlockSpec ``index_map`` arity == grid rank + num_scalar_prefetch;
+- scratch roles respected inside the kernel body: a ``SemaphoreType``
+  scratch param must never be subscript-read/written or used as a DMA
+  data buffer, and a ``VMEM`` scratch param must never be passed to
+  ``semaphore_signal``/``semaphore_wait`` or a DMA semaphore position —
+  cross-wired semaphores are precisely how a protocol kernel corrupts
+  its own flow control.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import Project
+from apex1_tpu.lint.kernels.extract import (PLTPU, PallasSite,
+                                            pallas_sites)
+
+#: the static gate prices against the conservative off-TPU planning
+#: target — the same default ``core.capability.get_capability`` serves
+#: the heuristics (passing the generation EXPLICITLY keeps this import
+#: path jax-free: detection would touch jax.devices()).
+PLANNING_GENERATION = "v5e"
+
+
+def _budget() -> int:
+    from apex1_tpu.vmem_model import budget_bytes
+    return budget_bytes(PLANNING_GENERATION)
+
+
+def check(project: Project,
+          sites: Optional[List[PallasSite]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    if sites is None:
+        sites = pallas_sites(project)
+    for site in sites:
+        findings.extend(_check_budget(site))
+        findings.extend(_check_binding(project, site))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APX208: static VMEM lower bound vs the planning budget
+# ---------------------------------------------------------------------------
+
+def _block_elems(shape) -> Optional[int]:
+    if shape is None:
+        return None
+    total = 1
+    for d in shape:
+        if not isinstance(d, int):
+            return None
+        total *= d
+    return total
+
+
+def _check_budget(site: PallasSite) -> List[Finding]:
+    scratch_bytes = 0
+    for entry in site.scratch:
+        b = entry.static_bytes()
+        if b:
+            scratch_bytes += b
+    operand_bytes = 0
+    for spec in site.in_specs + site.out_specs:
+        elems = _block_elems(spec.shape)
+        if elems:
+            operand_bytes += elems  # 1 byte/element floor: dtype unknown
+    from apex1_tpu.vmem_model import static_frame_bytes
+    est = static_frame_bytes(operand_bytes=operand_bytes,
+                             scratch_bytes=scratch_bytes)
+    if est == 0:
+        return []
+    budget = _budget()
+    if est <= budget:
+        return []
+    return [Finding(
+        "APX208", site.mod.path, site.line, site.call.col_offset,
+        f"statically provable VMEM frame lower bound "
+        f"{est / 2**20:.1f} MiB (scratch {scratch_bytes / 2**20:.1f} "
+        f"MiB + double-buffered blocks, 1 B/elem floor) exceeds the "
+        f"{PLANNING_GENERATION} planning budget "
+        f"{budget / 2**20:.1f} MiB (apex1_tpu.vmem_model) — this "
+        f"kernel cannot compile on the planning target")]
+
+
+# ---------------------------------------------------------------------------
+# APX209: pallas_call <-> kernel wiring
+# ---------------------------------------------------------------------------
+
+def _kernel_positional_params(node) -> Optional[List[str]]:
+    a = node.args
+    if a.vararg or a.kwarg:
+        return None
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+_SEM_KINDS = ("sem_dma", "sem_regular", "sem_barrier")
+
+
+def _check_binding(project: Project, site: PallasSite) -> List[Finding]:
+    findings: List[Finding] = []
+    if site.kernel is None:
+        return findings
+    all_params = _kernel_positional_params(site.kernel.node)
+    params = None
+    if all_params is not None:
+        # functools.partial consumes leading positionals and kw-bound
+        # names before the pallas machinery binds refs
+        params = [p for p in all_params[site.n_bound_pos:]
+                  if p not in site.kernel_bindings]
+    mod = site.mod
+
+    # arity: prefetch + inputs + outputs + scratch
+    if params is not None and site.n_inputs is not None and \
+            site.n_outputs is not None:
+        expected = (site.num_scalar_prefetch + site.n_inputs
+                    + site.n_outputs + len(site.scratch))
+        if len(params) != expected:
+            findings.append(Finding(
+                "APX209", mod.path, site.line, site.call.col_offset,
+                f"kernel {site.kernel.name!r} takes {len(params)} "
+                f"unbound positional ref(s) but the pallas_call "
+                f"supplies {expected} ({site.num_scalar_prefetch} "
+                f"prefetch + {site.n_inputs} in + {site.n_outputs} "
+                f"out + {len(site.scratch)} scratch) — Mosaic reports "
+                f"this as an opaque arity error at compile time"))
+            return findings   # role mapping below would misalign
+
+    # index_map arity: grid rank + prefetch
+    if site.grid_len is not None:
+        want = site.grid_len + site.num_scalar_prefetch
+        for spec in site.in_specs + site.out_specs:
+            if spec.index_map_arity is not None and \
+                    spec.index_map_arity != want:
+                findings.append(Finding(
+                    "APX209", mod.path, spec.line, 0,
+                    f"BlockSpec index_map takes "
+                    f"{spec.index_map_arity} argument(s) but the grid "
+                    f"supplies {want} ({site.grid_len} grid + "
+                    f"{site.num_scalar_prefetch} scalar-prefetch)"))
+
+    # scratch roles
+    if params is None or site.n_inputs is None or \
+            site.n_outputs is None or not site.scratch:
+        return findings
+    scratch_params = params[len(params) - len(site.scratch):]
+    roles = {p: e for p, e in zip(scratch_params, site.scratch)}
+    sem_use, buf_use = _usage(project, site)
+    for p, entry in roles.items():
+        if entry.kind in _SEM_KINDS and p in buf_use:
+            findings.append(Finding(
+                "APX209", mod.path, buf_use[p], 0,
+                f"semaphore scratch {p!r} is used as a data buffer "
+                f"(subscript access / DMA data operand) inside kernel "
+                f"{site.kernel.name!r}"))
+        if entry.kind == "vmem" and p in sem_use:
+            findings.append(Finding(
+                "APX209", mod.path, sem_use[p], 0,
+                f"VMEM scratch {p!r} is used as a semaphore inside "
+                f"kernel {site.kernel.name!r}"))
+    return findings
+
+
+def _base_ref_name(node) -> Optional[str]:
+    """``name``, ``name.at[..]`` or ``name[..]`` -> ``name``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr == "at":
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _usage(project: Project, site: PallasSite):
+    """(sem_use, buf_use): kernel param name -> first line used in a
+    semaphore position / a buffer position."""
+    sem_use: Dict[str, int] = {}
+    buf_use: Dict[str, int] = {}
+    mod = site.kernel.mod
+    for node in ast.walk(site.kernel.node):
+        if isinstance(node, ast.Call):
+            dotted = project.resolve_dotted(mod, node.func) or ""
+            if dotted in (f"{PLTPU}.semaphore_signal",
+                          f"{PLTPU}.semaphore_wait") and node.args:
+                name = _base_ref_name(node.args[0])
+                if name:
+                    sem_use.setdefault(name, node.lineno)
+            elif dotted == f"{PLTPU}.make_async_remote_copy":
+                for i, arg in enumerate(node.args[:4]):
+                    name = _base_ref_name(arg)
+                    if not name:
+                        continue
+                    if i < 2:
+                        buf_use.setdefault(name, node.lineno)
+                    else:
+                        sem_use.setdefault(name, node.lineno)
+            elif dotted == f"{PLTPU}.make_async_copy":
+                for i, arg in enumerate(node.args[:3]):
+                    name = _base_ref_name(arg)
+                    if not name:
+                        continue
+                    (buf_use if i < 2 else sem_use).setdefault(
+                        name, node.lineno)
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.value, ast.Name):
+            # direct data access only: `ref.at[slot]` slicing stays
+            # role-neutral here (its role comes from the DMA/semaphore
+            # call position it is passed to)
+            buf_use.setdefault(node.value.id, node.lineno)
+    return sem_use, buf_use

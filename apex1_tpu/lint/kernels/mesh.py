@@ -1,0 +1,448 @@
+"""Collective/mesh consistency pass (APX204–APX207).
+
+Four checks over the shard_map surface that pass every CPU test and
+fail only on a real mesh (or never fail, silently computing garbage):
+
+- **APX204 ring-guard** — a function that dispatches a ``pallas_call``
+  whose kernel performs inter-chip DMA must guard the degenerate ring
+  first (``if n < 2: raise/return``): on one device the RDMA drain
+  waits a never-started DMA — an in-kernel HANG, not an error message
+  (PR 9 round-2 review). Guarded kernels are also what licenses the
+  protocol checker to skip its n == 1 simulation.
+- **APX205 ppermute-perm** — a statically evaluable ``ppermute``
+  permutation must be injective in both coordinates with indices in
+  ``[0, n)`` (duplicated sources/destinations are undefined; partial
+  permutations are legal — halo's edge shifts use them — so coverage
+  is NOT required).
+- **APX206 axis-binding** — a collective's axis name must come from a
+  function contract (parameter), a named constant (``AXIS_TP``), or a
+  string literal the module visibly binds (a mesh axis name in
+  ``make_mesh``/``Mesh``/``shard_map``/``PartitionSpec``). A bare
+  string literal bound nowhere in sight is a typo'd or never-mounted
+  axis waiting for an ``unbound axis name`` crash at dispatch time.
+- **APX207 exclusive-knobs** — ``overlap=`` and ``fused=`` are
+  mutually exclusive by design (docs/parallel.md): a def taking both
+  must carry the both-set guard raise, and a call site passing both
+  non-False values is an error today or after the next default flip.
+
+All checks underclaim: anything not statically resolvable is skipped,
+never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import (FunctionInfo, ModuleSource, Project,
+                                    own_body_walk)
+from apex1_tpu.lint.kernels.extract import (PallasSite, pallas_sites,
+                                            uses_remote_dma)
+
+#: named-axis collectives -> index of the axis argument
+AXIS_OPS: Dict[str, int] = {
+    "jax.lax.psum": 1, "jax.lax.pmax": 1, "jax.lax.pmin": 1,
+    "jax.lax.pmean": 1, "jax.lax.ppermute": 1,
+    "jax.lax.psum_scatter": 1, "jax.lax.all_gather": 1,
+    "jax.lax.pbroadcast": 1, "jax.lax.all_to_all": 1,
+    "jax.lax.axis_index": 0, "jax.lax.axis_size": 0,
+}
+
+#: calls whose string arguments / kw names visibly bind mesh axis names
+_BINDING_CALLS = (
+    "jax.sharding.PartitionSpec", "jax.sharding.Mesh",
+    "jax.sharding.NamedSharding", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map", "jax.make_mesh",
+    "apex1_tpu.core.mesh.make_mesh",
+    "apex1_tpu.core.mesh.make_hybrid_mesh",
+    "apex1_tpu.core.mesh.local_mesh",
+)
+
+_AXIS_SIZE_OPS = ("jax.lax.axis_size", "jax.lax.psum")
+
+_TRIAL_NS = (2, 3, 4, 5, 6)
+
+
+def check(project: Project,
+          sites: Optional[List[PallasSite]] = None) -> List[Finding]:
+    if sites is None:
+        sites = pallas_sites(project)
+    findings: List[Finding] = []
+    findings.extend(_ring_guard(project, sites))
+    by_mod: Dict[int, List[FunctionInfo]] = {}
+    for info in project.functions.values():
+        by_mod.setdefault(id(info.mod), []).append(info)
+    for mod in project.modules:
+        if mod.tree is None:
+            continue
+        infos = by_mod.get(id(mod), [])
+        bound = _bound_axis_literals(project, mod)
+        for info in infos:
+            findings.extend(_check_function(project, mod, info, bound))
+        findings.extend(_exclusive_defs(infos))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APX204: ring-size guard before remote-DMA dispatch
+# ---------------------------------------------------------------------------
+
+def remote_dma_kernels(project: Project,
+                       sites: List[PallasSite]) -> List[PallasSite]:
+    return [s for s in sites if s.kernel is not None
+            and uses_remote_dma(project, s.kernel)]
+
+
+def _axis_size_names(project: Project, mod: ModuleSource,
+                     info: FunctionInfo) -> Set[str]:
+    """Names in ``info`` assigned from an axis-size source:
+    ``jax.lax.axis_size(...)``, a module-local wrapper of it, or
+    ``psum(1, axis)``."""
+    out: Set[str] = set()
+    for node in own_body_walk(info.node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        if _is_axis_size_call(project, mod, info, node.value):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _is_axis_size_call(project, mod, info, call: ast.Call) -> bool:
+    dotted = project.resolve_dotted(mod, call.func)
+    if dotted == "jax.lax.axis_size":
+        return True
+    if dotted == "jax.lax.psum" and call.args and \
+            isinstance(call.args[0], ast.Constant) and \
+            call.args[0].value == 1:
+        return True
+    if isinstance(call.func, ast.Name):
+        target = project.lookup_function(mod, info.scope, call.func.id)
+        if target is not None and isinstance(
+                target.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = [st for st in target.node.body
+                    if not isinstance(st, ast.Expr)
+                    or not isinstance(st.value, ast.Constant)]
+            if len(body) == 1 and isinstance(body[0], ast.Return) and \
+                    isinstance(body[0].value, ast.Call):
+                return (project.resolve_dotted(
+                    target.mod, body[0].value.func)
+                    in ("jax.lax.axis_size",))
+    return False
+
+
+def _has_ring_guard(project, mod, info, before_line: int) -> bool:
+    """An ``if`` comparing an axis-size-derived name against an int
+    constant, raising or returning, lexically before the dispatch."""
+    size_names = _axis_size_names(project, mod, info)
+    if not size_names:
+        return False
+    for node in own_body_walk(info.node):
+        if not isinstance(node, ast.If) or node.lineno >= before_line:
+            continue
+        test = node.test
+        if not isinstance(test, ast.Compare):
+            continue
+        names = {sub.id for sub in ast.walk(test)
+                 if isinstance(sub, ast.Name)}
+        consts = [sub for sub in ast.walk(test)
+                  if isinstance(sub, ast.Constant)
+                  and isinstance(sub.value, int)]
+        if not (names & size_names) or not consts:
+            continue
+        for st in node.body:
+            if isinstance(st, (ast.Raise, ast.Return)):
+                return True
+    return False
+
+
+def ring_guarded(project: Project, site: PallasSite) -> bool:
+    if site.enclosing is None:
+        return False
+    return _has_ring_guard(project, site.mod, site.enclosing, site.line)
+
+
+def _ring_guard(project: Project,
+                sites: List[PallasSite]) -> List[Finding]:
+    findings = []
+    for site in remote_dma_kernels(project, sites):
+        if not ring_guarded(project, site):
+            findings.append(Finding(
+                "APX204", site.mod.path, site.line, site.call.col_offset,
+                f"remote-DMA kernel "
+                f"{site.kernel.name if site.kernel else '?'!r} is "
+                f"dispatched without a ring-size guard: at axis size 1 "
+                f"the in-kernel drain waits a DMA that never starts (a "
+                f"hang, not an error) — guard with `if n < 2: raise` "
+                f"before the pallas_call"))
+    return findings
+
+
+def guarded_kernel_nodes(project: Project,
+                         sites: List[PallasSite]) -> Set[int]:
+    """Kernel nodes every dispatch of which carries a ring-size guard
+    (the protocol checker's license to skip n == 1)."""
+    by_kernel: Dict[int, List[PallasSite]] = {}
+    for site in remote_dma_kernels(project, sites):
+        by_kernel.setdefault(id(site.kernel.node), []).append(site)
+    return {k for k, ss in by_kernel.items()
+            if all(ring_guarded(project, s) for s in ss)}
+
+
+# ---------------------------------------------------------------------------
+# per-function checks: APX205 ppermute, APX206 axis binding, APX207 calls
+# ---------------------------------------------------------------------------
+
+def _check_function(project, mod, info, bound) -> List[Finding]:
+    findings: List[Finding] = []
+    size_names = _axis_size_names(project, mod, info)
+    assigns: Dict[str, List[ast.Assign]] = {}
+    for node in own_body_walk(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            assigns.setdefault(node.targets[0].id, []).append(node)
+    for node in own_body_walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = project.resolve_dotted(mod, node.func)
+        if dotted == "jax.lax.ppermute":
+            findings.extend(_check_perm(project, mod, info, node,
+                                        size_names, assigns))
+        if dotted in AXIS_OPS:
+            findings.extend(_check_axis(project, mod, info, node,
+                                        dotted, bound))
+        findings.extend(_exclusive_call(mod, node))
+    return findings
+
+
+def _perm_expr(node: ast.Call, assigns) -> Optional[ast.AST]:
+    perm = None
+    for kw in node.keywords:
+        if kw.arg == "perm":
+            perm = kw.value
+    if perm is None and len(node.args) > 2:
+        perm = node.args[2]
+    if isinstance(perm, ast.Name):
+        cands = [a for a in assigns.get(perm.id, ())
+                 if a.lineno < node.lineno]
+        if len(cands) != 1:
+            return None
+        return cands[0].value
+    return perm
+
+
+class _PermEval(ast.NodeVisitor):
+    """Tiny closed-form evaluator for permutation expressions: list
+    comprehensions / literals over int arithmetic, ``range``, and the
+    axis size bound to a trial n."""
+
+    def __init__(self, env: Dict[str, int]):
+        self.env = env
+
+    def ev(self, node):
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, int) and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            raise ValueError(f"free name {node.id}")
+        if isinstance(node, ast.Tuple):
+            return tuple(self.ev(el) for el in node.elts)
+        if isinstance(node, ast.List):
+            return [self.ev(el) for el in node.elts]
+        if isinstance(node, ast.BinOp):
+            a, b = self.ev(node.left), self.ev(node.right)
+            op = type(node.op)
+            if op is ast.Add:
+                return a + b
+            if op is ast.Sub:
+                return a - b
+            if op is ast.Mult:
+                return a * b
+            if op is ast.Mod:
+                return a % b
+            if op is ast.FloorDiv:
+                return a // b
+            raise ValueError("op")
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, ast.USub):
+            return -self.ev(node.operand)
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name) and node.func.id == "range":
+            return range(*[self.ev(a) for a in node.args])
+        if isinstance(node, ast.ListComp) and len(
+                node.generators) == 1 and not node.generators[0].ifs:
+            gen = node.generators[0]
+            if not isinstance(gen.target, ast.Name):
+                raise ValueError("target")
+            out = []
+            for v in self.ev(gen.iter):
+                sub = _PermEval({**self.env, gen.target.id: v})
+                out.append(sub.ev(node.elt))
+            return out
+        raise ValueError(type(node).__name__)
+
+
+def _check_perm(project, mod, info, node, size_names,
+                assigns) -> List[Finding]:
+    expr = _perm_expr(node, assigns)
+    if expr is None:
+        return []
+    free = {sub.id for sub in ast.walk(expr)
+            if isinstance(sub, ast.Name)}
+    comp_vars = {g.target.id for sub in ast.walk(expr)
+                 if isinstance(sub, (ast.ListComp, ast.GeneratorExp))
+                 for g in sub.generators
+                 if isinstance(g.target, ast.Name)}
+    unresolved = free - comp_vars - size_names - {"range"}
+    if unresolved:
+        return []     # underclaim: only axis-sized perms are provable
+    for n in _TRIAL_NS:
+        env = {name: n for name in size_names}
+        try:
+            perm = _PermEval(env).ev(expr)
+        except ValueError:
+            return []
+        if not isinstance(perm, list) or not all(
+                isinstance(p, tuple) and len(p) == 2
+                and all(isinstance(v, int) for v in p) for p in perm):
+            return []
+        srcs = [p[0] for p in perm]
+        dsts = [p[1] for p in perm]
+        bad = None
+        if len(set(srcs)) != len(srcs):
+            bad = "duplicate source indices"
+        elif len(set(dsts)) != len(dsts):
+            bad = "duplicate destination indices"
+        elif any(v < 0 or v >= n for v in srcs + dsts):
+            bad = f"indices outside [0, {n})"
+        if bad:
+            return [Finding(
+                "APX205", mod.path, node.lineno, node.col_offset,
+                f"ppermute permutation is not a bijection over the "
+                f"axis at size n={n}: {bad} in {perm!r}")]
+    return []
+
+
+def _bound_axis_literals(project, mod) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = project.resolve_dotted(mod, node.func) or ""
+        if dotted in _BINDING_CALLS or dotted.endswith(
+                (".PartitionSpec", ".NamedSharding", ".Mesh",
+                 ".shard_map", ".make_mesh", ".make_hybrid_mesh")):
+            for kw in node.keywords:
+                if kw.arg:
+                    out.add(kw.arg)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    out.add(sub.value)
+    # module-level string constants are contracts, not literals
+    for st in mod.tree.body:
+        if isinstance(st, ast.Assign) and isinstance(
+                st.value, ast.Constant) and isinstance(
+                    st.value.value, str):
+            out.add(st.value.value)
+    return out
+
+
+def _axis_arg(node: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _enclosing_params(project, info: FunctionInfo) -> Set[str]:
+    """Parameters of ``info`` and every lexically enclosing function."""
+    out: Set[str] = set(info.params)
+    key = info.mod.modname or info.mod.path
+    scope = info.scope
+    for k in range(len(scope) - 1, 0, -1):
+        outer = project.functions.get((key, scope[:k]))
+        if outer is not None:
+            out |= set(outer.params)
+    return out
+
+
+def _check_axis(project, mod, info, node, dotted, bound) -> List[Finding]:
+    arg = _axis_arg(node, AXIS_OPS[dotted])
+    if arg is None:
+        return []
+    out: List[Finding] = []
+    for expr in ([arg] if not isinstance(arg, (ast.Tuple, ast.List))
+                 else list(arg.elts)):
+        if not isinstance(expr, ast.Constant) or not isinstance(
+                expr.value, str):
+            continue  # params, constants, computed names: underclaim
+        if expr.value in bound:
+            continue
+        out.append(Finding(
+            "APX206", mod.path, node.lineno, node.col_offset,
+            f"axis name {expr.value!r} in "
+            f"{dotted.rsplit('.', 1)[-1]} is a bare string literal "
+            f"bound by no visible mesh/shard_map/PartitionSpec in "
+            f"this module and no function contract — a typo'd or "
+            f"never-mounted axis fails only at dispatch time"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# APX207: overlap= / fused= exclusivity
+# ---------------------------------------------------------------------------
+
+def _is_falsy_literal(node) -> bool:
+    return isinstance(node, ast.Constant) and (
+        node.value is False or node.value is None)
+
+
+def _exclusive_call(mod: ModuleSource, node: ast.Call) -> List[Finding]:
+    kw = {k.arg: k.value for k in node.keywords if k.arg}
+    if "overlap" in kw and "fused" in kw:
+        # both must be PROVABLY non-False: a variable on either side
+        # (`overlap=opt, fused=True`) is a legal plumb-one-knob-through
+        # pattern guarded at runtime — underclaim
+        if isinstance(kw["overlap"], ast.Constant) and \
+                isinstance(kw["fused"], ast.Constant) and \
+                not _is_falsy_literal(kw["overlap"]) and \
+                not _is_falsy_literal(kw["fused"]):
+            return [Finding(
+                "APX207", mod.path, node.lineno, node.col_offset,
+                "overlap= and fused= passed together as non-False "
+                "literals: the knobs are mutually exclusive (fused "
+                "IS the overlap)")]
+    return []
+
+
+def _exclusive_defs(infos: List[FunctionInfo]) -> List[Finding]:
+    findings = []
+    for info in infos:
+        mod = info.mod
+        params = set(info.params)
+        if not {"overlap", "fused"} <= params:
+            continue
+        guarded = False
+        for node in own_body_walk(info.node):
+            if not isinstance(node, ast.If):
+                continue
+            names = {sub.id for sub in ast.walk(node.test)
+                     if isinstance(sub, ast.Name)}
+            if {"overlap", "fused"} <= names and any(
+                    isinstance(st, ast.Raise) for st in node.body):
+                guarded = True
+                break
+        if not guarded:
+            findings.append(Finding(
+                "APX207", mod.path, info.line, 0,
+                f"{info.name}() takes both overlap= and fused= but "
+                f"never raises on the both-set combination — the "
+                f"mutually-exclusive knobs are silently combinable"))
+    return findings

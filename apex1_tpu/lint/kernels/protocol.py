@@ -1,0 +1,498 @@
+"""The semaphore/DMA protocol micro-model-checker (APX201–APX203).
+
+Automates the proof PR 9's review did by hand ("recounting for
+n=2..5"): for each protocol kernel and each ring size n, build the
+SPMD-symmetric transition system — n devices each running the
+schedule :mod:`extract` produced, semaphores as counters, RDMA
+transfers as in-flight items that *deliver nondeterministically* at any
+point between their start and the wait that licenses consuming them —
+and explore EVERY interleaving (DFS with memoized states). Checked
+properties:
+
+- **liveness** — no reachable state where all devices are blocked and
+  nothing is in flight (APX203; ``n == 1`` turns the RDMA drain into a
+  wait on a never-started DMA — the hang class the ring-size guard
+  rule exists for);
+- **torn sends** — a local write to a buffer slot while a DMA that
+  reads that slot is still in flight: delivery observes content that
+  differs from the content at start (APX202; PR 9 race #1,
+  write-before-credit-wait);
+- **read determinism** — every read of a DMA-fed buffer slot must
+  observe the SAME payload in every interleaving; two reachable
+  payloads mean the read is not ordered after the wait that completes
+  its DMA / the credit protecting it (APX202; PR 9 race #2,
+  credit-signal-before-read);
+- **conservation** — per semaphore, increments arriving at a device
+  (neighbor signals + DMA completions) must equal the wait decrements
+  it performs, and every semaphore must be zero in every terminal
+  state (APX201: unpaired signals, non-draining semaphores).
+
+Payload identity is structural: each write event has a deterministic
+tag ``(device, program_index)``; deliveries copy tags. Two schedules
+disagreeing about which tag a read sees IS the race — no algorithm
+knowledge needed, so the checker is generic over kernels.
+
+What this does NOT prove (docs/lint.md has the full list): anything
+beyond the modeled ring sizes (n=1..6), Mosaic lowering/DMA-engine
+bugs, numerics, or performance. It is a protocol checker, not a
+compiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from apex1_tpu.lint.kernels.extract import Event
+
+#: ring sizes the checker simulates — covers the degenerate pair ring
+#: (n=2, both neighbors one device), the first size with slot reuse
+#: (n=4) and two sizes beyond it.
+RING_SIZES = (1, 2, 3, 4, 5, 6)
+
+#: memoized-state budget per (kernel, n). The shipped RDMA kernel
+#: explores a few thousand states at n=6; the cap exists for runaway
+#: (buggy, unthrottled) protocols and surfaces as an APX201 finding
+#: when hit — an unexplorable protocol is not a verified protocol.
+STATE_CAP = 120_000
+
+
+@dataclasses.dataclass
+class Issue:
+    code: str          # "APX201" | "APX202" | "APX203"
+    line: int
+    key: str           # dedup key (issue class + anchor)
+    msg: str
+    ns: Set[int] = dataclasses.field(default_factory=set)
+
+
+# compact event encoding for the simulator ---------------------------------
+# ('r',  buf, slot, line, t)
+# ('w',  buf, slot, line, t, idx)        idx = program index (tag id)
+# ('sig', sem, slot, inc, off, line, t)
+# ('wai', sem, slot, cnt, line, t)
+# ('dma', src_buf, src_slot, dst_buf, dst_slot, send_sem, s_slot,
+#         recv_sem, r_slot, off, line, t)
+
+
+def _encode(schedule: Sequence[Sequence[Event]]
+            ) -> Tuple[Tuple, FrozenSet[str]]:
+    """Flatten per-step events into one device program; returns the
+    program and the set of DMA-touched buffers (only their reads and
+    writes are simulated — everything else is local arithmetic).
+    A whole-ref access on a slotted DMA buffer (``buf[...]``) aliases
+    EVERY slot the program ever addresses on that buffer, so it is
+    expanded into one event per slot — collapsing it to slot 0 would
+    certify torn sends on slots 1+ as clean."""
+    dma_bufs: Set[str] = set()
+    slots: Dict[str, Set[int]] = {}
+    for evs in schedule:
+        for e in evs:
+            if e.kind == "dma":
+                for sr in (e.desc.src, e.desc.dst):
+                    dma_bufs.add(sr.ref)
+                    slots.setdefault(sr.ref, set()).add(sr.key()[1])
+            elif e.kind in ("read", "write") and e.ref.slot is not None:
+                slots.setdefault(e.ref.ref, set()).add(e.ref.slot)
+    prog = []
+    for evs in schedule:
+        for e in evs:
+            if e.kind in ("read", "write"):
+                if e.ref.ref not in dma_bufs:
+                    continue
+                kind = "r" if e.kind == "read" else "w"
+                expand = (sorted(slots.get(e.ref.ref, {0})) or [0]) \
+                    if e.ref.slot is None else [e.ref.slot]
+                for slot in expand:
+                    k = (kind, e.ref.ref, slot, e.line, e.t)
+                    if kind == "w":
+                        k = k + (len(prog),)
+                    prog.append(k)
+            elif e.kind == "signal":
+                prog.append(("sig", e.ref.ref, e.ref.key()[1], e.count,
+                             e.off, e.line, e.t))
+            elif e.kind == "wait":
+                prog.append(("wai", e.ref.ref, e.ref.key()[1], e.count,
+                             e.line, e.t))
+            elif e.kind == "dma":
+                d = e.desc
+                prog.append(("dma", d.src.ref, d.src.key()[1],
+                             d.dst.ref, d.dst.key()[1],
+                             d.send_sem.ref, d.send_sem.key()[1],
+                             d.recv_sem.ref, d.recv_sem.key()[1],
+                             d.off, e.line, e.t))
+    return tuple(prog), frozenset(dma_bufs)
+
+
+def _conservation(prog: Tuple, n: int) -> List[Issue]:
+    """Static signal/wait pairing: by SPMD symmetry every device
+    receives exactly what every device sends, so per (sem, slot) the
+    arriving increments must equal the wait decrements."""
+    inc: Dict[Tuple[str, int], int] = {}
+    dec: Dict[Tuple[str, int], int] = {}
+    first_line: Dict[Tuple[str, int], int] = {}
+    for ev in prog:
+        if ev[0] == "sig":
+            k = (ev[1], ev[2])
+            inc[k] = inc.get(k, 0) + ev[3]
+            first_line.setdefault(k, ev[5])
+        elif ev[0] == "wai":
+            k = (ev[1], ev[2])
+            dec[k] = dec.get(k, 0) + ev[3]
+            first_line.setdefault(k, ev[4])
+        elif ev[0] == "dma":
+            ks = (ev[5], ev[6])
+            kr = (ev[7], ev[8])
+            inc[ks] = inc.get(ks, 0) + 1
+            inc[kr] = inc.get(kr, 0) + 1
+            first_line.setdefault(ks, ev[10])
+            first_line.setdefault(kr, ev[10])
+    issues = []
+    for k in sorted(set(inc) | set(dec)):
+        i, d = inc.get(k, 0), dec.get(k, 0)
+        if i != d:
+            sem, slot = k
+            issues.append(Issue(
+                "APX201", first_line.get(k, 0),
+                f"conservation:{sem}:{slot}:{i - d}",
+                f"semaphore {sem!r} slot {slot} receives {i} "
+                f"increment(s) but waits consume {d} per device — "
+                f"{'unconsumed signals leave it' if i > d else 'waits block forever; it ends'}"
+                f" nonzero at kernel exit", {n}))
+    return issues
+
+
+class _Checker:
+    def __init__(self, prog: Tuple, n: int, state_cap: int):
+        self.prog = prog
+        self.n = n
+        self.cap = state_cap
+        self.issues: List[Issue] = []
+        self._seen_keys: Set[str] = set()
+        # (grid step, line, slot) -> observed payload tags, banked
+        # rotation-invariantly (provenance relative to the reader)
+        self.reads: Dict[Tuple[int, int, int], Set] = {}
+        self.cap_hit = False
+        self.deadlocks: Set[Tuple] = set()
+        self.bad_exit: Set[Tuple[str, int, int]] = set()
+        self.torn: Set[Tuple[int, int, int]] = set()
+
+    def _issue(self, code, line, dedup, msg):
+        if dedup not in self._seen_keys:
+            self._seen_keys.add(dedup)
+            self.issues.append(Issue(code, line, dedup, msg, {self.n}))
+
+    # state: (pcs, sems, bufs, inflight) — all hashable-canonical
+    #   sems:     sorted tuple of ((dev, sem, slot), value>0)
+    #   bufs:     sorted tuple of ((dev, buf, slot), tag)
+    #   inflight: frozenset of (src_dev, dst_dev, src_buf, src_slot,
+    #             dst_buf, dst_slot, send_sem, s_slot, recv_sem,
+    #             r_slot, tag_at_start, line, t)
+    #
+    # Partial-order reduction (Lipton-style movers — what keeps n=6
+    # exhaustively checkable): with per-device semaphores there is
+    # exactly ONE consumer per semaphore instance, so a signal only
+    # monotonically enables its single remote consumer, an enabled wait
+    # only lowers a counter nobody else reads, and a DMA start whose
+    # source buffer is never a delivery TARGET captures content no
+    # concurrent transition can change. All three commute with every
+    # other device's transitions, so executing the first enabled one
+    # deterministically loses no reachable observation (reads, torn
+    # sends, deadlocks, exit counts). Branching remains only where
+    # interleavings genuinely differ: buffer reads/writes on DMA-fed
+    # slots versus in-flight delivery timing.
+
+    def run(self) -> None:
+        n = self.n
+        plen = len(self.prog)
+        self._dst_bufs = {ev[3] for ev in self.prog if ev[0] == "dma"}
+        # recv semaphores that plain signals also touch lose the
+        # "only this delivery can unblock the consumer" eagerness
+        self._signalled_sems = {ev[1] for ev in self.prog
+                                if ev[0] == "sig"}
+        init = self._settle(([0] * n, {}, {}, set()))
+        stack = [init]
+        visited = {self._rot_canonical(init)}
+        while stack:
+            if len(visited) > self.cap:
+                self.cap_hit = True
+                break
+            state = stack.pop()
+            pcs_t, sems_t, bufs_t, inflight = state
+            moves = []
+            for d in range(n):
+                if pcs_t[d] < plen and self.prog[pcs_t[d]][0] in (
+                        "r", "w", "dma"):
+                    moves.append(("ev", d))
+            for dma in inflight:
+                moves.append(("del", dma))
+            if not moves:
+                if all(pc >= plen for pc in pcs_t):
+                    for (d, sem, slot), v in sems_t:
+                        if v:
+                            self.bad_exit.add((sem, slot, v))
+                else:
+                    self._deadlock(pcs_t, dict(sems_t))
+                continue
+            for mv in moves:
+                work = (list(pcs_t), dict(sems_t), dict(bufs_t),
+                        set(inflight))
+                self._apply(work, mv)
+                nxt = self._settle(work)
+                canon = self._rot_canonical(nxt)
+                if canon not in visited:
+                    visited.add(canon)
+                    stack.append(nxt)
+
+    def _rot_canonical(self, state) -> Tuple:
+        """The ring is SPMD-symmetric: relabeling devices by a rotation
+        maps reachable states to reachable states and preserves every
+        recorded observation (reads are banked rotation-invariantly —
+        payload provenance relative to the reading device). Memoizing
+        the lexicographically-least rotation cuts the explored set by
+        up to a factor of n."""
+        pcs, sems, bufs, inflight = state
+        n = self.n
+        if n == 1:
+            return state
+        # cheap pre-filter: only rotations minimizing the pcs tuple can
+        # be the canonical representative (ties are rare mid-run)
+        rots = [tuple(pcs[(d + r) % n] for d in range(n))
+                for r in range(n)]
+        m = min(rots)
+        best = None
+        for r in range(n):
+            if rots[r] != m:
+                continue
+            s = tuple(sorted((((k[0] - r) % n, k[1], k[2]), v)
+                             for k, v in sems))
+            b = tuple(sorted((((k[0] - r) % n, k[1], k[2]),
+                              _rot_tag(t, r, n)) for k, t in bufs))
+            f = tuple(sorted(
+                ((i[0] - r) % n, (i[1] - r) % n) + i[2:10]
+                + (_rot_tag(i[10], r, n),) + i[11:]
+                for i in inflight))
+            cand = (m, s, b, f)
+            if best is None or cand < best:
+                best = cand
+        return best
+
+    def _settle(self, work) -> Tuple:
+        """Fast-forward every deterministic (mover) transition in place,
+        then freeze the state: only genuine branch points are memoized.
+        A settled state's pending device events are exactly the
+        conflict-prone kinds ("r"/"w"/"dma" with a possible delivery
+        race) plus blocked waits."""
+        pcs, sems, bufs, inflight = work
+        n = self.n
+        plen = len(self.prog)
+        dst_bufs = self._dst_bufs
+        progressed = True
+        while progressed:
+            progressed = False
+            for d in range(n):
+                while pcs[d] < plen:
+                    ev = self.prog[pcs[d]]
+                    kind = ev[0]
+                    if kind == "wai":
+                        if sems.get((d, ev[1], ev[2]), 0) >= ev[3]:
+                            self._apply(work, ("ev", d))
+                            progressed = True
+                            continue
+                        break
+                    if kind == "sig":
+                        self._apply(work, ("ev", d))
+                        progressed = True
+                        continue
+                    if kind == "dma" and ev[1] not in dst_bufs:
+                        # start whose source no delivery can mutate:
+                        # captures content nothing concurrent changes
+                        self._apply(work, ("ev", d))
+                        progressed = True
+                        continue
+                    if kind == "w" and ev[1] not in dst_bufs and \
+                            not any(dma[0] == d and dma[2] == ev[1]
+                                    and dma[3] == ev[2]
+                                    for dma in inflight):
+                        # a write to a slot that is never a delivery
+                        # target conflicts only with SAME-device DMAs
+                        # reading it; none in flight -> any future
+                        # conflicting DMA is program-ordered after it
+                        self._apply(work, ("ev", d))
+                        progressed = True
+                        continue
+                    break
+            for dma in list(inflight):
+                dd = dma[1]
+                key = (dd, dma[7], dma[8])
+                if dma[7] in self._signalled_sems or any(
+                        o is not dma and (o[1], o[7], o[8]) == key
+                        for o in inflight):
+                    continue
+                if pcs[dd] >= plen:
+                    # consumer finished: no read can ever conflict
+                    self._apply(work, ("del", dma))
+                    progressed = True
+                    continue
+                nxt = self.prog[pcs[dd]]
+                if nxt[0] == "wai" and (nxt[1], nxt[2]) == (
+                        dma[7], dma[8]) and \
+                        sems.get(key, 0) < nxt[3]:
+                    # consumer is blocked on THIS delivery's recv
+                    # semaphore and nothing else can unblock it: no
+                    # conflicting read/write can precede the delivery
+                    # in any schedule — deliver now
+                    self._apply(work, ("del", dma))
+                    progressed = True
+        return (tuple(pcs), _canon(sems), _canon_b(bufs),
+                frozenset(inflight))
+
+    def _apply(self, work, mv) -> None:
+        pcs, sems, bufs, inflight = work
+        if mv[0] == "del":
+            dma = mv[1]
+            (src_dev, dst_dev, src_buf, src_slot, dst_buf, dst_slot,
+             send_sem, s_slot, recv_sem, r_slot, tag0, line, t) = dma
+            cur = bufs.get((src_dev, src_buf, src_slot))
+            if cur != tag0:
+                # the slot was overwritten while the DMA was reading it
+                wline = (self.prog[cur[1]][3]
+                         if isinstance(cur, tuple) else line)
+                self.torn.add((wline, line, t))
+            bufs[(dst_dev, dst_buf, dst_slot)] = cur
+            k = (dst_dev, recv_sem, r_slot)
+            sems[k] = sems.get(k, 0) + 1
+            k = (src_dev, send_sem, s_slot)
+            sems[k] = sems.get(k, 0) + 1
+            inflight.discard(dma)
+            return
+        d = mv[1]
+        ev = self.prog[pcs[d]]
+        pcs[d] += 1
+        kind = ev[0]
+        if kind == "r":
+            tag = bufs.get((d, ev[1], ev[2]))
+            # bank the observation rotation-invariantly: payload
+            # provenance RELATIVE to the reading device. Keyed per
+            # SLOT — a whole-ref read expands to one event per slot,
+            # and distinct slots legitimately hold distinct payloads.
+            rel = (((tag[0] - d) % self.n, tag[1])
+                   if isinstance(tag, tuple) else None)
+            self.reads.setdefault((ev[4], ev[3], ev[2]),
+                                  set()).add(rel)
+        elif kind == "w":
+            bufs[(d, ev[1], ev[2])] = (d, ev[5])
+        elif kind == "sig":
+            tgt = ((d + ev[4]) % self.n, ev[1], ev[2])
+            sems[tgt] = sems.get(tgt, 0) + ev[3]
+        elif kind == "wai":
+            k = (d, ev[1], ev[2])
+            sems[k] = sems.get(k, 0) - ev[3]
+            if sems[k] == 0:
+                del sems[k]
+        elif kind == "dma":
+            tgt = (d + ev[9]) % self.n
+            tag0 = bufs.get((d, ev[1], ev[2]))
+            inflight.add((d, tgt, ev[1], ev[2], ev[3], ev[4], ev[5],
+                          ev[6], ev[7], ev[8], tag0, ev[10], ev[11]))
+
+    def _deadlock(self, pcs, sems) -> None:
+        blocked = []
+        for d in range(self.n):
+            pc = pcs[d]
+            if pc < len(self.prog):
+                ev = self.prog[pc]
+                if ev[0] == "wai":
+                    blocked.append((ev[4], ev[1], ev[2], ev[5]))
+        blocked.sort()
+        self.deadlocks.add(tuple(sorted(set(blocked))))
+
+    def collect(self) -> List[Issue]:
+        for b in sorted(self.deadlocks):
+            if not b:
+                continue
+            line, sem, slot, t = b[0]
+            waits = ", ".join(
+                f"line {ln} (sem {s!r} slot {sl}, grid step {tt})"
+                for ln, s, sl, tt in b)
+            hint = (" — on a single device the DMA the drain waits for "
+                    "is never started (ring-size guard missing?)"
+                    if self.n == 1 else "")
+            self._issue(
+                "APX203", line, f"deadlock:{b}",
+                f"kernel can hang at ring size n={self.n}: every "
+                f"device blocks at {waits} with nothing in "
+                f"flight{hint}")
+        for sem, slot, v in sorted(self.bad_exit):
+            self._issue(
+                "APX201", 0, f"exit:{sem}:{slot}",
+                f"semaphore {sem!r} slot {slot} is {v} (not zero) at "
+                f"kernel exit at ring size n={self.n}")
+        for wline, dline, t in sorted(self.torn):
+            self._issue(
+                "APX202", wline, f"torn:{wline}:{dline}",
+                f"write at line {wline} can overwrite a buffer slot "
+                f"while the DMA started at line {dline} (grid step "
+                f"{t}) is still reading it — the write is not ordered "
+                f"after the send-wait/credit that licenses the slot "
+                f"reuse (n={self.n})")
+        for (t, line, slot), tags in sorted(self.reads.items()):
+            if len(tags) > 1:
+                # dedup on the LINE only (like the torn-send key): one
+                # racy read is one defect; check_schedules' ns merge
+                # then aggregates the ring sizes/steps it reproduces at
+                self._issue(
+                    "APX202", line, f"nondet:{line}",
+                    f"read at line {line} (first at grid step {t}, "
+                    f"slot {slot}) can observe different in-flight "
+                    f"payloads depending on the schedule (n={self.n}) "
+                    f"— the read is not ordered after the DMA-wait "
+                    f"that completes it, or its slot's credit is "
+                    f"returned before the read")
+        if self.cap_hit:
+            self._issue(
+                "APX201", 0, "cap",
+                f"state space exceeds {self.cap} states at n={self.n} "
+                f"— the protocol is not flow-controlled enough to "
+                f"verify (missing credit waits let devices drift "
+                f"unboundedly)")
+        return self.issues
+
+
+def _rot_tag(tag, r: int, n: int) -> Tuple[int, int]:
+    """Payload tag under a device rotation; the never-written sentinel
+    sorts uniformly as (-1, -1)."""
+    if isinstance(tag, tuple):
+        return ((tag[0] - r) % n, tag[1])
+    return (-1, -1)
+
+
+def _canon(sems: Dict) -> Tuple:
+    return tuple(sorted((k, v) for k, v in sems.items() if v))
+
+
+def _canon_b(bufs: Dict) -> Tuple:
+    return tuple(sorted(bufs.items()))
+
+
+def check_schedules(schedules_by_n: Dict[int, Sequence[Sequence[Event]]],
+                    state_cap: int = STATE_CAP) -> List[Issue]:
+    """Model-check one kernel over all extracted ring sizes; issues are
+    deduplicated across sizes (the ``ns`` field collects every ring
+    size an issue reproduces at)."""
+    merged: Dict[str, Issue] = {}
+    for n, schedule in sorted(schedules_by_n.items()):
+        prog, _bufs = _encode(schedule)
+        issues = _conservation(prog, n)
+        chk = _Checker(prog, n, state_cap)
+        chk.run()
+        issues.extend(chk.collect())
+        for iss in issues:
+            prev = merged.get(iss.key + iss.code)
+            if prev is None:
+                merged[iss.key + iss.code] = iss
+            else:
+                prev.ns |= iss.ns
+    out = list(merged.values())
+    out.sort(key=lambda i: (i.line, i.code, i.key))
+    return out

@@ -813,12 +813,15 @@ def matmul_reduce_scatter_rdma(x, w, axis_name=AXIS_TP):
     target). Compiled-TPU only; raises off-TPU. Forward-only (no VJP):
     training paths use `fused_matmul_reduce_scatter`.
 
-    VMEM sizing rule (established by the aot_check gate): the kernel
-    holds four fp32 chunk slots (2 recv + 2 send double buffers), i.e.
-    ``16 * (S/n) * N`` bytes, beside the double-buffered x/w/out
-    blocks — keep ``chunk * N`` under ~0.5M elements on v5e
-    (chunk=512 x N=1024 measured RESOURCE_EXHAUSTED; 256 x 512 fits
-    with margin).
+    VMEM sizing rule (established by the aot_check gate, enforced here
+    and machine-checked by graftlint APX208): the kernel holds four
+    fp32 chunk slots (2 recv + 2 send double buffers) beside the
+    double-buffered x/w/out blocks — ``apex1_tpu.vmem_model.
+    rdma_check`` is the ONE formula (shared with ``tuning.registry``'s
+    gating and ``tools/aot_check.py``); chunk=512 x N=1024 measured
+    RESOURCE_EXHAUSTED on v5e, 256 x 512 fits with margin. An
+    over-budget shape raises here instead of dying in Mosaic with
+    RESOURCE_EXHAUSTED mid-hardware-window.
     """
     if interpret_mode():
         raise NotImplementedError(
@@ -847,6 +850,16 @@ def matmul_reduce_scatter_rdma(x, w, axis_name=AXIS_TP):
             f"rdma form needs chunk % 16 == 0 and K, N % 128 == 0; got "
             f"chunk={chunk}, K={K}, N={N} (pad at the call site)")
     x, w = to_mosaic(x, w)
+    from apex1_tpu.vmem_model import budget_bytes, rdma_check
+    fits, est = rdma_check(chunk, K, N, x.dtype.itemsize,
+                           budget_bytes())
+    if not fits:
+        raise ValueError(
+            f"rdma kernel frame ~{est / 2**20:.1f} MiB (4 fp32 chunk "
+            f"slots + double-buffered x/w/out blocks, vmem_model."
+            f"rdma_check) exceeds the VMEM planning budget "
+            f"{budget_bytes() / 2**20:.1f} MiB — shrink chunk*N "
+            f"(chunk=512 x N=1024 measured RESOURCE_EXHAUSTED on v5e)")
     idx = _axis_index(axis_name)
     # chunk visiting schedule, ring order: own chunk LAST (same
     # summation order as the ppermute form / a monolithic ring

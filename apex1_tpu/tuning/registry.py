@@ -17,10 +17,15 @@ One :class:`KernelSpec` per Pallas entry point declares
   heuristics (``ops/attention._auto_blocks``, ``ops/_common.row_block``,
   ``ops/linear_xent._auto_blocks``) take over instead.
 
-The models are GATING models, not performance models: generous enough
-that every block shape the analytic heuristics produce passes, tight
-enough that the shapes AOT analysis showed OOMing do not. Measured
-preference between valid candidates comes from ``tools/tune_kernels.py``.
+The per-kernel formulas live in ``apex1_tpu.vmem_model`` — the ONE
+sizing model this registry shares with the graftlint kernel analyzer
+(APX208) and ``tools/aot_check.py``; gating behavior is pinned
+bit-identical to the pre-refactor in-module formulas by
+``tests/test_lint_kernels.py::TestVmemModelShared``. The models are
+GATING models, not performance models: generous enough that every block
+shape the analytic heuristics produce passes, tight enough that the
+shapes AOT analysis showed OOMing do not. Measured preference between
+valid candidates comes from ``tools/tune_kernels.py``.
 
 Adding a tunable kernel (see docs/ops.md "Block-size tuning"):
 
@@ -36,10 +41,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Mapping
 
-# fp32 scratch/statistics lanes — every row-stat scratch buffer is
-# (rows, 128) fp32 regardless of input dtype
-_LANES = 128
-_DB = 2  # Pallas double-buffers every blocked operand
+from apex1_tpu.vmem_model import CHECKS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,106 +57,29 @@ class KernelSpec:
                     tuple[bool, int]]
 
 
-def _flash_check(blocks, dims, es, budget):
-    """Flash attention frame: q/k/v/o blocks (double-buffered, input
-    dtype), fp32 (acc, m, l) scratch, and the live fp32 score + exp
-    tiles (bq, bk) the MXU step materializes in vregs/VMEM."""
-    bq, bk = blocks["block_q"], blocks["block_k"]
-    dp = dims["Dp"]
-    est = (_DB * es * (bq * dp + 2 * bk * dp)      # q, k, v in
-           + _DB * es * bq * dp                    # o out
-           + 4 * (bq * dp + 2 * bq * _LANES)       # acc, m, l scratch
-           + 2 * 4 * bq * bk)                      # s and e tiles
-    return est <= budget, est
-
-
-def _row_check(n_passes):
-    """Row-wise kernels (softmax/LN/xentropy/rope): ``n_passes`` row-block
-    operands of (br, lanes_p), double-buffered, priced fp32 (compute is
-    fp32 even for bf16 inputs)."""
-    def check(blocks, dims, _es, budget):
-        br = blocks["block_rows"]
-        est = n_passes * _DB * br * dims["lanes"] * 4
-        return est <= budget, est
-    return check
-
-
-def _linear_xent_check(blocks, dims, es, budget):
-    """Fused LM-head CE: the binding constraint is the AOT-established
-    accumulator bound (``ops/linear_xent._auto_blocks``): the fp32
-    dx (bt, Hp) + dw (bv, Hp) accumulators must fit 3/4 of a quarter of
-    the VMEM budget; the double-buffered operand blocks and the live
-    (bt, bv) logit tile are additionally bounded by the full budget."""
-    bt, bv = blocks["block_t"], blocks["block_v"]
-    hp = dims["Hp"]
-    acc = 4 * (bt + bv) * hp
-    est = (acc + _DB * es * (bt + bv) * hp + 2 * 4 * bt * bv)
-    ok = est <= budget and acc <= (budget // 4) * 3 // 4
-    return ok, est
-
-
-def _cm_check(blocks, dims, es, budget):
-    """Fused-collective chunk matmul (`ops.fused_collective.
-    _chunk_matmul`, the tile loop of the ppermute-ring and RDMA
-    reduce-scatter forms): x (bm, Kp) and w (Kp, bn) operand blocks
-    (double-buffered, input dtype) + the fp32 (bm, bn) output block.
-    K is untiled by design (one MXU dot per output tile, no cross-grid
-    accumulation), so Kp itself bounds the frame."""
-    bm, bn = blocks["block_m"], blocks["block_n"]
-    kp = dims["Kp"]
-    est = _DB * es * (bm * kp + kp * bn) + _DB * 4 * bm * bn
-    return est <= budget, est
-
-
-def _agf_check(blocks, dims, es, budget):
-    """All-gather-fused flash attention (`ops.fused_collective.
-    _agf_kernel`): the flash frame plus the carried fp32 (prev_out,
-    prev_lse) merge operands and the fp32 merged output block the
-    epilogue writes (the plain kernel's output is input-dtype)."""
-    ok, est = _flash_check(blocks, dims, es, budget)
-    bq, dp = blocks["block_q"], dims["Dp"]
-    extra = (_DB * 4 * (bq * dp + bq * _LANES)   # prev_out, prev_lse in
-             + _DB * 4 * bq * dp                 # merged fp32 out
-             - _DB * es * bq * dp)               # replaces q-dtype out
-    est = est + extra
-    return est <= budget, est
-
-
-def _int8_check(blocks, dims, _es, budget):
-    """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
-    ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
-    (double-buffered), fp32 out block + scales."""
-    bn, bk = blocks["block_n"], blocks["block_k"]
-    t = 1024
-    est = (_DB * (t * bk * 2 + bn * bk * 1 + bn * 4) + t * bn * 4)
-    return est <= budget, est
-
-
 SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
     # Sb: power-of-two seq bucket (tuning.seq_bucket) — block preference
-    # varies with seq length, so winners never cross shape classes
+    # varies with seq length, so winners never cross shape classes.
+    # The check callables are the shared apex1_tpu.vmem_model formulas;
+    # the per-formula frame accounting is documented there.
     KernelSpec("flash_attention", ("block_q", "block_k"), ("Dp", "Sb"),
-               16, _flash_check),
+               16, CHECKS["flash_attention"]),
     KernelSpec("fused_softmax", ("block_rows",), ("lanes",), 8,
-               _row_check(3)),                     # y, dy, dx row blocks
+               CHECKS["fused_softmax"]),
     KernelSpec("layer_norm", ("block_rows",), ("lanes",), 8,
-               _row_check(5)),                     # x, dy, dx + dg/db acc
+               CHECKS["layer_norm"]),
     KernelSpec("rope", ("block_rows",), ("lanes",), 8,
-               _row_check(6)),                     # x1, x2, cos, sin, o1, o2
+               CHECKS["rope"]),
     KernelSpec("xentropy", ("block_rows",), ("lanes",), 8,
-               _row_check(2)),                     # x in, dx out (stats
-                                                   # are (br, 1) noise)
+               CHECKS["xentropy"]),
     KernelSpec("bias_dropout_add", ("block_rows",), ("lanes",), 8,
-               _row_check(4)),                     # x, residual, out (+
-                                                   # dy/dx in bwd); mask
-                                                   # is PRNG-recomputed,
-                                                   # never stored
+               CHECKS["bias_dropout_add"]),
     KernelSpec("linear_xent", ("block_t", "block_v"), ("Hp",), 16,
-               _linear_xent_check),
+               CHECKS["linear_xent"]),
     KernelSpec("fused_collective_matmul", ("block_m", "block_n"),
-               ("Kp",), 16, _cm_check),
+               ("Kp",), 16, CHECKS["fused_collective_matmul"]),
     KernelSpec("fused_ag_flash", ("block_q", "block_k"), ("Dp", "Sb"),
-               16, _agf_check),
+               16, CHECKS["fused_ag_flash"]),
     KernelSpec("int8_matmul", ("block_n", "block_k"), ("N", "K"), 128,
-               _int8_check),
+               CHECKS["int8_matmul"]),
 )}

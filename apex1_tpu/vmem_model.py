@@ -1,0 +1,183 @@
+"""The ONE per-kernel VMEM sizing model — shared by the tuning
+registry, the graftlint kernel analyzer, and the AOT gate.
+
+History: these formulas started life private to ``tuning.registry``
+(gating table entries against ``core.capability.vmem_budget``), while
+the RDMA reduce-scatter's sizing rule lived as prose in
+``ops/fused_collective.matmul_reduce_scatter_rdma``'s docstring and a
+comment beside ``tools/aot_check.py``'s compile gate. Three consumers,
+three copies, zero machine checks. This module is the deduplication:
+
+- ``tuning.registry`` builds its :class:`KernelSpec` ``check``
+  callables from the ``*_check`` functions here (gating behavior pinned
+  bit-identical to the pre-refactor formulas by
+  ``tests/test_lint_kernels.py::TestVmemModelShared``);
+- ``apex1_tpu.lint.kernels`` (graftlint APX208) prices statically
+  evaluable ``pallas_call`` frames against ``budget_bytes`` — the gate
+  that runs with NO jax and NO hardware;
+- ``tools/aot_check.py`` sizes the RDMA gate shape through
+  :func:`rdma_check` instead of restating the ``16·chunk·N`` bound in
+  a comment.
+
+Everything here is stdlib-only and jax-free: the lint CLI imports this
+module through its stub-parent path (``tools/lint.py``), so nothing
+below may import jax, numpy, or any ``apex1_tpu`` module that does.
+The generation budgets come from ``core.capability`` (itself jax-free
+at import; jax is touched only inside ``detect_generation``).
+
+All models are GATING models, not performance models: coarse, monotone
+in the block sizes, generous enough that every block shape the analytic
+heuristics produce passes, tight enough that the shapes AOT analysis
+showed OOMing do not.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+#: fp32 scratch/statistics lanes — every row-stat scratch buffer is
+#: (rows, 128) fp32 regardless of input dtype
+LANES = 128
+#: Pallas double-buffers every blocked operand
+DB = 2
+
+
+def budget_bytes(generation: str | None = None) -> int:
+    """``core.capability.vmem_budget`` — re-exported here so every
+    sizing consumer prices against the same figure. Off-TPU (and for
+    the static analyzer, always) this is the conservative v5e planning
+    budget."""
+    from apex1_tpu.core.capability import vmem_budget
+    return vmem_budget(generation)
+
+
+def flash_check(blocks, dims, es, budget):
+    """Flash attention frame: q/k/v/o blocks (double-buffered, input
+    dtype), fp32 (acc, m, l) scratch, and the live fp32 score + exp
+    tiles (bq, bk) the MXU step materializes in vregs/VMEM."""
+    bq, bk = blocks["block_q"], blocks["block_k"]
+    dp = dims["Dp"]
+    est = (DB * es * (bq * dp + 2 * bk * dp)       # q, k, v in
+           + DB * es * bq * dp                     # o out
+           + 4 * (bq * dp + 2 * bq * LANES)        # acc, m, l scratch
+           + 2 * 4 * bq * bk)                      # s and e tiles
+    return est <= budget, est
+
+
+def row_check(n_passes):
+    """Row-wise kernels (softmax/LN/xentropy/rope): ``n_passes``
+    row-block operands of (br, lanes_p), double-buffered, priced fp32
+    (compute is fp32 even for bf16 inputs)."""
+    def check(blocks, dims, _es, budget):
+        br = blocks["block_rows"]
+        est = n_passes * DB * br * dims["lanes"] * 4
+        return est <= budget, est
+    return check
+
+
+def linear_xent_check(blocks, dims, es, budget):
+    """Fused LM-head CE: the binding constraint is the AOT-established
+    accumulator bound (``ops/linear_xent._auto_blocks``): the fp32
+    dx (bt, Hp) + dw (bv, Hp) accumulators must fit 3/4 of a quarter of
+    the VMEM budget; the double-buffered operand blocks and the live
+    (bt, bv) logit tile are additionally bounded by the full budget."""
+    bt, bv = blocks["block_t"], blocks["block_v"]
+    hp = dims["Hp"]
+    acc = 4 * (bt + bv) * hp
+    est = (acc + DB * es * (bt + bv) * hp + 2 * 4 * bt * bv)
+    ok = est <= budget and acc <= (budget // 4) * 3 // 4
+    return ok, est
+
+
+def cm_check(blocks, dims, es, budget):
+    """Fused-collective chunk matmul (`ops.fused_collective.
+    _chunk_matmul`, the tile loop of the ppermute-ring and RDMA
+    reduce-scatter forms): x (bm, Kp) and w (Kp, bn) operand blocks
+    (double-buffered, input dtype) + the fp32 (bm, bn) output block.
+    K is untiled by design (one MXU dot per output tile, no cross-grid
+    accumulation), so Kp itself bounds the frame."""
+    bm, bn = blocks["block_m"], blocks["block_n"]
+    kp = dims["Kp"]
+    est = DB * es * (bm * kp + kp * bn) + DB * 4 * bm * bn
+    return est <= budget, est
+
+
+def agf_check(blocks, dims, es, budget):
+    """All-gather-fused flash attention (`ops.fused_collective.
+    _agf_kernel`): the flash frame plus the carried fp32 (prev_out,
+    prev_lse) merge operands and the fp32 merged output block the
+    epilogue writes (the plain kernel's output is input-dtype)."""
+    ok, est = flash_check(blocks, dims, es, budget)
+    bq, dp = blocks["block_q"], dims["Dp"]
+    extra = (DB * 4 * (bq * dp + bq * LANES)     # prev_out, prev_lse in
+             + DB * 4 * bq * dp                  # merged fp32 out
+             - DB * es * bq * dp)                # replaces q-dtype out
+    est = est + extra
+    return est <= budget, est
+
+
+def int8_check(blocks, dims, _es, budget):
+    """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
+    ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
+    (double-buffered), fp32 out block + scales."""
+    bn, bk = blocks["block_n"], blocks["block_k"]
+    t = 1024
+    est = (DB * (t * bk * 2 + bn * bk * 1 + bn * 4) + t * bn * 4)
+    return est <= budget, est
+
+
+# ---------------------------------------------------------------------------
+# the RDMA reduce-scatter sizing rule — previously comment-only
+# ---------------------------------------------------------------------------
+
+def rdma_slot_bytes(chunk: int, n_cols: int) -> int:
+    """The four fp32 chunk slots (2 recv + 2 send double buffers) of
+    ``ops.fused_collective._mrs_rdma_kernel``: ``16 * chunk * N``
+    bytes — the bound PR 9's review established from the measured
+    RESOURCE_EXHAUSTED at chunk=512, N=1024 on v5e."""
+    return 4 * 4 * chunk * n_cols
+
+
+def rdma_check(chunk: int, k: int, n_cols: int, es: int,
+               budget: int) -> tuple[bool, int]:
+    """Full static frame of the RDMA matmul->reduce-scatter kernel:
+    the four fp32 chunk slots beside the double-buffered x (chunk, K)
+    and w (K, N) operand blocks and the fp32 (chunk, N) output block.
+    At the v5e budget this reproduces both gate data points: (256,
+    1024, 512) bf16 fits with margin (~6 MiB), (512, 1024, 1024) does
+    not (measured RESOURCE_EXHAUSTED)."""
+    est = (rdma_slot_bytes(chunk, n_cols)
+           + DB * es * (chunk * k + k * n_cols)   # x, w operand blocks
+           + DB * 4 * chunk * n_cols)             # fp32 out block
+    return est <= budget, est
+
+
+#: the registry-facing name -> check table; ``tuning.registry`` builds
+#: its SPECS from this, and the analyzer uses it to price kernels it can
+#: match to a registered spec.
+CHECKS: dict[str, object] = {
+    "flash_attention": flash_check,
+    "fused_softmax": row_check(3),       # y, dy, dx row blocks
+    "layer_norm": row_check(5),          # x, dy, dx + dg/db acc
+    "rope": row_check(6),                # x1, x2, cos, sin, o1, o2
+    "xentropy": row_check(2),            # x in, dx out (stats are
+                                         # (br, 1) noise)
+    "bias_dropout_add": row_check(4),    # x, residual, out (+ dy/dx in
+                                         # bwd); mask is PRNG-recomputed,
+                                         # never stored
+    "linear_xent": linear_xent_check,
+    "fused_collective_matmul": cm_check,
+    "fused_ag_flash": agf_check,
+    "int8_matmul": int8_check,
+}
+
+
+def static_frame_bytes(block_bytes: Mapping[str, int] | None = None, *,
+                       operand_bytes: int = 0,
+                       scratch_bytes: int = 0) -> int:
+    """Generic lower-bound frame for a ``pallas_call`` the analyzer can
+    price without a registered spec: double-buffered blocked operands
+    plus (single-buffered) scratch. A LOWER bound by construction —
+    anything unpriceable contributes zero — so exceeding the budget is
+    proof, not heuristic."""
+    return DB * operand_bytes + scratch_bytes

@@ -131,6 +131,15 @@ SURFACE = {
         "force_virtual_cpu_devices", "enable_persistent_compilation_cache",
         "honor_jax_platforms_env", "distributed_mesh", "standalone_gpt",
         "standalone_bert"],
+    "apex1_tpu.lint": [
+        "lint_paths", "lint_files", "lint_sources", "LintResult",
+        "RULES", "RULE_SLUGS"],
+    "apex1_tpu.lint.kernels": [
+        "check_kernels", "KERNEL_RULES", "KernelRule"],
+    "apex1_tpu.vmem_model": [
+        "CHECKS", "budget_bytes", "flash_check", "row_check",
+        "linear_xent_check", "cm_check", "agf_check", "int8_check",
+        "rdma_check", "rdma_slot_bytes", "static_frame_bytes"],
 }
 
 

@@ -732,7 +732,7 @@ class TestCli:
         path = os.path.join(REPO, "perf_results", "lint_baseline.json")
         assert os.path.exists(path), \
             "perf_results/lint_baseline.json missing (bank it with " \
-            "`python tools/lint.py --json > " \
+            "`python tools/lint.py --kernels --json > " \
             "perf_results/lint_baseline.json`)"
         doc = json.load(open(path))
         assert doc["ok"] is True

@@ -546,10 +546,20 @@ def main():
             # per-shard (S=1024, K=1024, N=512): chunk 256 -> frame =
             # 2 send + 2 recv fp32 slots (2 MiB) + double-buffered
             # x/w/out blocks ~ 6 MiB, inside the v5e budget. The
-            # kernel's VMEM rule (established BY this gate): the four
-            # fp32 chunk slots cost 16*chunk*N bytes — chunk*N above
-            # ~0.5M elements OOMs v5e alongside the operand blocks
-            # (chunk=512, K=1024, N=1024 measured RESOURCE_EXHAUSTED).
+            # kernel's VMEM rule (established BY this gate, now CODE in
+            # apex1_tpu.vmem_model.rdma_check — shared with
+            # tuning.registry's gating and graftlint's APX208 pass):
+            # chunk=512, K=1024, N=1024 measured RESOURCE_EXHAUSTED.
+            from apex1_tpu.vmem_model import budget_bytes, rdma_check
+            fits, est = rdma_check(
+                256, 1024, 512, 2,
+                budget_bytes(_gen_from_topology(args.topology)))
+            over, _ = rdma_check(512, 1024, 1024, 2,
+                                 budget_bytes("v5e"))
+            assert fits and not over, (
+                "vmem_model.rdma_check disagrees with the gate's "
+                "established data points — the shared sizing model "
+                f"drifted (fits={fits} est={est} over={over})")
             arrs = [jax.ShapeDtypeStruct((1024, 1024 * n), jnp.bfloat16,
                                          sharding=ns3(P(None, "tp"))),
                     jax.ShapeDtypeStruct((1024 * n, 512), jnp.bfloat16,

@@ -16,8 +16,10 @@
 # see the pytest.ini note).
 set -e
 cd "$(dirname "$0")/.."
-echo "== graftlint (static JAX-hazard gate; docs/lint.md) =="
-python tools/lint.py
+echo "== graftlint kernels (APX1xx + APX2xx: JAX hazards, Pallas semaphore/DMA protocol model-check n=1..6, mesh/axis consistency, shared-VMEM budgets; jax-free; docs/lint.md) =="
+# --kernels is a strict superset of the plain run (all APX1xx rules +
+# the kernel analyzer), so ONE step gates both families
+python tools/lint.py --kernels
 echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
 python tools/tune_kernels.py --validate
 echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="

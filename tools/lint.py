@@ -4,6 +4,7 @@
 Usage::
 
     python tools/lint.py                  # lint apex1_tpu/ tools/ examples/
+    python tools/lint.py --kernels        # + APX2xx kernel/collective pass
     python tools/lint.py --json           # machine-readable (baseline bank)
     python tools/lint.py --changed        # only files in git diff (pre-commit)
     python tools/lint.py path/to/file.py  # explicit targets
@@ -34,14 +35,22 @@ def _import_lint():
     ``__init__`` (which imports jax to install the compat bridge —
     ~4s of startup the stdlib-ast linter doesn't need). A stub parent
     module with the real ``__path__`` lets the import machinery find
-    the subpackage while skipping the parent's body. CLI-process-only:
-    the lint subpackage imports nothing else from apex1_tpu, and
+    the subpackage while skipping the parent's body. ``apex1_tpu.core``
+    gets the same stub so the ``--kernels`` budget pass can read
+    ``core.capability``'s generation table (itself jax-free; only
+    chip *detection* touches jax, and the analyzer passes the planning
+    generation explicitly) without running ``core/__init__``'s mesh
+    imports. CLI-process-only: the lint subpackage and
+    ``apex1_tpu.vmem_model`` import nothing else from apex1_tpu, and
     in-process users (tests, check_all's pytest) import the real
-    package normally."""
-    if "apex1_tpu" not in sys.modules:
-        stub = types.ModuleType("apex1_tpu")
-        stub.__path__ = [os.path.join(REPO, "apex1_tpu")]
-        sys.modules["apex1_tpu"] = stub
+    package normally. tests/test_lint_kernels.py pins the whole CLI
+    jax-free by running it against a poisoned ``jax`` module."""
+    for name, sub in (("apex1_tpu", ""), ("apex1_tpu.core", "core")):
+        if name not in sys.modules:
+            stub = types.ModuleType(name)
+            stub.__path__ = [os.path.join(REPO, "apex1_tpu", sub)
+                             if sub else os.path.join(REPO, "apex1_tpu")]
+            sys.modules[name] = stub
     import apex1_tpu.lint as lint
     return lint
 
@@ -87,6 +96,10 @@ def main(argv=None):
     ap.add_argument("--changed", action="store_true",
                     help="lint only files changed vs HEAD (plus "
                          "untracked) under the default roots")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the APX2xx kernel/collective "
+                         "analyzer (Pallas semaphore/DMA protocol "
+                         "model-check, mesh consistency, VMEM budget)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings (text mode)")
@@ -95,7 +108,8 @@ def main(argv=None):
     lint = _import_lint()
 
     if args.list_rules:
-        for r in lint.RULES:
+        from apex1_tpu.lint.kernels import KERNEL_RULES
+        for r in list(lint.RULES) + list(KERNEL_RULES):
             print(f"{r.code}  {r.slug:16s} {r.summary}")
         return 0
 
@@ -112,7 +126,7 @@ def main(argv=None):
                                   "n_files": 0, "findings": []}))
             return 0
         res = lint.lint_files([os.path.join(REPO, f) for f in files],
-                              root=REPO)
+                              root=REPO, kernels=args.kernels)
     else:
         # fail CLOSED on bad targets: a typoed path in a CI job must
         # not read as a passing gate forever
@@ -121,7 +135,8 @@ def main(argv=None):
             if not os.path.exists(full):
                 print(f"graftlint: no such path: {p}", file=sys.stderr)
                 return 2
-        res = lint.lint_paths(args.paths or DEFAULT_ROOTS, root=REPO)
+        res = lint.lint_paths(args.paths or DEFAULT_ROOTS, root=REPO,
+                              kernels=args.kernels)
         if args.paths and res.n_files == 0:
             print("graftlint: the given paths contain no .py files",
                   file=sys.stderr)

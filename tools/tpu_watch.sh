@@ -195,8 +195,20 @@ run ring_overlap_ab 1800 python tools/bench_ring_ab.py
 # boundary MLP + fused-merge ring attention + the RDMA kernel's first
 # execution/parity datum — AHEAD of the llama_longctx re-bench so the
 # 16k number rides whichever form wins (needs >= 2 devices; emits a
-# skip record on a single-chip window)
-run fused_comm_ab   1800 python tools/bench_fused_comm.py --rdma
+# skip record on a single-chip window).
+# GATE: the RDMA kernel's numerics are UNVERIFIED until this entry
+# runs, and its semaphore/DMA protocol is proved only by graftlint's
+# APX2xx model checker (docs/lint.md) — a red APX2xx run means the
+# kernel would be first-executed with a known protocol defect, so the
+# A/B must NOT dispatch. apx2_gate runs immediately before; its rc
+# gates the --rdma entry (a lint failure burns ~10s, not the window).
+run apx2_gate        120 python tools/lint.py --kernels
+if [ -f "$RES/apx2_gate.log" ] && grep -q " 0 findings" "$RES/apx2_gate.log"; then
+  run fused_comm_ab   1800 python tools/bench_fused_comm.py --rdma
+else
+  echo "SKIP fused_comm_ab: APX2xx kernel lint not green (see apx2_gate.log)" \
+    | tee -a "$RES/status.log"
+fi
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
 # dropout=0.1 bert variant FIRST (PR5: attention-probability dropout now
 # rides the flash kernel + fused dropout-add-LN epilogues — this is the
