@@ -80,6 +80,10 @@ LOG_TO_CONFIG = {
     "bench_gpt2.log": "gpt2",
     "bench_gpt2_b24.log": "gpt2",
     "bench_gpt2_fp16.log": "gpt2_fp16",
+    # the planner-driven 3D config: joins no single-chip prediction
+    # row (the planner prices it), so records land in `excluded` with
+    # that reason rather than a bogus factor
+    "bench_llama3d.log": "llama_3d",
     "bench_llama_blk.log": "llama_block",
     "bench_llama16k.log": "llama_longctx",
     "bench_resnet.log": "resnet",

@@ -1,0 +1,158 @@
+"""Analytic per-device HBM model — the planner's jax-free pre-filter.
+
+Two memory checks gate a candidate layout, at very different prices:
+
+1. THIS module: a closed-form byte count over the layout's shards —
+   microseconds per candidate, runs with no jax, prunes the search
+   space before anything compiles. Like ``vmem_model`` it is a GATING
+   model: coarse, monotone in the degrees, calibrated against the AOT
+   history the repo has banked (the llama_longctx sizing episode:
+   aot_check measured the 22-layer variant at 18.7 GiB on a 15.75 GiB
+   v5e and the shipped 16-layer at ~14.4 GiB — this model prices them
+   at ~18.3 and ~14.1, same verdicts; pinned in tests/test_planner.py).
+2. :func:`aot_memory_analysis`: XLA's real AOT memory analysis of the
+   lowered ``models.llama_3d.build_step`` executable through the
+   compile-only topology client — the on-device truth, minutes per
+   config, run for the WINNER only (`tools/aot_check.py`'s planner
+   gate), never inside the search loop.
+
+Accounting (fp32-master training, the repo's O2 recipe — fused Adam on
+fp32 masters, bf16 compute):
+
+- weights: 4 B/param on the device's shard (layer dense matmuls /tp,
+  experts /ep, stack /pp; norms+router replicated over tp; emb/head
+  /tp, pp-replicated on the embedding group);
+- grads: 4 B/param, same shards;
+- optimizer: 8 B/param (two Adam moments) — divided by dp when the
+  layout's ``zero`` flag shards the update
+  (`parallel.distributed_optimizer.shard_opt_state_specs`);
+- activations: the remat/scan pipeline keeps (a) the microbatch
+  boundary stack — M x (S/(cp*tp)) x mb x E, held in fp32 through the
+  backward — and (b) one layer's recompute working set at the GATHERED
+  sequence width (S/cp), bf16;
+- data: the (M, S/cp, mb) int32 token + label shards.
+
+A 256 MiB system reserve is subtracted from the capability row's
+``hbm_bytes`` (16 GiB v5e advertises ~15.75 usable — the figure the
+banked aot logs report).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex1_tpu.planner.layouts import Layout, ModelShape
+
+#: bytes held back from the spec-sheet HBM figure (runtime + framework
+#: reserve — v5e's 16 GiB advertises ~15.75 usable in the AOT logs).
+#: This is the ONLY margin the pre-filter applies: the analytic count
+#: is compared straight against the usable budget, and the AOT gate
+#: (aot_memory_analysis via tools/aot_check.py) is what protects the
+#: winner from the model's coarseness — not a fudge factor here.
+HBM_RESERVE_BYTES = 256 * 2**20
+
+
+def budget_bytes(generation: Optional[str] = None) -> int:
+    """Usable per-chip HBM for planning at a capability row."""
+    from apex1_tpu.core.capability import get_capability
+
+    cap = get_capability(generation or "v5e")
+    return cap.hbm_bytes - HBM_RESERVE_BYTES
+
+
+def param_counts(shape: ModelShape) -> dict:
+    """Global parameter counts by sharding class."""
+    E, F = shape.hidden_size, shape.ffn_size
+    HD = shape.num_heads * shape.head_dim
+    KD = shape.num_kv_heads * shape.head_dim
+    attn = E * HD * 2 + E * KD * 2          # wq + wo, wk + wv
+    if shape.moe:
+        dense_mlp = 0
+        router = E * shape.num_experts
+        experts = shape.num_experts * 2 * E * F   # w_moe1 + w_moe2
+    else:
+        dense_mlp = 3 * E * F               # gate, up, down
+        router = 0
+        experts = 0
+    norms = 2 * E
+    shared = 2 * shape.vocab_size * E + E   # emb, head, final_norm
+    return dict(
+        layer_tp_sharded=attn + dense_mlp,  # col/row shards over tp
+        layer_replicated=norms + router,    # tp-replicated
+        layer_ep_sharded=experts,           # expert stacks over ep
+        shared_tp_sharded=2 * shape.vocab_size * E,
+        shared_replicated=E,
+        total=(shape.num_layers
+               * (attn + dense_mlp + norms + router + experts)
+               + shared))
+
+
+def params_per_device(shape: ModelShape, layout: Layout) -> float:
+    c = param_counts(shape)
+    per_layer = (c["layer_tp_sharded"] / layout.tp
+                 + c["layer_replicated"]
+                 + c["layer_ep_sharded"] / layout.ep)
+    return (shape.num_layers / layout.pp * per_layer
+            + c["shared_tp_sharded"] / layout.tp
+            + c["shared_replicated"])
+
+
+def hbm_breakdown(shape: ModelShape, layout: Layout,
+                  generation: Optional[str] = None) -> dict:
+    """Per-device HBM bytes by component, plus the budget verdict."""
+    p_dev = params_per_device(shape, layout)
+    weights = 4.0 * p_dev
+    grads = 4.0 * p_dev
+    opt = 8.0 * p_dev / (layout.dp if layout.zero else 1)
+
+    S_sp = shape.seq_len // (layout.cp * layout.tp)   # SP-region rows
+    S_cp = shape.seq_len // layout.cp                 # gathered rows
+    mb = layout.microbatch_size
+    M = layout.num_microbatches
+    E, F = shape.hidden_size, shape.ffn_size
+    F_eff = F * (shape.moe_top_k if shape.moe else 1)
+    Hl = max(1, shape.num_heads // layout.tp)
+    # boundary stack (fp32 through the backward) + one layer's
+    # recompute working set at the gathered width: residual in/out +
+    # qkv/attn io + mlp hidden
+    acts = (M * S_sp * mb * E * 4.0
+            + S_cp * mb * (4 * E + 2 * F_eff
+                           + 4 * Hl * shape.head_dim) * 2.0)
+    data = 2.0 * M * S_cp * mb * 4.0                  # tokens + labels
+    total = weights + grads + opt + acts + data
+    budget = budget_bytes(generation)
+    return dict(weights=weights, grads=grads, opt=opt, acts=acts,
+                data=data, total=total, budget=float(budget),
+                fits=total <= budget)
+
+
+def fit_check(shape: ModelShape, layout: Layout,
+              generation: Optional[str] = None) -> Optional[str]:
+    """None when the layout fits the per-chip budget; otherwise the
+    rejection message WITH the sizing stated (the contract the tests
+    pin — an over-budget config must say by how much and why)."""
+    b = hbm_breakdown(shape, layout, generation)
+    if b["fits"]:
+        return None
+    gib = 2.0 ** 30
+    return (f"hbm-fit: needs {b['total'] / gib:.2f} GiB/chip > "
+            f"{b['budget'] / gib:.2f} GiB usable "
+            f"({generation or 'v5e'}) — weights "
+            f"{b['weights'] / gib:.2f} + grads {b['grads'] / gib:.2f} "
+            f"+ opt {b['opt'] / gib:.2f} + acts {b['acts'] / gib:.2f} "
+            f"+ data {b['data'] / gib:.2f} GiB at layout "
+            f"{layout.mesh_str()}")
+
+
+def aot_memory_analysis(cfg, mesh):
+    """The on-device truth this module approximates: lower the full 3D
+    train step (``models.llama_3d.build_step`` + ``abstract_state``)
+    for an AOT topology mesh and return XLA's memory analysis
+    (``temp_size_in_bytes`` / ``argument_size_in_bytes``). Requires
+    jax + the compile-only topology client — `tools/aot_check.py`'s
+    planner gate is the caller; the search loop never is."""
+    from apex1_tpu.models.llama_3d import abstract_state, build_step
+
+    step, _, _, _ = build_step(cfg, mesh)
+    state, data = abstract_state(cfg, mesh)
+    return step.lower(state, data, data).compile().memory_analysis()

@@ -1,0 +1,132 @@
+"""The search loop: enumerate -> pre-filter -> price -> pick -> emit.
+
+`make_plan` is the planner's one front door (everything else is its
+machinery): given a ModelShape and a chip count it returns the plan
+document for the cheapest CALIBRATED layout, deterministically — the
+enumeration order is fixed (`layouts.enumerate_layouts`), prices are
+pure functions of (inputs, banked calibration.json), and ties break on
+`Layout.sort_key`. Same inputs, byte-identical `emit.plan_json`.
+
+Failure is loud and sized: no legal layout raises :class:`PlanError`
+naming the violated rules of the nearest miss; every-layout-over-HBM
+raises with the SMALLEST over-budget sizing message (so the error
+tells you how far from fitting the model is, not just "no").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex1_tpu.planner import cost, emit, memory
+from apex1_tpu.planner.layouts import (Layout, ModelShape, check_layout,
+                                       enumerate_layouts)
+
+
+class PlanError(RuntimeError):
+    """No plan exists for the request — message carries the why."""
+
+
+def search_layouts(shape: ModelShape, n_devices: int, *,
+                   generation: Optional[str] = None,
+                   results_dir: Optional[str] = None,
+                   use_calibration: bool = True,
+                   **enum_kw) -> dict:
+    """Full ranked search. Returns ``{"ranked": [(price, layout)...],
+    "n_enumerated": int, "hbm_rejected": [msg...]}`` with ``ranked``
+    sorted cheapest-calibrated-first."""
+    gen = generation or "v5e"
+    legal = list(enumerate_layouts(shape, n_devices, **enum_kw))
+    if not legal:
+        # name WHY: re-check the all-ones layout (and the requested
+        # product) so the error carries rules, not a shrug
+        probe = Layout(dp=n_devices,
+                       num_microbatches=max(1, shape.global_batch
+                                            // max(1, n_devices)))
+        why = "; ".join(str(v) for v in
+                        check_layout(shape, probe, n_devices)) \
+            or "no axis factorization satisfies the legality rules"
+        raise PlanError(
+            f"no legal (dp,pp,cp,ep,tp) layout for "
+            f"{shape.name} on {n_devices} device(s): {why}")
+    fitting, rejected = [], []
+    for lay in legal:
+        msg = memory.fit_check(shape, lay, gen)
+        if msg is None:
+            fitting.append(lay)
+        else:
+            rejected.append(msg)
+    if not fitting:
+        # the closest miss (smallest total) is the actionable sizing
+        closest = min(
+            legal, key=lambda l: memory.hbm_breakdown(shape, l,
+                                                      gen)["total"])
+        raise PlanError(
+            f"every legal layout for {shape.name} on {n_devices} "
+            f"device(s) is over the HBM budget; closest: "
+            f"{memory.fit_check(shape, closest, gen)}")
+    # load the banked calibration ONCE per search: the step factor is
+    # a property of the shape, the fused-kernel factor of
+    # (tp>1, fused) — both constant across candidates; re-reading
+    # calibration.json per layout would be 2N file parses for nothing
+    cal = (cost.calibration_factor(shape, results_dir)
+           if use_calibration else None)
+    kf_fused = cost._sp_kernel_factor(
+        Layout(tp=2, sp_mode="fused", num_microbatches=1),
+        results_dir)
+    # the non-fused fallback comes from the SAME function (tp=1 takes
+    # the analytic branch) so both pricing paths report identical
+    # provenance for the identical situation
+    kf_none = cost._sp_kernel_factor(Layout(num_microbatches=1),
+                                     results_dir)
+    priced = [(cost.price_layout(
+        shape, lay, generation=gen, results_dir=results_dir,
+        use_calibration=use_calibration, calibration=cal,
+        sp_kernel=(kf_fused if (lay.tp > 1 and lay.sp_mode == "fused")
+                   else kf_none)), lay)
+              for lay in fitting]
+    priced.sort(key=lambda pl: (pl[0]["calibrated_step_ms"],
+                                pl[1].sort_key()))
+    return {"ranked": priced, "n_enumerated": len(legal),
+            "hbm_rejected": rejected}
+
+
+def make_plan(shape: ModelShape, n_devices: int, *,
+              generation: Optional[str] = None,
+              results_dir: Optional[str] = None,
+              use_calibration: bool = True,
+              top_k: int = 5, **enum_kw) -> dict:
+    """Search and emit the winning plan document (`emit.build_plan`).
+    ``enum_kw`` forwards to `layouts.enumerate_layouts` (allow_cp /
+    allow_ep / allow_zero / sp_modes / microbatch_size)."""
+    gen = generation or "v5e"
+    res = search_layouts(shape, n_devices, generation=gen,
+                         results_dir=results_dir,
+                         use_calibration=use_calibration, **enum_kw)
+    price, lay = res["ranked"][0]
+    mem = memory.hbm_breakdown(shape, lay, gen)
+    ranked_top = [
+        {"mesh": l.mesh_str(),
+         "calibrated_step_ms": round(p["calibrated_step_ms"], 4),
+         "step_ms": round(p["step_ms"], 4)}
+        for p, l in res["ranked"][:top_k]]
+    provenance = _calibration_provenance(results_dir)
+    return emit.build_plan(
+        shape, lay, price, mem, generation=gen,
+        search={"n_enumerated": res["n_enumerated"],
+                "n_hbm_rejected": len(res["hbm_rejected"]),
+                "ranked_top": ranked_top},
+        provenance=provenance)
+
+
+def _calibration_provenance(results_dir: Optional[str] = None) -> dict:
+    """Identity of the calibration table the prices rode on — banked
+    fields only (deterministic for a given file; no clock reads)."""
+    from apex1_tpu.obs.calibrate import CAL_NAME, load_calibration
+
+    doc = load_calibration(results_dir)
+    if doc is None:
+        return {"calibration_table": None}
+    return {"calibration_table": CAL_NAME,
+            "calibration_generated_unix": doc.get("generated_unix"),
+            "calibration_n_pairs": doc.get("n_pairs"),
+            "calibration_prediction_table": doc.get("prediction_table")}

@@ -463,6 +463,89 @@ def bench_decode(on_accel, quant=False):
             proxy)
 
 
+def bench_llama_3d(on_accel, plan=None):
+    """The planner-driven 3D config: layout chosen by
+    `apex1_tpu.planner` for THIS process's device count (or replayed
+    from a banked plan via --plan), then the full
+    `models.llama_3d.make_train_step` composition driven end-to-end
+    from the emitted spec. On one CPU device the planner degenerates
+    to the all-ones layout — the smoke proves the plan->mesh->specs->
+    step path, the multi-chip number is the hardware queue's
+    (`planner_ab`)."""
+    import dataclasses
+
+    from apex1_tpu import planner
+    from apex1_tpu.core.policy import get_policy
+    from apex1_tpu.models.llama import LlamaConfig
+    from apex1_tpu.models.llama_3d import make_train_step
+
+    n = jax.device_count()
+    if on_accel:
+        # the llama_longctx-class 0.8B at trainable depth; global
+        # batch sized so every dp split up to n stays feasible
+        mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048,
+                           num_layers=8, num_heads=32, num_kv_heads=4,
+                           hidden_size=2048, ffn_size=5632, remat=True,
+                           policy=get_policy("O2"))
+        global_batch, iters = 4 * n, 6
+    else:
+        mcfg = dataclasses.replace(
+            LlamaConfig.tiny(policy=get_policy("O2")), max_seq_len=128,
+            remat=True)
+        global_batch, iters = 4 * n, 2
+    shape = planner.ModelShape.from_llama(mcfg, name="llama_3d",
+                                          global_batch=global_batch)
+    gen = None
+    if on_accel:
+        from apex1_tpu.core.capability import get_capability
+        gen = get_capability().generation
+    if plan is None:
+        plan = planner.make_plan(shape, n, generation=gen,
+                                 allow_zero=False)
+    else:
+        plan = planner.load_plan(plan)
+        # a replayed plan must price THIS model and cover THIS mesh —
+        # and the record's tokens/step must follow the PLAN's
+        # schedule, not the live-derived default batch
+        mismatch = planner.check_plan_model(plan, shape)
+        if plan["n_devices"] != n:
+            mismatch.append(f"n_devices: plan={plan['n_devices']} "
+                            f"live={n}")
+        if mismatch:
+            raise ValueError(
+                "--plan was searched for a different model/mesh than "
+                "this bench builds: " + "; ".join(mismatch))
+        shape = dataclasses.replace(
+            shape, global_batch=plan["model"]["global_batch"])
+    m = plan["mesh"]
+    print(f"planner pick: dp={m['dp']} pp={m['pp']} cp={m['cp']} "
+          f"ep={m['ep']} tp={m['tp']} "
+          f"M={plan['schedule']['num_microbatches']} — "
+          f"{plan['predicted']['calibrated_step_ms']:.2f} ms/step "
+          f"calibrated", flush=True)
+    cfg = planner.llama3d_config_from_plan(plan, mcfg)
+    step, state, _ = make_train_step(cfg)
+    rng = np.random.default_rng(0)
+    dshape = (cfg.num_microbatches, mcfg.max_seq_len,
+              cfg.microbatch_size * cfg.dp * cfg.ep)
+    tokens = jnp.asarray(rng.integers(0, mcfg.vocab_size, dshape),
+                         jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def loss_step(state, tokens, labels):
+        state, loss = step(state, tokens, labels)
+        return state, {"loss": loss}
+
+    tokens_per_step = shape.tokens_per_step
+    return (state, loss_step, (tokens, labels), tokens_per_step // n,
+            iters,
+            f"tokens/sec/chip Llama-3D(planned x{n}) amp-O2 remat",
+            "tokens/sec/chip",
+            11_100.0)   # vs the pinned llama_longctx A100 row: the
+    #                     nearest hand-tuned comparator until the
+    #                     planner A/B banks its own
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
     "gpt2_fp16": functools.partial(bench_gpt2, fp16=True),
@@ -472,10 +555,17 @@ BENCHES = {
     "resnet": bench_resnet,
     "llama_longctx": bench_llama_longctx,
     "llama_block": bench_llama_block,
+    "llama_3d": bench_llama_3d,
     "t5": bench_t5,
     "decode": bench_decode,
     "decode_int8": functools.partial(bench_decode, quant=True),
 }
+
+#: configs whose mesh comes from the planner + the LIVE device count:
+#: excluded from tools/predict_perf.py's single-chip AOT table (the
+#: planner's own cost engine prices them) so the banked
+#: predicted_*.json rows stay byte-stable
+PLANNED_BENCHES = {"llama_3d"}
 
 
 def _emit(record, out_path=None):
@@ -516,6 +606,7 @@ _BANKED_LOGS = {
     "decode_int8": ["bench_dec_int8.log"],
     "gpt2": ["bench_gpt2.log", "bench_gpt2_b24.log"],
     "gpt2_fp16": ["bench_gpt2_fp16.log"],
+    "llama_3d": ["bench_llama3d.log"],
     "llama_block": ["bench_llama_blk.log"],
     "llama_longctx": ["bench_llama16k.log"],
     "resnet": ["bench_resnet.log"],
@@ -701,6 +792,10 @@ def main():
                     help="override batch size (gpt2 config only)")
     ap.add_argument("--seq", type=int, default=None,
                     help="override sequence length (gpt2 config only)")
+    ap.add_argument("--plan", default=None,
+                    help="banked plan.json for --config llama_3d "
+                    "(default: the planner searches the live device "
+                    "count)")
     ap.add_argument("--timeout", type=float, default=1500.0,
                     help="watchdog for build+compile+measure (seconds)")
     ap.add_argument("--probe-timeout", type=float, default=180.0)
@@ -788,6 +883,8 @@ def main():
                 kw = {}
                 if args.config in ("gpt2", "gpt2_fp16"):
                     kw = dict(batch=b, seq=args.seq)
+                elif args.config == "llama_3d":
+                    kw = dict(plan=args.plan)
                 (state, step, batch, units_per_step, iters, metric, unit,
                  proxy) = BENCHES[args.config](on_accel, **kw)
                 resumed_from = None

@@ -8,7 +8,23 @@ fused Adam on fp32 masters. Defaults run a tiny model on the virtual
 CPU mesh; the same code compiles for a v5p-32 class topology at 8B
 (`tools/aot_check.py --flagship`).
 
+Two ways to pick the parallel layout:
+
+- by hand: ``--dp 2 --pp 2 --tp 2`` etc. — every axis flag is
+  validated against `apex1_tpu.planner.check_layout` BEFORE anything
+  compiles, and an illegal combination exits loudly NAMING the broken
+  rule (tp not dividing heads, pp exceeding layers, ...) instead of
+  failing deep inside `shard_map`;
+- by search: ``--plan auto`` hands the same model to the
+  auto-parallel planner (`apex1_tpu.planner`), which enumerates the
+  legal layouts for ``--devices`` chips, prices them with the
+  calibrated cost model, and drives this loop from the winning plan —
+  whose partition rules are verified against the model's own specs
+  before training starts. ``--plan <path>`` replays a banked plan
+  document instead of searching.
+
 ``python examples/llama_3d.py [--dp 2 --pp 2 --tp 2] [--chunks 2]``
+``python examples/llama_3d.py --plan auto [--devices 8]``
 """
 
 import argparse
@@ -23,7 +39,43 @@ _root = (os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
          if "__file__" in globals() else os.getcwd())
 sys.path.insert(0, _root)
 
+from apex1_tpu import planner  # noqa: E402
 from apex1_tpu.testing import force_virtual_cpu_devices  # noqa: E402
+
+
+def _model_shape(args) -> planner.ModelShape:
+    """The planner's view of the tiny example model — dims mirror the
+    LlamaConfig.tiny(...) construction below (heads/kv fixed 4/2)."""
+    return planner.ModelShape(
+        name="llama3d-example", num_layers=args.layers,
+        hidden_size=args.hidden, ffn_size=2 * args.hidden,
+        num_heads=4, num_kv_heads=2, head_dim=args.hidden // 4,
+        vocab_size=args.vocab, seq_len=args.seq,
+        global_batch=args.microbatches * args.dp * args.ep,
+        num_experts=4 if args.moe else 0, moe_top_k=2)
+
+
+def _validate_hand_layout(args) -> None:
+    """The satellite fix: the hand axis flags used to be checked only
+    as a device product; every other rule surfaced as a shard_map or
+    Llama3DConfig traceback. Now the planner's legality predicate
+    rejects them up front, one named rule per line, exit 2."""
+    layout = planner.Layout(
+        dp=args.dp, pp=args.pp, cp=args.cp, ep=args.ep, tp=args.tp,
+        num_microbatches=args.microbatches, microbatch_size=1,
+        num_chunks=args.chunks, schedule=args.schedule)
+    violations = planner.check_layout(_model_shape(args), layout)
+    if violations:
+        print("ILLEGAL LAYOUT — rejected by apex1_tpu.planner."
+              "check_layout before compiling anything:",
+              file=sys.stderr, flush=True)
+        for v in violations:
+            print(f"  [{v.rule}] {v.message}", file=sys.stderr,
+                  flush=True)
+        print("(see docs/planner.md for the rule catalogue; "
+              "`--plan auto` searches only legal layouts)",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
 
 
 def main():
@@ -49,10 +101,63 @@ def main():
                     help="pipeline schedule: scan (remat) or the true "
                          "staggered-fwd/bwd 1F1B (interleaved with "
                          "--chunks > 1)")
+    ap.add_argument("--plan", default=None, metavar="auto|PATH",
+                    help="'auto': search dp x pp x cp x ep x tp with "
+                         "the calibrated planner instead of the axis "
+                         "flags; PATH: replay a banked plan.json")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="chip count for --plan auto (default: the "
+                         "product of the axis flags)")
     args = ap.parse_args()
     if args.ep > 1:
         args.moe = True
-    n = args.dp * args.pp * args.tp * args.ep * args.cp
+
+    plan = None
+    if args.plan:
+        n = args.devices or (args.dp * args.pp * args.tp * args.ep
+                             * args.cp)
+        if args.plan == "auto":
+            # zero stays off: the example's step shards optimizer
+            # state like params (GSPMD); the dp-axis ZeRO split is
+            # priced for 8B-scale plans, not exercised by this loop
+            plan = planner.make_plan(_model_shape(args), n,
+                                     allow_zero=False)
+        else:
+            plan = planner.load_plan(args.plan)
+            n = plan["n_devices"]
+            # a replayed plan must price THIS model: the schedule and
+            # partition rules are only valid for the dims it priced
+            mismatch = planner.check_plan_model(plan,
+                                                _model_shape(args))
+            if mismatch:
+                raise SystemExit(
+                    "plan/model mismatch — this plan was searched for "
+                    "a different model than the flags describe:\n  "
+                    + "\n  ".join(mismatch))
+        m, sch = plan["mesh"], plan["schedule"]
+        args.dp, args.pp, args.tp = m["dp"], m["pp"], m["tp"]
+        args.cp, args.ep = m["cp"], m["ep"]
+        args.microbatches = sch["num_microbatches"]
+        args.chunks = sch["num_chunks"]
+        args.schedule = sch["kind"]
+        args.moe = args.moe or bool(plan["model"].get("num_experts"))
+        pr = plan["predicted"]
+        print(f"plan: mesh dp={m['dp']} pp={m['pp']} cp={m['cp']} "
+              f"ep={m['ep']} tp={m['tp']} M={sch['num_microbatches']} "
+              f"sp={plan['kernel_flags']['sp_boundary']} — "
+              f"{pr['calibrated_step_ms']:.3f} ms/step calibrated "
+              f"[{pr['calibration']['source']}], "
+              f"{plan['search']['n_enumerated']} layouts searched, "
+              f"{plan['search']['n_hbm_rejected']} over HBM",
+              flush=True)
+        if plan["zero"]["enabled"]:
+            print("note: plan prices ZeRO optimizer sharding; this "
+                  "example runs the GSPMD param-sharded default "
+                  "(consumer: parallel.distributed_optimizer)",
+                  flush=True)
+    else:
+        _validate_hand_layout(args)
+        n = args.dp * args.pp * args.tp * args.ep * args.cp
     force_virtual_cpu_devices(max(n, 2))
 
     import jax
@@ -61,7 +166,10 @@ def main():
 
     from apex1_tpu.core.policy import get_policy
     from apex1_tpu.models.llama import LlamaConfig
-    from apex1_tpu.models.llama_3d import Llama3DConfig, make_train_step
+    from apex1_tpu.models.llama_3d import (Llama3DConfig,
+                                           chunk_param_specs,
+                                           make_train_step,
+                                           shared_param_specs)
 
     moe_kw = (dict(moe_every=1, num_experts=4, moe_top_k=2,
                    moe_capacity_factor=2.0) if args.moe else {})
@@ -70,18 +178,41 @@ def main():
         vocab_size=args.vocab, num_heads=4, num_kv_heads=2,
         hidden_size=args.hidden, ffn_size=2 * args.hidden,
         policy=get_policy("O2"), **moe_kw)
-    cfg = Llama3DConfig(model=mcfg, dp=args.dp, pp=args.pp, tp=args.tp,
-                        cp=args.cp, ep=args.ep, moe=args.moe,
-                        num_chunks=args.chunks,
-                        num_microbatches=args.microbatches,
-                        microbatch_size=1, learning_rate=3e-3,
-                        schedule=args.schedule)
+    if plan is not None:
+        # ignore_zero: the note above told the user this loop runs the
+        # unsharded optimizer; at tiny example scale that always fits
+        cfg = planner.llama3d_config_from_plan(plan, mcfg,
+                                               learning_rate=3e-3,
+                                               ignore_zero=True)
+    else:
+        cfg = Llama3DConfig(model=mcfg, dp=args.dp, pp=args.pp,
+                            tp=args.tp, cp=args.cp, ep=args.ep,
+                            moe=args.moe, num_chunks=args.chunks,
+                            num_microbatches=args.microbatches,
+                            microbatch_size=1, learning_rate=3e-3,
+                            schedule=args.schedule)
     step, state, _ = make_train_step(cfg)
+    if plan is not None:
+        # the emitted regex rules must reproduce the model's own
+        # hand-written specs leaf-for-leaf — a plan that drifts from
+        # the model is caught HERE, not as a wrong-layout slowdown
+        got = planner.plan_param_specs(plan, state["params"])
+        cspecs = chunk_param_specs(cfg)
+        want = {"chunk": {k: cspecs[k]
+                          for k in state["params"]["chunk"]},
+                "shared": shared_param_specs()}
+        if got != want:
+            raise SystemExit(
+                f"plan partition rules drifted from "
+                f"models.llama_3d specs:\n got {got}\nwant {want}")
+        print("plan verified: partition rules reproduce "
+              "models.llama_3d specs", flush=True)
     rng = np.random.default_rng(0)
-    shape = (args.microbatches, args.seq, args.dp * args.ep)
-    print(f"mesh dp={args.dp} pp={args.pp} tp={args.tp} ep={args.ep} "
-          f"cp={args.cp} "
-          f"chunks={args.chunks} moe={args.moe} ({n} devices), "
+    shape = (cfg.num_microbatches, args.seq,
+             cfg.microbatch_size * cfg.dp * cfg.ep)
+    print(f"mesh dp={cfg.dp} pp={cfg.pp} tp={cfg.tp} ep={cfg.ep} "
+          f"cp={cfg.cp} "
+          f"chunks={cfg.num_chunks} moe={cfg.moe} ({n} devices), "
           f"{args.layers}L x {args.hidden}h", flush=True)
     t0 = time.time()
     for i in range(args.steps):

@@ -140,6 +140,19 @@ SURFACE = {
         "CHECKS", "budget_bytes", "flash_check", "row_check",
         "linear_xent_check", "cm_check", "agf_check", "int8_check",
         "rdma_check", "rdma_slot_bytes", "static_frame_bytes"],
+    "apex1_tpu.perf_model": [
+        "roofline", "kernel_cases", "flash_flops_bytes",
+        "linear_xent_flops", "ring_attention_comms",
+        "sp_boundary_comms", "allreduce_bytes"],
+    "apex1_tpu.planner": [
+        "ModelShape", "Layout", "Violation", "BANKED_SHAPES",
+        "check_layout", "check_plan_model", "enumerate_layouts",
+        "fit_check",
+        "hbm_breakdown", "price_layout", "calibration_factor",
+        "make_plan", "search_layouts", "PlanError", "plan_json",
+        "save_plan", "load_plan", "partition_rules", "rules_to_specs",
+        "plan_param_specs", "llama3d_config_from_plan",
+        "layout_from_plan", "PLAN_SCHEMA"],
 }
 
 

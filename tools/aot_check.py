@@ -247,7 +247,12 @@ def main():
                                                jnp.asarray(x).dtype,
                                                sharding=s1), tree)
 
-        for cfg_name in sorted(bench_mod.BENCHES):
+        # planner-driven configs (PLANNED_BENCHES) build their mesh
+        # from the live device count — not single-device-lowerable
+        # here; the planner's own pick is AOT-gated in the flagship
+        # section below
+        for cfg_name in sorted(set(bench_mod.BENCHES)
+                               - bench_mod.PLANNED_BENCHES):
             def run(cfg_name=cfg_name):
                 state, step, batch, *_ = bench_mod.BENCHES[cfg_name](True)
                 return jax.jit(step, donate_argnums=0).lower(
@@ -751,6 +756,42 @@ def main():
             return step.lower(state, data, data)
 
         report(f"flagship 8B train step ({gen} x{fn_dev})", flagship_run)
+
+        # PLANNER GATE (ROADMAP item 1): the auto-parallel planner's
+        # OWN 8B pick for this topology, AOT-lowered so XLA's memory
+        # analysis verifies what the analytic pre-filter promised —
+        # the planner must never queue an unverified layout into a
+        # hardware window. dp/pp/tp family only: the gate guards the
+        # search's HBM arithmetic, not every axis composition (cp/ep
+        # lowering is covered by the dedicated sections above/below).
+        from apex1_tpu import planner as _planner
+
+        pshape = _planner.ModelShape.from_llama(
+            mcfg, global_batch=2 * fn_dev // max(2, tp),
+            name="llama8b")
+        pplan = _planner.make_plan(pshape, fn_dev, generation=gen,
+                                   allow_cp=False, allow_ep=False,
+                                   allow_zero=False)
+        pm = pplan["mesh"]
+        print(f"   planner pick dp={pm['dp']} pp={pm['pp']} "
+              f"tp={pm['tp']} "
+              f"M={pplan['schedule']['num_microbatches']}: analytic "
+              f"{pplan['memory']['total']:.1f} of "
+              f"{pplan['memory']['budget']:.1f} GiB/chip, "
+              f"{pplan['predicted']['calibrated_step_ms']:.1f} ms/step "
+              f"calibrated", flush=True)
+        pcfg = _planner.llama3d_config_from_plan(pplan, mcfg)
+        pmesh = mk(dp=pm["dp"], pp=pm["pp"], tp=pm["tp"],
+                   devices=list(ftopo.devices),
+                   allow_split_physical_axes=True)
+
+        def planner_run():
+            step, _, _, _ = build_step(pcfg, pmesh)
+            state, data = abstract_state(pcfg, pmesh)
+            return step.lower(state, data, data)
+
+        report(f"planner 8B pick dp{pm['dp']} pp{pm['pp']} "
+               f"tp{pm['tp']} ({gen} x{fn_dev})", planner_run)
 
         # BASELINE config 5 at scale: 8B LONG-CONTEXT — sequence 32k
         # sharded over cp (ring attention inside the same step)

@@ -28,6 +28,8 @@ echo "== serving chaos smoke (replica-kill token parity + poison quarantine, CPU
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --serve-smoke
 echo "== obs smoke (CPU trace -> per-op report -> calibration fit, non-empty) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.obs --smoke
+echo "== planner smoke (enumerate -> price -> emit -> llama_3d dryrun from the plan, CPU mesh) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.planner --smoke
 if [ "${1:-}" = "--all" ]; then
   echo "== pytest (8-device virtual CPU mesh, FULL suite) =="
   python -m pytest tests/ -q

@@ -93,27 +93,14 @@ def _cost(compiled):
 
 
 def _roofline(flops, nbytes, cap, ici_exposed_bytes=0.0):
-    """Predicted seconds + binding side for one program on one chip.
+    """Predicted (seconds, bound, mfu) — now the LIBRARY roofline
+    (`apex1_tpu.perf_model.roofline`, docstring there): the planner and
+    this CLI must price through the same arithmetic or their numbers
+    drift (the reason perf_model exists)."""
+    from apex1_tpu.perf_model import roofline
 
-    ``ici_exposed_bytes``: ICI traffic NOT hidden behind compute — it
-    ADDS to the roofline time (an overlapped transfer costs nothing
-    here; an exposed one serializes). Priced at the conservative
-    per-neighbor link rate (`core.capability.ici_link_gbps`). 0 for
-    the single-chip bench rows."""
-    from apex1_tpu.core.capability import ici_link_gbps
-
-    t_mxu = flops / (cap.bf16_tflops * 1e12)
-    t_hbm = nbytes / (cap.hbm_gbps * 1e9)
-    t = max(t_mxu, t_hbm)
-    bound = "MXU" if t_mxu >= t_hbm else "HBM"
-    if ici_exposed_bytes:
-        link = ici_link_gbps(cap.generation)
-        t_ici = ici_exposed_bytes / (link * 1e9) if link else 0.0
-        t = t + t_ici
-        if t_ici > max(t_mxu, t_hbm):
-            bound = "ICI"
-    mfu = flops / (t * cap.bf16_tflops * 1e12) if t > 0 else 0.0
-    return t, bound, mfu
+    return roofline(flops, nbytes, cap,
+                    ici_exposed_bytes=ici_exposed_bytes)
 
 
 def predict_steps(topo, configs):
@@ -196,77 +183,13 @@ def predict_steps(topo, configs):
 
 
 def _kernel_cases():
-    """ANALYTIC (flops, min HBM bytes) per Pallas kernel at its bench
-    shape — shapes mirror tools/aot_check.py's kernel gate, so each row
-    lines up with what tools/bench_kernels.py measures on silicon.
+    """The per-kernel analytic table — moved verbatim to
+    `apex1_tpu.perf_model.kernel_cases` (formula docstring there) so
+    the planner's attention/CE pricing and this CLI share one set of
+    formulas."""
+    from apex1_tpu.perf_model import kernel_cases
 
-    Formulas (all counts: multiply-add = 2 flops; bytes = each operand
-    and result crossing HBM once — the kernels are designed to touch
-    operands once, so this IS the target):
-    - flash attention fwd: 4*B*H*S^2*D matmul flops (QK^T + PV), x0.5
-      causal skip; bwd = 2.5x fwd (dV/dP/dS/dQ/dK matmuls + the
-      recomputed P the memory-efficient backward pays for). GQA K/V
-      bytes scale by Hkv/Hq.
-    - linear_xent f+b: 6*T*Hd*V (fwd logits + dX + dW); bytes 3 reads
-      of W (fwd + recompute-bwd + dW stream) + x/dx/dw.
-    - LN / RMS / softmax / rope / xentropy: bandwidth-bound, flops ~
-      a few per element (counted as 5/elem fwd, 8/elem f+b — they
-      never bind the roofline); bytes = per-pass element traffic
-      (softmax f+b: x in, y out, then y + dy in, dx out; LN f+b: 2
-      reads + 2 writes of x-sized arrays + stats).
-    - int8 GEMM: 2*M*N*K flops; bytes dominated by the int8 weight
-      (N*K) + scales + activations.
-    """
-    def flash(B, Hq, Hkv, S, D, causal=True, grad=False):
-        f = 4 * B * Hq * S * S * D * (0.5 if causal else 1.0)
-        if grad:
-            # fwd (2 matmuls) + the SHIPPED two-pass backward: dq pass
-            # recomputes p and dP then dq (3 matmuls), dkv pass
-            # recomputes them again then dk, dv (4) — 7 bwd matmuls
-            # total, NOT the fused-backward 5 an analytic count
-            # assumes (Mosaic's output-revisiting rule forces the two
-            # passes; see ops/attention.py and measured_r5.md). A
-            # perfect kernel measured against the 5-matmul roofline
-            # would read as ~0.78 and be mis-flagged as a tuning
-            # target.
-            f *= 4.5          # (2 + 7) / 2
-        qb = B * Hq * S * D * 2
-        kvb = 2 * B * Hkv * S * D * 2
-        byt = qb + kvb + qb   # q, k, v in; o out
-        if grad:
-            byt += 2 * qb + kvb + qb   # dq out, dk/dv out, do in
-        return f, byt
-
-    T, Hd, V = 16 * 1023, 768, 50432
-    lx_f = 6 * T * Hd * V
-    lx_b = 2 * (3 * V * Hd + 2 * T * Hd + V * Hd)  # W x3, x/dx, dW
-
-    def elemwise(n_elem, passes, itemsize, fpe):
-        return fpe * n_elem, passes * n_elem * itemsize
-
-    return [
-        ("flash gpt2 (16,12,1024,64) fwd", *flash(16, 12, 12, 1024, 64)),
-        ("flash gpt2 (16,12,1024,64) f+b",
-         *flash(16, 12, 12, 1024, 64, grad=True)),
-        ("flash longctx (1,32,16384,64) f+b",
-         *flash(1, 32, 32, 16384, 64, grad=True)),
-        ("flash GQA (Hq32/Hkv4,16k,64) f+b",
-         *flash(1, 32, 4, 16384, 64, grad=True)),
-        ("linear_xent gpt2 (16k,768,50k) f+b", lx_f, lx_b),
-        ("layer_norm (16384,768) f+b",
-         *elemwise(16384 * 768, 4, 2, 8)),
-        ("rms_norm (16384,2048) f+b",
-         *elemwise(16384 * 2048, 4, 2, 8)),
-        ("causal softmax (16,12,1024,1024) f+b",
-         *elemwise(16 * 12 * 1024 * 1024 // 2, 4, 4, 8)),
-        ("xentropy (16368,50432) f+b",
-         *elemwise(16368 * 50432, 3, 4, 8)),   # recompute-bwd: x, x, dx
-        ("rope llama (1,16384,32,64) f+b",
-         *elemwise(16384 * 32 * 64, 4, 2, 6)),
-        ("int8 GEMM decode (8,4096)x(32000,4096)",
-         2 * 8 * 32000 * 4096,
-         32000 * 4096 * 1 + 32000 * 4 + 2 * 8 * (4096 + 32000) * 2),
-    ]
+    return kernel_cases()
 
 
 def predict_kernels(_topo):
@@ -298,36 +221,26 @@ def predict_comms():
     n instead of n−1 — see parallel/ring_attention.py) vs the ~2.5x
     fwd per-shard backward compute.
     """
-    from apex1_tpu.core.capability import get_capability, ici_link_gbps
+    from apex1_tpu.perf_model import ring_attention_comms
 
     B, Hq, Hkv, S, D = 1, 32, 4, 16384, 64
     rows = []
     for gen in ("v5e", "v5p"):
-        cap = get_capability(gen)
-        link = ici_link_gbps(gen)
-        if not link:
-            # capability row carries no ICI figure — nothing to price
-            print(f"  SKIP ring comms {gen}: no ici_gbps in capability "
-                  f"row", flush=True)
-            continue
         for n in (4, 8):
-            S_l = S // n
-            kv_hop = 2 * B * Hkv * S_l * D * 2          # K+V bf16
-            dkv_hop = 2 * B * Hkv * S_l * D * 4         # dK+dV fp32
-            att = 4 * B * Hq * S_l * S_l * D * 0.5      # causal attend
-            bwd = 2.5 * att
-            t_hop_f = kv_hop / (link * 1e9)
-            t_hop_b = (kv_hop + dkv_hop) / (link * 1e9)
-            t_att = att / (cap.bf16_tflops * 1e12)
-            t_bwd = bwd / (cap.bf16_tflops * 1e12)
-            fwd_bytes = (n - 1) * kv_hop
-            bwd_bytes = n * (kv_hop + dkv_hop)
-            exp_f_overlap = (n - 1) * max(0.0, t_hop_f - t_att) * \
-                (link * 1e9)
-            exp_b_overlap = n * max(0.0, t_hop_b - t_bwd) * (link * 1e9)
+            m = ring_attention_comms(gen, n, B=B, Hq=Hq, Hkv=Hkv, S=S,
+                                     D=D)
+            if m is None:
+                # capability row carries no ICI figure — nothing to
+                # price
+                print(f"  SKIP ring comms {gen}: no ici_gbps in "
+                      f"capability row", flush=True)
+                break
+            link = m["link_gbps"]
             for phase, total, serial_t, overlap_exp in (
-                    ("fwd", fwd_bytes, (n - 1) * t_hop_f, exp_f_overlap),
-                    ("bwd", bwd_bytes, n * t_hop_b, exp_b_overlap)):
+                    ("fwd", m["fwd_bytes"], (n - 1) * m["t_hop_f"],
+                     m["exp_f_overlap"]),
+                    ("bwd", m["bwd_bytes"], n * m["t_hop_b"],
+                     m["exp_b_overlap"])):
                 rows.append(dict(
                     name=f"ring llama_longctx {phase} cp={n}",
                     generation=gen, cp=n, phase=phase,
@@ -339,9 +252,10 @@ def predict_comms():
                     * 1e3,
                     source="analytic"))
             print(f"  OK   ring comms {gen} cp={n}: fwd hop "
-                  f"{kv_hop / 2**20:.1f} MiB vs attend {t_att * 1e3:.2f} "
-                  f"ms -> exposed {exp_f_overlap / 2**20:.1f} MiB "
-                  f"(serial {fwd_bytes / 2**20:.1f})", flush=True)
+                  f"{m['kv_hop'] / 2**20:.1f} MiB vs attend "
+                  f"{m['t_att'] * 1e3:.2f} "
+                  f"ms -> exposed {m['exp_f_overlap'] / 2**20:.1f} MiB "
+                  f"(serial {m['fwd_bytes'] / 2**20:.1f})", flush=True)
     return rows
 
 
@@ -369,43 +283,40 @@ def predict_comms_fused():
     at the per-link rate, so the three forms are scored honestly
     against each other, not assumed free.
     """
-    from apex1_tpu.core.capability import get_capability, ici_link_gbps
+    from apex1_tpu.perf_model import sp_boundary_comms
 
     S, hid, ffn = 8192, 4096, 14336   # global seq, llama-8B MLP dims
     rows = []
     for gen in ("v5e", "v5p"):
-        cap = get_capability(gen)
-        link = ici_link_gbps(gen)
-        if not link:
-            print(f"  SKIP fused comms {gen}: no ici_gbps in capability "
-                  f"row", flush=True)
-            continue
         for n in (4, 8):
             # matmul->reduce-scatter at the row-parallel boundary:
             # x (S, ffn/n) @ w (ffn/n, hid), travelling fp32 chunk acc
-            chunk_rows = S // n
-            hop = chunk_rows * hid * 4                    # fp32 acc hop
-            dot = 2 * chunk_rows * (ffn // n) * hid       # per-step MXU
-            t_hop = hop / (link * 1e9)
-            t_dot = dot / (cap.bf16_tflops * 1e12)
-            total = n * hop
-            resid = n * max(0.0, t_hop - t_dot) * (link * 1e9)
-            fused_exposed = hop + resid                  # prologue hop
+            m = sp_boundary_comms(gen, n, rows=S, out_width=hid,
+                                  ffn=ffn)
+            if m is None:
+                print(f"  SKIP fused comms {gen}: no ici_gbps in "
+                      f"capability row", flush=True)
+                break
+            link = m["link_gbps"]
             rows.append(dict(
                 name=f"SP matmul_reduce_scatter tp={n}",
                 generation=gen, tp=n,
-                ici_bytes=float(total),
-                exposed_bytes_serial=float(total),
-                exposed_bytes_overlap=float(resid),
-                exposed_bytes_fused=float(fused_exposed),
-                t_serial_ms=n * t_hop * 1e3,
-                t_exposed_overlap_ms=(resid / (link * 1e9)) * 1e3,
-                t_exposed_fused_ms=(fused_exposed / (link * 1e9)) * 1e3,
+                ici_bytes=m["total"],
+                exposed_bytes_serial=m["exposed_serial"],
+                exposed_bytes_overlap=m["exposed_overlap"],
+                exposed_bytes_fused=m["exposed_fused"],
+                t_serial_ms=n * m["t_hop"] * 1e3,
+                t_exposed_overlap_ms=(m["exposed_overlap"]
+                                      / (link * 1e9)) * 1e3,
+                t_exposed_fused_ms=(m["exposed_fused"]
+                                    / (link * 1e9)) * 1e3,
                 source="analytic"))
             print(f"  OK   fused comms {gen} tp={n}: hop "
-                  f"{hop / 2**20:.1f} MiB vs dot {t_dot * 1e3:.2f} ms "
-                  f"-> exposed serial {total / 2**20:.0f} / overlap "
-                  f"{resid / 2**20:.1f} / fused {fused_exposed / 2**20:.1f}"
+                  f"{m['hop'] / 2**20:.1f} MiB vs dot "
+                  f"{m['t_dot'] * 1e3:.2f} ms "
+                  f"-> exposed serial {m['total'] / 2**20:.0f} / overlap "
+                  f"{m['exposed_overlap'] / 2**20:.1f} / fused "
+                  f"{m['exposed_fused'] / 2**20:.1f}"
                   f" MiB", flush=True)
     return rows
 
@@ -630,8 +541,14 @@ def main():
                                         topology_name=TOPOLOGY)
 
     import bench as bench_mod
+    # planner-driven multichip configs (bench.PLANNED_BENCHES) build
+    # their mesh from the live device count — they cannot be priced by
+    # this single-chip AOT path and are priced by the planner's own
+    # cost engine instead; excluding them keeps the banked
+    # predicted_*.json rows byte-stable across the planner's arrival
     configs = (args.configs.split(",") if args.configs
-               else sorted(bench_mod.BENCHES))
+               else sorted(set(bench_mod.BENCHES)
+                           - bench_mod.PLANNED_BENCHES))
 
     print(f"== step cost models ({TOPOLOGY}) ==", flush=True)
     step_rows = predict_steps(topo, configs)

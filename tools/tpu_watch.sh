@@ -210,6 +210,14 @@ else
     | tee -a "$RES/status.log"
 fi
 run bench_llama16k  1800 python bench.py --config llama_longctx --timeout 1500
+# planner A/B (ROADMAP item 1, apex1_tpu.planner): the auto-parallel
+# planner's pick vs the hand-tuned layout — pricing leg against the
+# JUST-refit calibration, measured leg on the live mesh (skip record
+# on a single-chip window), plus the planner-driven llama_3d bench
+# record. Runs AFTER the llama_longctx re-bench: the planner consumes
+# this window's calibration, it must not delay the headline numbers.
+run planner_ab      1800 python tools/bench_planner_ab.py
+run bench_llama3d   1800 python bench.py --config llama_3d --timeout 1500
 # dropout=0.1 bert variant FIRST (PR5: attention-probability dropout now
 # rides the flash kernel + fused dropout-add-LN epilogues — this is the
 # headline BERT-pretrain configuration, measured before the plain
