@@ -1,0 +1,31 @@
+"""`apex1_tpu.autopilot` — the telemetry-driven fleet control loop
+(ROADMAP item 4).
+
+PR 10 made the fleet observable (obs spine, `ServingMetrics`); PR 7
+made it controllable (QoS ladder, degrade profiles, replica
+supervision). This package connects the two: a controller that
+consumes rolling per-class latency/TTFT percentiles and actuates the
+`ServingFrontend` knob surface — replica scale-up/down, overload-mode
+selection, admission setpoints, per-tenant hedge budgets — with every
+actuation banked beside the evidence that triggered it.
+
+- `policy` — the PURE decision core (`decide`: snapshot + state →
+  actions; hysteresis, escalation ladder, setpoint fits).
+- `controller` — `Autopilot`: measure → decide → actuate → bank
+  against a live frontend.
+- `testing.fleetsim` — the replayable fleet simulator the whole loop
+  is validated on (virtual clock, seed-keyed traces + chaos,
+  bit-deterministic episodes).
+
+``python -m apex1_tpu.autopilot --smoke`` replays the headline drill
+(static threshold ladder misses guaranteed-class p99 on an overload
+trace, the autopilot holds it, the episode replays bit-identically) —
+check_all's ``== autopilot smoke ==`` step. See docs/autopilot.md.
+"""
+
+from apex1_tpu.autopilot.controller import Autopilot  # noqa: F401
+from apex1_tpu.autopilot.policy import (Action,  # noqa: F401
+                                        AutopilotConfig,
+                                        ControllerState, FleetView,
+                                        SLOTarget, decide,
+                                        default_slo)

@@ -1,0 +1,135 @@
+"""Autopilot controller — binds the pure policy to a live
+`ServingFrontend`.
+
+`Autopilot.tick()` is the whole loop: snapshot the frontend
+(`summary()` → `FleetView`), run `policy.decide`, apply each returned
+`Action` through the frontend's actuation surface, and BANK it —
+every actuation lands (1) in ``self.actions`` (the in-memory episode
+log the drills assert on), (2) as a ``ServingMetrics.transition``
+(event ``"autopilot"``) beside the mode/shed/restart history, and
+(3) as an ``autopilot.action`` event on the telemetry spine when
+``APEX1_OBS_DIR`` is set — with the triggering evidence (the breached
+percentiles, the sustain counters, the load fraction) attached at
+every layer, so a whole episode is reconstructable from banked events
+alone (the headline drill's assertion).
+
+Attaching an Autopilot flips the frontend to
+``mode_control="external"``: from then on overload-mode transitions
+are driven by per-class latency/TTFT percentiles, not the built-in
+load-fraction ladder. The caller owns tick cadence — call `tick()`
+from the supervision loop (`testing.fleetsim` ticks on virtual time
+every ``control_interval_s``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from apex1_tpu.autopilot.policy import (Action, AutopilotConfig,
+                                        ControllerState, FleetView,
+                                        decide)
+from apex1_tpu.obs import spine
+
+__all__ = ["Autopilot"]
+
+MODES_DOWN = {"degraded": "shedding", "shedding": "normal"}
+
+
+class Autopilot:
+    """The fleet control loop: measure → decide → actuate → bank.
+
+    ``frontend`` is a `serving.ServingFrontend`; ``config`` an
+    `AutopilotConfig` (default: guard guaranteed-class p99 latency at
+    1s). ``clock`` defaults to the frontend's own (virtual under
+    `testing.fleetsim`).
+    """
+
+    def __init__(self, frontend, config: Optional[AutopilotConfig] = None,
+                 *, clock: Optional[Callable[[], float]] = None):
+        self.frontend = frontend
+        self.cfg = config or AutopilotConfig()
+        self.clock = clock or frontend.clock
+        self.state = ControllerState()
+        self.actions: List[dict] = []
+        frontend.mode_control = "external"
+        frontend.metrics.transition(
+            "autopilot_attached",
+            slo={cls: dataclasses.asdict(t)
+                 for cls, t in sorted(self.cfg.slo.items())},
+            min_replicas=self.cfg.min_replicas,
+            max_replicas=self.cfg.max_replicas)
+
+    # ---- measure ---------------------------------------------------------
+
+    def view(self) -> FleetView:
+        """Snapshot the frontend into the policy's input shape — via
+        the O(window) accessor, never `summary()` (whole-run
+        percentile sorts grow with every request ever served; a
+        per-tick read must not pay that under the metrics lock)."""
+        f = self.frontend
+        win = f.metrics.window_summary()
+        return FleetView(
+            mode=f.mode, load_fraction=f.load_fraction,
+            inflight=f.total_inflight, capacity=f.capacity,
+            n_replicas=len(f.replicas), n_alive=f.n_alive,
+            admission_limit=f.admission_limit,
+            window=win.get("per_class", {}),
+            per_tenant=win.get("per_tenant", {}))
+
+    # ---- the loop --------------------------------------------------------
+
+    def tick(self) -> List[Action]:
+        """One control tick; returns the actions applied (often
+        none — hysteresis is the point)."""
+        v = self.view()
+        actions = decide(v, self.state, self.cfg)
+        for act in actions:
+            self._apply(act, v)
+        return actions
+
+    # ---- actuate + bank --------------------------------------------------
+
+    def _apply(self, act: Action, view: FleetView):
+        f = self.frontend
+        result: dict = {}
+        if act.kind == "escalate" or act.kind == "deescalate":
+            f.set_mode(act.params["mode"], by="autopilot",
+                       evidence=act.evidence)
+            result["mode"] = f.mode
+        elif act.kind == "scale_up":
+            result["replica"] = f.add_replica(by="autopilot",
+                                              evidence=act.evidence)
+        elif act.kind == "scale_down":
+            rid = f.retire_replica(by="autopilot",
+                                   evidence=act.evidence)
+            result["replica"] = rid
+            if rid is None:            # nothing retirable after all —
+                result["noop"] = True  # banked as such, not hidden
+        elif act.kind == "set_admission":
+            f.set_admission_limit(act.params["limit"], by="autopilot",
+                                  evidence=act.evidence)
+            result["limit"] = act.params["limit"]
+        elif act.kind == "fit_hedge":
+            f.set_hedge_budget(act.params["budget_s"],
+                               tenant=act.params["tenant"],
+                               by="autopilot", evidence=act.evidence)
+            result.update(act.params)
+        else:                          # a policy/controller version skew
+            raise ValueError(f"unknown action kind {act.kind!r}")
+        rec = {"t": round(self.clock(), 6), "tick": self.state.ticks,
+               "action": act.kind, "params": act.params,
+               "result": result, "evidence": act.evidence}
+        self.actions.append(rec)
+        # the dedicated spine event (the knob calls above ALSO mirror
+        # through serving.transition; this one carries the full record
+        # under one greppable name). The controller clock's origin is
+        # its own (virtual under fleetsim) — it must not land on the
+        # spine's run-relative `t` axis (same origin rule as
+        # serving.metrics' t_serving).
+        spine.emit("event", "autopilot.action",
+                   **{("t_ctrl" if k == "t" else k): v
+                      for k, v in rec.items()})
+        f.metrics.transition("autopilot", action=act.kind,
+                             params=act.params, result=result,
+                             evidence=act.evidence)

@@ -1,0 +1,168 @@
+"""The headline autopilot drill — one scenario, three consumers
+(tier-1 `tests/test_autopilot.py`, ``python -m apex1_tpu.autopilot
+--smoke``, `tools/bench_autopilot.py`), so the claim every surface
+makes is the SAME claim.
+
+THE CLAIM (ROADMAP item 4's "done" line): on a replayed
+adversarial-overload trace whose guaranteed-class demand alone exceeds
+the provisioned fleet's service rate, EVERY static `FrontendConfig` in
+the stated sweep — the hand-tunable threshold-ladder knobs at baseline
+provisioning, from lenient to panic — misses the guaranteed-class SLO,
+while the autopilot (same baseline provisioning, same trace, same
+seed) holds it by actuating what no static ladder can: elastic
+capacity, percentile-driven mode selection, admission setpoints. And
+the whole episode is reconstructable from banked events and replays
+bit-identically.
+
+THE SWEEP IS STATED, NOT IMPLIED: it varies every knob the static
+overload ladder HAS (thresholds, sustain, degrade caps) at the
+baseline ``N_BASELINE`` replicas. A static config with the
+autopilot's peak fleet size pre-provisioned would of course hold the
+SLO — by paying for peak capacity all day; the autopilot's point is
+holding it from baseline provisioning, scaling back after
+(`SimReport.summary["replicas"]` shows the retirements).
+
+Provisioning arithmetic (`FleetSimConfig` docstring): one replica
+serves ``slots / (mean_new_tokens * dt_s)`` ≈ 29 req/s here; the
+overload phase offers ~120 req/s with half guaranteed, so guaranteed
+demand (~60 req/s) alone exceeds the 2-replica fleet (~57 req/s) no
+matter what the ladder sheds, and fits easily at the autopilot's
+4-replica ceiling (~114 req/s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from apex1_tpu.autopilot.policy import AutopilotConfig, SLOTarget
+from apex1_tpu.testing.fleetsim import (FleetSimConfig, SimReport,
+                                        Trace, run_fleet,
+                                        synthetic_trace)
+
+__all__ = [
+    "SLO_LATENCY_S", "SLO_ATTAINMENT", "N_BASELINE", "overload_trace",
+    "static_sweep", "autopilot_config", "sim_config", "frontend_config",
+    "run_headline",
+]
+
+#: the guaranteed-class SLO the drill holds: this fraction of OFFERED
+#: guaranteed load must finish within this many (virtual) seconds
+SLO_LATENCY_S = 1.0
+SLO_ATTAINMENT = 0.90
+
+#: baseline provisioning — both the static sweep and the autopilot
+#: start here; only the autopilot may leave it
+N_BASELINE = 2
+N_MAX = 4
+
+
+def sim_config(**over) -> FleetSimConfig:
+    return FleetSimConfig(**{**dict(dt_s=0.02, control_interval_s=0.1,
+                                    slots_per_replica=4), **over})
+
+
+def overload_trace(seed: int = 20260804, *, scale: float = 1.0,
+                   horizon_s: float = 6.0) -> Trace:
+    """The adversarial-overload replay input: ~40 req/s baseline,
+    3x that for the middle 55% of the horizon, half guaranteed.
+    ``scale`` multiplies the rate (benches crank it; tier-1 keeps
+    1.0 ≈ 450 requests)."""
+    return synthetic_trace(
+        "adversarial_overload", seed=seed, horizon_s=horizon_s,
+        base_rate=40.0 * scale, overload_mult=3.0,
+        overload_span=(0.25, 0.80),
+        class_mix={"guaranteed": 0.5, "best_effort": 0.25,
+                   "sheddable": 0.25})
+
+
+def frontend_config(**over):
+    """Baseline frontend: the shape both arms share. Hedging is off so
+    the capacity arithmetic above stays exact (the hedge-budget FIT is
+    exercised by its own test + the diurnal bench trace)."""
+    from apex1_tpu.serving import FrontendConfig, ReplicaConfig
+
+    kw = dict(n_replicas=N_BASELINE, capacity_per_replica=16,
+              hedge_after_s=None, seed=7,
+              replica=ReplicaConfig(watchdog_s=1e9))
+    kw.update(over)
+    return FrontendConfig(**kw)
+
+
+def static_sweep() -> List[Tuple[str, object]]:
+    """The stated sweep: every hand-tunable knob of the static
+    overload ladder, at baseline provisioning, lenient → panic."""
+    from apex1_tpu.serving import DegradeProfile
+
+    return [
+        ("static-lenient", frontend_config(
+            enter_shed=0.90, enter_degraded=0.98, exit_overload=0.6,
+            sustain_rounds=8)),
+        ("static-default", frontend_config()),
+        ("static-panic", frontend_config(
+            enter_shed=0.45, enter_degraded=0.70, exit_overload=0.3,
+            sustain_rounds=2,
+            degrade=DegradeProfile(max_new_tokens_cap=4))),
+    ]
+
+
+def autopilot_config(**over) -> AutopilotConfig:
+    kw = dict(
+        slo={"guaranteed": SLOTarget(
+            latency_p99_ms=1e3 * SLO_LATENCY_S, success_rate=0.95)},
+        min_replicas=N_BASELINE, max_replicas=N_MAX,
+        breach_sustain=3, clear_sustain=8, cooldown_ticks=3,
+        min_window=8, fit_hedge=False)
+    kw.update(over)
+    return AutopilotConfig(**kw)
+
+
+@dataclasses.dataclass
+class HeadlineResult:
+    """The drill's verdict surface."""
+
+    trace: Trace
+    static: Dict[str, SimReport]
+    auto: SimReport
+
+    def attainment(self, report: SimReport) -> float:
+        return report.slo_attainment("guaranteed", SLO_LATENCY_S)
+
+    @property
+    def static_attainments(self) -> Dict[str, float]:
+        return {name: self.attainment(r)
+                for name, r in self.static.items()}
+
+    @property
+    def auto_attainment(self) -> float:
+        return self.attainment(self.auto)
+
+    def verdict(self) -> dict:
+        return {
+            "slo": {"latency_s": SLO_LATENCY_S,
+                    "attainment": SLO_ATTAINMENT,
+                    "class": "guaranteed"},
+            "static": {n: round(a, 4)
+                       for n, a in self.static_attainments.items()},
+            "autopilot": round(self.auto_attainment, 4),
+            "every_static_misses": all(
+                a < SLO_ATTAINMENT
+                for a in self.static_attainments.values()),
+            "autopilot_holds": self.auto_attainment >= SLO_ATTAINMENT,
+            "n_actions": len(self.auto.actions),
+            "auto_fingerprint": self.auto.fingerprint(),
+        }
+
+
+def run_headline(seed: int = 20260804, *, scale: float = 1.0,
+                 sim: Optional[FleetSimConfig] = None
+                 ) -> HeadlineResult:
+    """Replay the overload trace through the whole static sweep and
+    the autopilot arm."""
+    trace = overload_trace(seed, scale=scale)
+    simcfg = sim or sim_config()
+    static = {name: run_fleet(trace, cfg, sim=simcfg)
+              for name, cfg in static_sweep()}
+    auto = run_fleet(trace, frontend_config(),
+                     sim=simcfg, autopilot=autopilot_config())
+    return HeadlineResult(trace=trace, static=static, auto=auto)

@@ -1,0 +1,301 @@
+"""Autopilot policy — the PURE decision core of the fleet control loop.
+
+Everything here is a function of (snapshot, controller state, config):
+no clocks, no I/O, no frontend handles — `decide` is unit-testable and
+replay-deterministic by construction. The side-effecting half
+(`controller.Autopilot`) builds the `FleetView` snapshot from
+`ServingFrontend.summary()` and applies the returned `Action`s to the
+frontend's knob surface.
+
+The control contract (docs/autopilot.md):
+
+- **Signal**: per-class rolling-window latency/TTFT p99s
+  (`ServingMetrics` ring buffer) against per-class `SLOTarget`s — NOT
+  raw queue depth; queue depth says a queue exists, percentiles say
+  users are hurting.
+- **Hysteresis**: a breach must hold for ``breach_sustain``
+  consecutive ticks before anything actuates (a burst is not an
+  overload), relief must hold for the LONGER ``clear_sustain`` before
+  anything relaxes, and every actuation starts a ``cooldown_ticks``
+  refractory period — the anti-flap triad the oscillation tests pin.
+- **Escalation ladder** (cheapest relief first):
+  ``shed sheddable load → add replicas (to max_replicas) → degrade →
+  tighten the admission setpoint``; relaxation unwinds the same ladder
+  in reverse, one rung per sustained-clear window.
+- **Setpoint fitting**: per-tenant hedge/TTFT budgets are FIT from the
+  measured windowed TTFT distribution (``multiplier x p99``, floored),
+  replacing the hand-tuned global ``hedge_after_s`` — the same
+  measured-not-hand-picked move the planner (PR 12) made for parallel
+  layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = [
+    "SLOTarget", "AutopilotConfig", "FleetView", "ControllerState",
+    "Action", "decide", "default_slo",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTarget:
+    """Per-class objective; None disables that dimension.
+
+    ``success_rate`` is the windowed fraction of terminal outcomes
+    that are "done" — the dimension that sees ADMISSION-induced
+    misses: under a hard overload the accepted requests' latency can
+    look healthy precisely BECAUSE the front door is rejecting the
+    excess, so a percentile-only controller would sleep through the
+    worst failure mode (latency percentiles survive only on accepted
+    traffic)."""
+
+    latency_p99_ms: Optional[float] = None
+    ttft_p99_ms: Optional[float] = None
+    success_rate: Optional[float] = None
+
+
+def default_slo() -> Dict[str, SLOTarget]:
+    """Guard the guaranteed class only — best_effort/sheddable are,
+    definitionally, what gets traded away under pressure."""
+    return {"guaranteed": SLOTarget(latency_p99_ms=1000.0,
+                                    success_rate=0.95)}
+
+
+@dataclasses.dataclass
+class AutopilotConfig:
+    """Control-loop knobs. Tick cadence is owned by the caller (the
+    simulator ticks on virtual time); everything here counts TICKS."""
+
+    slo: Dict[str, SLOTarget] = dataclasses.field(
+        default_factory=default_slo)
+    min_replicas: int = 1
+    max_replicas: int = 4
+    breach_sustain: int = 3        # ticks in breach before actuating
+    clear_sustain: int = 8         # ticks clear before relaxing (slower
+    #                                down than up — the asymmetry that
+    #                                keeps relief from flapping)
+    cooldown_ticks: int = 4        # refractory period after any rung
+    min_window: int = 8            # windowed samples needed to act on a
+    #                                class (thin evidence actuates
+    #                                nothing, in either direction)
+    scale_down_headroom: float = 0.5   # p99 must sit under
+    #                                    headroom x target to shrink
+    load_scale_down: float = 0.35      # ... AND load under this
+    admission_decrease: float = 0.85   # AIMD tighten factor (x current
+    #                                    inflight) on the last rung
+    fit_hedge: bool = True
+    fit_every: int = 16            # hedge-budget refit cadence (ticks)
+    hedge_multiplier: float = 3.0  # budget = mult x windowed ttft_p99
+    hedge_floor_s: float = 0.05
+    hedge_rel_tol: float = 0.1     # refit only on >10% movement
+
+
+@dataclasses.dataclass
+class FleetView:
+    """The normalized snapshot `decide` consumes — built by the
+    controller from `ServingFrontend.summary()` (so policy tests can
+    hand-build one)."""
+
+    mode: str
+    load_fraction: float
+    inflight: int
+    capacity: int
+    n_replicas: int                # supervisors ever built
+    n_alive: int                   # routable now (excl. retiring)
+    admission_limit: Optional[int]
+    window: dict                   # summary()["window"]["per_class"]
+    per_tenant: dict               # summary()["window"]["per_tenant"]
+
+
+@dataclasses.dataclass
+class ControllerState:
+    """Mutable controller memory between ticks."""
+
+    ticks: int = 0
+    breach_ticks: int = 0
+    clear_ticks: int = 0
+    cooldown: int = 0
+    hedge_budgets: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class Action:
+    """One actuation: ``kind`` picks the frontend knob, ``params``
+    feed it, ``evidence`` is the triggering measurement banked beside
+    the actuation (spine + transitions)."""
+
+    kind: str      # escalate|deescalate|scale_up|scale_down|
+    #                set_admission|fit_hedge
+    params: dict
+    evidence: dict
+
+
+def _breaches(view: FleetView, cfg: AutopilotConfig) -> List[dict]:
+    """Every (class, metric) whose windowed p99 exceeds its SLO target,
+    with the numbers attached. Classes with fewer than ``min_window``
+    samples contribute nothing — no evidence, no verdict."""
+    out = []
+    for cls, target in sorted(cfg.slo.items()):
+        stats = view.window.get(cls)
+        if not stats or stats.get("n", 0) < cfg.min_window:
+            continue
+        for metric, want in (("latency_p99_ms", target.latency_p99_ms),
+                             ("ttft_p99_ms", target.ttft_p99_ms)):
+            got = stats.get(metric)
+            if want is not None and got is not None and got > want:
+                out.append({"class": cls, "metric": metric,
+                            "value": round(got, 3), "target": want,
+                            "n": stats["n"]})
+        if target.success_rate is not None:
+            got = stats["done"] / stats["n"]
+            if got < target.success_rate:
+                out.append({"class": cls, "metric": "success_rate",
+                            "value": round(got, 4),
+                            "target": target.success_rate,
+                            "n": stats["n"]})
+    return out
+
+
+def _has_evidence(view: FleetView, cfg: AutopilotConfig) -> bool:
+    """True when at least one SLO'd class has a full-enough window to
+    judge. With NO evidence the controller must freeze — counting
+    evidence-free ticks as "clear" would relax straight back into a
+    live overload whose guaranteed entries were merely crowded out of
+    the shared ring."""
+    return any(
+        (view.window.get(cls) or {}).get("n", 0) >= cfg.min_window
+        for cls in cfg.slo)
+
+
+def _headroom_ok(view: FleetView, cfg: AutopilotConfig) -> bool:
+    """True when every SLO'd class with evidence sits comfortably
+    under its targets — the precondition for giving capacity back."""
+    for cls, target in cfg.slo.items():
+        stats = view.window.get(cls)
+        if not stats or stats.get("n", 0) < cfg.min_window:
+            continue
+        for metric, want in (("latency_p99_ms", target.latency_p99_ms),
+                             ("ttft_p99_ms", target.ttft_p99_ms)):
+            got = stats.get(metric)
+            if want is not None and got is not None \
+                    and got > cfg.scale_down_headroom * want:
+                return False
+        if target.success_rate is not None \
+                and stats["done"] / stats["n"] < target.success_rate:
+            return False
+    return True
+
+
+def _escalation(view: FleetView, cfg: AutopilotConfig,
+                evidence: dict) -> Optional[Action]:
+    """One rung up the relief ladder, cheapest first."""
+    if view.mode == "normal":
+        return Action("escalate", {"mode": "shedding"}, evidence)
+    if view.n_alive < cfg.max_replicas:
+        return Action("scale_up", {}, evidence)
+    if view.mode == "shedding":
+        return Action("escalate", {"mode": "degraded"}, evidence)
+    # everything cheaper is spent: tighten the admission setpoint so
+    # queueing delay stops compounding (AIMD decrease; rejected load
+    # retries against a 429 instead of rotting in the queue)
+    limit = max(view.n_alive, int(view.inflight
+                                  * cfg.admission_decrease))
+    if view.admission_limit is None or limit < view.admission_limit:
+        return Action("set_admission", {"limit": limit}, evidence)
+    return None
+
+
+def _relaxation(view: FleetView, cfg: AutopilotConfig,
+                evidence: dict) -> Optional[Action]:
+    """One rung back down, unwinding `_escalation` in reverse."""
+    if view.admission_limit is not None:
+        return Action("set_admission", {"limit": None}, evidence)
+    if view.mode == "degraded":
+        return Action("deescalate", {"mode": "shedding"}, evidence)
+    if (view.n_alive > cfg.min_replicas
+            and view.load_fraction <= cfg.load_scale_down
+            and _headroom_ok(view, cfg)):
+        # capacity is the most expensive rung, so it unwinds as soon as
+        # load AND percentiles prove it idle — but never on load alone:
+        # a breach-free window under p99 headroom is required too
+        return Action("scale_down", {}, evidence)
+    if view.mode == "shedding":
+        return Action("deescalate", {"mode": "normal"}, evidence)
+    return None
+
+
+def _fit_hedges(view: FleetView, state: ControllerState,
+                cfg: AutopilotConfig) -> List[Action]:
+    """Refit per-tenant hedge/TTFT budgets from the measured windowed
+    TTFT distribution; emit only on material movement."""
+    out = []
+    for tenant, stats in sorted(view.per_tenant.items()):
+        if stats.get("n", 0) < cfg.min_window:
+            continue
+        p99 = stats.get("ttft_p99_ms")
+        if p99 is None:
+            continue
+        budget = max(cfg.hedge_floor_s,
+                     cfg.hedge_multiplier * p99 / 1e3)
+        prev = state.hedge_budgets.get(tenant)
+        if prev is not None and abs(budget - prev) \
+                <= cfg.hedge_rel_tol * prev:
+            continue
+        state.hedge_budgets[tenant] = budget
+        out.append(Action(
+            "fit_hedge", {"tenant": tenant,
+                          "budget_s": round(budget, 6)},
+            {"ttft_p99_ms": p99, "n": stats["n"],
+             "multiplier": cfg.hedge_multiplier}))
+    return out
+
+
+def decide(view: FleetView, state: ControllerState,
+           cfg: AutopilotConfig) -> List[Action]:
+    """One control tick: update the hysteresis counters, emit at most
+    one ladder action (plus any hedge-budget refits). Mutates
+    ``state``; pure in everything else."""
+    state.ticks += 1
+    if state.cooldown > 0:
+        state.cooldown -= 1
+    if not _has_evidence(view, cfg):
+        # thin evidence actuates nothing, in EITHER direction: freeze
+        # the hysteresis counters (an evidence-free tick is not a
+        # "clear" tick) and emit only the self-gated hedge refits
+        actions: List[Action] = []
+        if cfg.fit_hedge and state.ticks % cfg.fit_every == 0:
+            actions.extend(_fit_hedges(view, state, cfg))
+        return actions
+    breaches = _breaches(view, cfg)
+    if breaches:
+        state.breach_ticks += 1
+        state.clear_ticks = 0
+    else:
+        state.clear_ticks += 1
+        state.breach_ticks = 0
+    evidence = {
+        "breaches": breaches, "breach_ticks": state.breach_ticks,
+        "clear_ticks": state.clear_ticks, "mode": view.mode,
+        "load_fraction": round(view.load_fraction, 4),
+        "inflight": view.inflight, "n_alive": view.n_alive,
+    }
+    actions: List[Action] = []
+    if state.breach_ticks >= cfg.breach_sustain and state.cooldown == 0:
+        act = _escalation(view, cfg, evidence)
+        if act is not None:
+            actions.append(act)
+            state.cooldown = cfg.cooldown_ticks
+            state.breach_ticks = 0
+    elif state.clear_ticks >= cfg.clear_sustain and state.cooldown == 0:
+        act = _relaxation(view, cfg, evidence)
+        if act is not None:
+            actions.append(act)
+            state.cooldown = cfg.cooldown_ticks
+            state.clear_ticks = 0
+    if cfg.fit_hedge and state.ticks % cfg.fit_every == 0:
+        actions.extend(_fit_hedges(view, state, cfg))
+    return actions

@@ -34,6 +34,30 @@ Drive modes mirror `ReplicaSupervisor`: `start()` + threaded
 replicas for production/bench, `pump()` inline for deterministic
 tier-1 drills. `pump` is also the supervision tick in threaded mode
 (watchdogs, restarts, hedges, mode transitions, result collection).
+
+**Actuation surface** (docs/autopilot.md): the knobs a controller —
+`apex1_tpu.autopilot` — turns at runtime, every call banked as a
+transition with its caller (`by=`) and evidence attached:
+
+- `add_replica()` / `retire_replica()` — elastic fleet size. A
+  retiring replica takes no new routes, drains its in-flight work,
+  then stops; its slot in ``replicas`` stays (ids are route indices).
+- `set_mode()` — external overload-ladder control. With
+  ``FrontendConfig.mode_control="external"`` the built-in
+  load-fraction ladder is off and transitions are driven by whatever
+  signal the controller watches (per-class latency percentiles, not
+  raw queue depth).
+- `set_admission_limit()` — admission setpoint: caps `capacity`
+  below the structural ``n_alive * capacity_per_replica``.
+- `set_hedge_budget()` — per-tenant TTFT/hedge budgets fit from
+  measured distributions (falls back to ``cfg.hedge_after_s``).
+
+The frontend also RECORDS every accepted request's lifecycle
+(queued → first_token → terminal) into its own shared
+`ServingMetrics`, so `summary()["window"]` carries the rolling
+per-class percentiles the controller consumes — engine-level metrics
+stay per-replica and are not aggregated here. ``clock`` is injectable
+(`testing.fleetsim` passes virtual time for deterministic replay).
 """
 
 from __future__ import annotations
@@ -47,7 +71,7 @@ import numpy as np
 
 from apex1_tpu.serving.engine import Engine, RequestResult, \
     derive_request_seed
-from apex1_tpu.serving.metrics import ServingMetrics
+from apex1_tpu.serving.metrics import TERMINAL, ServingMetrics
 from apex1_tpu.serving.replica import (ReplicaConfig, ReplicaSupervisor,
                                        Submission)
 from apex1_tpu.serving.scheduler import (Backpressure, new_request_id,
@@ -89,6 +113,11 @@ class FrontendConfig:
     replica: ReplicaConfig = dataclasses.field(
         default_factory=ReplicaConfig)
     retry_after_s: float = 0.05    # frontend 429 backoff floor base
+    mode_control: str = "load"     # "load" = the built-in load-fraction
+    #  ladder walks modes; "external" = ONLY set_mode() flips them (an
+    #  attached autopilot drives transitions from latency percentiles)
+    metrics_window: int = 128      # rolling-percentile ring size for a
+    #                                frontend-constructed ServingMetrics
 
 
 class ServingFrontend:
@@ -104,29 +133,53 @@ class ServingFrontend:
     def __init__(self, make_engine: Callable[..., Engine],
                  config: Optional[FrontendConfig] = None, *,
                  metrics: Optional[ServingMetrics] = None,
-                 fault=None):
+                 fault=None,
+                 clock: Optional[Callable[[], float]] = None):
         self.cfg = cfg = config or FrontendConfig()
         if cfg.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
-        self.metrics = metrics or ServingMetrics()
+        if cfg.mode_control not in ("load", "external"):
+            raise ValueError(
+                f"mode_control must be 'load' or 'external', "
+                f"got {cfg.mode_control!r}")
+        # instance state seeded from the config: attaching an Autopilot
+        # flips THIS frontend to external control without mutating a
+        # (possibly shared) FrontendConfig object
+        self.mode_control = cfg.mode_control
+        self.clock = clock or time.monotonic
+        self.metrics = metrics or ServingMetrics(
+            window=cfg.metrics_window, clock=self.clock)
         self._make_engine = make_engine
+        self._fault = fault
         self._takes_cache_dtype = "cache_dtype" in \
             inspect.signature(make_engine).parameters
         self.mode = "normal"
         self._above = 0                      # sustained-overload counters
         self._below = 0
-        self.replicas: List[ReplicaSupervisor] = [
-            ReplicaSupervisor(self._build_engine, i, config=cfg.replica,
-                              metrics=self.metrics, fault=fault,
-                              seed=cfg.seed)
-            for i in range(cfg.n_replicas)]
+        self.replicas: List[ReplicaSupervisor] = []
+        self._rep_counters: Dict[int, Dict[str, int]] = {}
+        for _ in range(cfg.n_replicas):
+            self._new_replica()
         self._subs: Dict[int, Submission] = {}      # all accepted, by id
         self._live: set = set()                     # accepted, not terminal
         self._route: Dict[int, List[int]] = {}      # rid -> replica ids
         self._shed_rids: set = set()                # relabel cancelled->shed
         self._hedged: set = set()
+        self._ttft_marked: set = set()              # first_token recorded
+        self._retiring: set = set()                 # replica ids draining
+        self._admission_limit: Optional[int] = None
+        self._hedge_budgets: Dict[Optional[str], Optional[float]] = {}
         self._terminal: Dict[int, RequestResult] = {}
         self._threaded = False
+
+    def _new_replica(self) -> ReplicaSupervisor:
+        rep = ReplicaSupervisor(
+            self._build_engine, len(self.replicas),
+            config=self.cfg.replica, metrics=self.metrics,
+            fault=self._fault, seed=self.cfg.seed, clock=self.clock)
+        self.replicas.append(rep)
+        self._rep_counters[rep.replica_id] = {"hedges": 0, "sheds": 0}
+        return rep
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -155,7 +208,7 @@ class ServingFrontend:
         class refused while shedding/degraded, or no replica can
         feasibly meet the deadline."""
         qos_rank(qos)                        # validate loudly
-        now = time.monotonic()
+        now = self.clock()
         rid = new_request_id() if req_id is None else int(req_id)
         if seed is None:
             # pinned HERE, not per engine: failover must regenerate the
@@ -163,9 +216,9 @@ class ServingFrontend:
             seed = derive_request_seed(self.cfg.seed, rid)
         seed = int(seed) & 0x7FFFFFFF    # int32 counter-key contract
         if self.mode in ("shedding", "degraded") and qos == "sheddable":
-            raise Backpressure(
+            raise self._reject(
+                rid, now, qos, tenant,
                 f"{self.mode}: sheddable admissions refused",
-                queue_depth=self.total_inflight,
                 retry_after_s=self._retry_after())
         if self.mode == "degraded":
             capped = min(int(max_new_tokens),
@@ -178,16 +231,17 @@ class ServingFrontend:
         # innocent sheddable victim for nothing (review finding)
         rep = self._pick_replica(max_new_tokens, deadline, now)
         if rep is None:
-            raise Backpressure(
+            raise self._reject(
+                rid, now, qos, tenant,
                 "no replica can feasibly meet the deadline",
-                queue_depth=self.total_inflight, retry_after_s=0.0)
+                retry_after_s=0.0)
         if self.total_inflight >= self.capacity:
             if qos == "guaranteed" and self._displace_sheddable():
                 pass                         # freed a unit of capacity
             else:
-                raise Backpressure(
+                raise self._reject(
+                    rid, now, qos, tenant,
                     f"frontend at capacity ({self.capacity})",
-                    queue_depth=self.total_inflight,
                     retry_after_s=self._retry_after())
         sub = Submission(
             tokens=np.asarray(tokens, np.int32).reshape(-1),
@@ -197,6 +251,13 @@ class ServingFrontend:
         self._subs[rid] = sub
         self._live.add(rid)
         self._route[rid] = [rep.replica_id]
+        # the frontend-level lifecycle record: per-class/tenant rolling
+        # percentiles (summary()["window"]) are fed from THESE events,
+        # which survive replica restarts and failover — engine-level
+        # records die with their engine
+        self.metrics.event(rid, "queued", now=now,
+                           n_prompt=int(sub.tokens.size), qos=qos,
+                           tenant=tenant)
         rep.submit_sub(sub)
         return rid
 
@@ -247,7 +308,12 @@ class ServingFrontend:
                 elif rep.state in ("new", "alive"):
                     rep.pump(1)
             self._recover_dead()
+            # TTFT before collection: a request whose first token and
+            # terminal result land in the same round must still get its
+            # first_token stamp (collection pops it from _live)
+            self._observe_first_tokens()
             self._collect()
+            self._complete_retirements()
             self._hedge_blown_budgets()
             self._update_mode()
             if self._threaded:
@@ -278,17 +344,49 @@ class ServingFrontend:
     # ---- internals ------------------------------------------------------
 
     @property
+    def n_alive(self) -> int:
+        """Routable replicas: alive and not draining toward
+        retirement."""
+        return sum(r.state in ("new", "alive")
+                   and r.replica_id not in self._retiring
+                   for r in self.replicas)
+
+    @property
     def capacity(self) -> int:
-        n_live = sum(r.state in ("new", "alive") for r in self.replicas)
-        return max(1, n_live) * self.cfg.capacity_per_replica
+        cap = max(1, self.n_alive) * self.cfg.capacity_per_replica
+        if self._admission_limit is not None:
+            cap = min(cap, self._admission_limit)
+        return cap
 
     @property
     def total_inflight(self) -> int:
         return len(self._live)
 
+    @property
+    def load_fraction(self) -> float:
+        return self.total_inflight / self.capacity
+
+    @property
+    def admission_limit(self) -> Optional[int]:
+        return self._admission_limit
+
     def _retry_after(self) -> float:
-        return self.cfg.retry_after_s * max(
-            1.0, self.total_inflight / self.capacity)
+        return self.cfg.retry_after_s * max(1.0, self.load_fraction)
+
+    def _reject(self, rid: int, now: float, qos: str,
+                tenant: Optional[str], reason: str, *,
+                retry_after_s: float) -> Backpressure:
+        """Build the structured 429 AND record the refusal in the
+        lifecycle stream: a rejected guaranteed request is an SLO miss
+        the latency percentiles can never see (they survive only on
+        accepted traffic) — the rolling window's per-class done-rate
+        is the control signal that sees it (`policy.SLOTarget
+        .success_rate`)."""
+        self.metrics.event(rid, "queued", now=now, n_prompt=0,
+                           qos=qos, tenant=tenant)
+        self.metrics.event(rid, "rejected", now=now, reason=reason)
+        return Backpressure(reason, queue_depth=self.total_inflight,
+                            retry_after_s=retry_after_s)
 
     def _build_engine(self) -> Engine:
         prof = self.cfg.degrade
@@ -298,7 +396,10 @@ class ServingFrontend:
         return self._make_engine()
 
     def _alive(self) -> List[ReplicaSupervisor]:
-        return [r for r in self.replicas if r.state in ("new", "alive")]
+        """Replicas new/routable work may target — a retiring replica
+        finishes what it has but takes no new routes."""
+        return [r for r in self.replicas if r.state in ("new", "alive")
+                and r.replica_id not in self._retiring]
 
     def _pick_replica(self, max_new_tokens: int,
                       deadline: Optional[float], now: float
@@ -341,45 +442,73 @@ class ServingFrontend:
     def _shed(self, sub: Submission, reason: str):
         self._shed_rids.add(sub.req_id)
         self.metrics.incr("sheds")
+        routed = self._route.get(sub.req_id, [])
+        if routed:
+            self._rep_counters[routed[0]]["sheds"] += 1
         self.metrics.transition("shed", req=sub.req_id, qos=sub.qos,
                                 reason=reason)
-        for r in self._route.get(sub.req_id, []):
+        for r in routed:
             self.replicas[r].cancel(sub.req_id)
 
     def _recover_dead(self):
         for rep in self.replicas:
             if rep.state != "dead":
                 continue
+            if rep.replica_id in self._retiring:
+                # a replica that dies while draining is not restarted —
+                # it was leaving anyway; its in-flight work fails over
+                self._failover(rep)
+                rep.state = "stopped"
+                rep.engine = None        # release the KV cache: only
+                #  restart() clears the engine, and this replica never
+                #  restarts
+                self._retiring.discard(rep.replica_id)
+                self.metrics.transition(
+                    "replica_retired", replica=rep.replica_id,
+                    note="died while draining")
+                continue
             if not rep.restart():
                 # budget spent: fail over its in-flight work
-                subs = rep.drain_inflight()
-                targets = self._alive()
-                for sub in subs:
-                    # a hedge leg may already be running elsewhere —
-                    # re-routing would double-decode the same id on
-                    # one engine; dropping the failed leg suffices
-                    others = [r for r in self._route.get(sub.req_id, [])
-                              if r != rep.replica_id
-                              and self.replicas[r].state
-                              in ("new", "alive")]
-                    if others:
-                        continue
-                    if not targets:
-                        self._terminal[sub.req_id] = RequestResult(
-                            req_id=sub.req_id, status="evicted",
-                            tokens=np.zeros((0,), np.int32),
-                            reason="no surviving replicas")
-                        self._live.discard(sub.req_id)
-                        continue
-                    tgt = min(targets,
-                              key=lambda r: (r.load, r.replica_id))
-                    self._route.setdefault(sub.req_id, []).append(
-                        tgt.replica_id)
-                    tgt.submit_sub(sub)
-                    self.metrics.incr("retries")
-                self.metrics.transition(
-                    "failover", source=rep.replica_id,
-                    rerouted=[s.req_id for s in subs])
+                self._failover(rep)
+
+    def _failover(self, rep: ReplicaSupervisor):
+        subs = rep.drain_inflight()
+        targets = self._alive()
+        for sub in subs:
+            # a hedge leg may already be running elsewhere —
+            # re-routing would double-decode the same id on
+            # one engine; dropping the failed leg suffices
+            others = [r for r in self._route.get(sub.req_id, [])
+                      if r != rep.replica_id
+                      and self.replicas[r].state
+                      in ("new", "alive")]
+            if others:
+                continue
+            if not targets:
+                self._finish_here(sub.req_id, RequestResult(
+                    req_id=sub.req_id, status="evicted",
+                    tokens=np.zeros((0,), np.int32),
+                    reason="no surviving replicas"))
+                continue
+            tgt = min(targets,
+                      key=lambda r: (r.load, r.replica_id))
+            self._route.setdefault(sub.req_id, []).append(
+                tgt.replica_id)
+            tgt.submit_sub(sub)
+            self.metrics.incr("retries")
+        self.metrics.transition(
+            "failover", source=rep.replica_id,
+            rerouted=[s.req_id for s in subs])
+
+    def _finish_here(self, rid: int, res: RequestResult):
+        """Make a request terminal at the frontend and close its
+        lifecycle record (latency/TTFT land in the rolling window)."""
+        self._terminal[rid] = res
+        self._live.discard(rid)
+        self._ttft_marked.discard(rid)
+        status = res.status if res.status in TERMINAL else "done"
+        self.metrics.event(rid, status, reason=res.reason,
+                           n_generated=int(res.tokens.size))
 
     def _collect(self):
         # sweep settled hedge/cancel races: a loser leg publishes its
@@ -401,8 +530,7 @@ class ServingFrontend:
                 if rid in self._shed_rids and res.status == "cancelled":
                     res = dataclasses.replace(
                         res, status="evicted", reason="shed (overload)")
-                self._terminal[rid] = res
-                self._live.discard(rid)
+                self._finish_here(rid, res)
                 # hedge race settled: cancel the other leg(s)
                 for other in self._route.get(rid, []):
                     if other != r:
@@ -412,15 +540,53 @@ class ServingFrontend:
                     self.metrics.incr("hedges_won")
                 break
 
+    def _observe_first_tokens(self):
+        """Stamp each live request's first_token lifecycle event the
+        first supervision round any routed replica reports it (the
+        `first_token_seen` probe) — pump-granular, which is exactly the
+        resolution the control loop samples at anyway."""
+        for rid in list(self._live):
+            if rid in self._ttft_marked:
+                continue
+            if any(self.replicas[r].first_token_seen(rid)
+                   for r in self._route.get(rid, [])):
+                self._ttft_marked.add(rid)
+                self.metrics.event(rid, "first_token")
+
+    def _complete_retirements(self):
+        """Stop a retiring replica once it has drained (dead retiring
+        replicas are handled by `_recover_dead`)."""
+        for rep_id in sorted(self._retiring):
+            rep = self.replicas[rep_id]
+            if rep.state in ("new", "alive") and rep.n_inflight == 0:
+                rep.stop()
+                rep.engine = None        # a stopped replica never
+                #  restarts — drop the engine (and its KV cache) or
+                #  every scale-up/scale-down cycle leaks one
+                self._retiring.discard(rep_id)
+                self.metrics.transition("replica_retired",
+                                        replica=rep_id)
+
+    def _hedge_budget_for(self, tenant: Optional[str]
+                          ) -> Optional[float]:
+        """Per-tenant fitted budget > fitted default (None key) >
+        the static config; None = hedging disabled for that tenant."""
+        if tenant in self._hedge_budgets:
+            return self._hedge_budgets[tenant]
+        if None in self._hedge_budgets:
+            return self._hedge_budgets[None]
+        return self.cfg.hedge_after_s
+
     def _hedge_blown_budgets(self):
-        if self.cfg.hedge_after_s is None:
+        if self.cfg.hedge_after_s is None and not self._hedge_budgets:
             return
-        now = time.monotonic()
+        now = self.clock()
         for rid in list(self._live):
             sub = self._subs[rid]
             if sub.qos != "guaranteed" or rid in self._hedged:
                 continue
-            if now - sub.submitted_at <= self.cfg.hedge_after_s:
+            budget = self._hedge_budget_for(sub.tenant)
+            if budget is None or now - sub.submitted_at <= budget:
                 continue
             routed = set(self._route[rid])
             # the budget is a TTFT budget: a primary that has already
@@ -445,15 +611,21 @@ class ServingFrontend:
             self._route[rid].append(tgt.replica_id)
             tgt.submit_sub(sub)
             self.metrics.incr("hedges_fired")
+            self._rep_counters[tgt.replica_id]["hedges"] += 1
             self.metrics.transition("hedge", req=rid, primary=primary,
                                     secondary=tgt.replica_id)
 
     def _update_mode(self):
-        """The overload ladder. Escalation requires the load fraction
-        to hold above the threshold for ``sustain_rounds`` consecutive
-        pump rounds (a burst is not an overload); de-escalation is
-        symmetric. Every flip is banked."""
-        frac = self.total_inflight / self.capacity
+        """The BUILT-IN overload ladder (``mode_control="load"``).
+        Escalation requires the load fraction to hold above the
+        threshold for ``sustain_rounds`` consecutive pump rounds (a
+        burst is not an overload); de-escalation is symmetric. Every
+        flip is banked. With ``mode_control="external"`` this is a
+        no-op — `set_mode` (the autopilot's actuator) owns the
+        ladder."""
+        if self.mode_control != "load":
+            return
+        frac = self.load_fraction
         cfg = self.cfg
         enter = (cfg.enter_shed if self.mode == "normal"
                  else cfg.enter_degraded)
@@ -470,26 +642,116 @@ class ServingFrontend:
             self._flip_mode(nxt, frac)
             self._above = 0
             if nxt == "shedding":
-                # first relief valve: sheddable-class load goes first
-                for rid in list(self._live):
-                    sub = self._subs[rid]
-                    if (sub.qos == "sheddable"
-                            and rid not in self._shed_rids):
-                        self._shed(sub, "shed (overload)")
+                self._shed_all_sheddable()
         elif self._below >= cfg.sustain_rounds:
             self._flip_mode("normal", frac)
             self._below = 0
 
-    def _flip_mode(self, new_mode: str, frac: float):
+    def _shed_all_sheddable(self):
+        """First relief valve on entering shedding: sheddable-class
+        load goes first."""
+        for rid in list(self._live):
+            sub = self._subs[rid]
+            if sub.qos == "sheddable" and rid not in self._shed_rids:
+                self._shed(sub, "shed (overload)")
+
+    def _flip_mode(self, new_mode: str, frac: float, **extra):
         old, self.mode = self.mode, new_mode
         fields = dict(frm=old, to=new_mode, load_fraction=round(frac, 4),
                       inflight=self.total_inflight,
-                      capacity=self.capacity)
+                      capacity=self.capacity, **extra)
         if new_mode == "degraded":
             fields["max_new_tokens_cap"] = \
                 self.cfg.degrade.max_new_tokens_cap
             fields["cache_dtype"] = str(self.cfg.degrade.cache_dtype)
         self.metrics.transition("mode", **fields)
+
+    # ---- the actuation surface (docs/autopilot.md) ----------------------
+
+    def set_mode(self, mode: str, *, by: str = "operator", **evidence):
+        """Flip the overload mode directly (the external-control
+        actuator; also works alongside the load ladder — the ladder
+        just keeps walking from the new rung). Entering
+        shedding-or-worse from normal sheds sheddable load, same as
+        the ladder. Banked with the caller and its evidence."""
+        if mode not in MODES:
+            raise ValueError(f"unknown mode {mode!r}; one of {MODES}")
+        if mode == self.mode:
+            return
+        was = self.mode
+        self._flip_mode(mode, self.load_fraction, by=by, **evidence)
+        self._above = self._below = 0
+        if MODES.index(mode) >= 1 and MODES.index(was) < 1:
+            self._shed_all_sheddable()
+
+    def add_replica(self, *, by: str = "operator", **evidence) -> int:
+        """Grow the fleet by one supervised replica (started when the
+        frontend is threaded). Returns the new replica id. A replica
+        built while degraded rides the degrade profile's cache dtype,
+        same as a degraded restart."""
+        rep = self._new_replica()
+        if self._threaded:
+            rep.start()
+        self.metrics.transition("replica_added", replica=rep.replica_id,
+                                n_replicas=len(self.replicas),
+                                n_alive=self.n_alive, by=by, **evidence)
+        return rep.replica_id
+
+    def retire_replica(self, replica_id: Optional[int] = None, *,
+                       by: str = "operator",
+                       **evidence) -> Optional[int]:
+        """Begin draining one replica toward retirement (the
+        least-loaded alive one when unspecified): it takes no new
+        routes, finishes its in-flight work, then stops. Returns the
+        retiring id, or None when nothing is retirable (never drains
+        the last routable replica). The supervisor object stays in
+        ``replicas`` — ids are route indices."""
+        if replica_id is None:
+            cands = self._alive()
+            if len(cands) <= 1:
+                return None
+            # least-loaded; ties go to the newest (scale-down unwinds
+            # scale-up)
+            rep = min(cands, key=lambda r: (r.load, -r.replica_id))
+        else:
+            # an unknown id (stale replay of a banked transition) is
+            # "nothing retirable", not a crash; ids are route indices,
+            # so a negative index must not alias from the end
+            if not 0 <= int(replica_id) < len(self.replicas):
+                return None
+            rep = self.replicas[replica_id]
+            if (rep.state not in ("new", "alive")
+                    or rep.replica_id in self._retiring
+                    or len(self._alive()) <= 1):
+                return None
+        self._retiring.add(rep.replica_id)
+        self.metrics.transition("replica_retiring",
+                                replica=rep.replica_id,
+                                inflight=rep.n_inflight,
+                                n_alive=self.n_alive, by=by, **evidence)
+        return rep.replica_id
+
+    def set_admission_limit(self, limit: Optional[int], *,
+                            by: str = "operator", **evidence):
+        """Admission setpoint: cap `capacity` below the structural
+        ``n_alive * capacity_per_replica``. None clears it."""
+        self._admission_limit = (None if limit is None
+                                 else max(1, int(limit)))
+        self.metrics.transition("admission_limit",
+                                limit=self._admission_limit,
+                                by=by, **evidence)
+
+    def set_hedge_budget(self, budget_s: Optional[float],
+                         tenant: Optional[str] = None, *,
+                         by: str = "operator", **evidence):
+        """Install a fitted TTFT/hedge budget (None disables hedging)
+        for one tenant, or the fitted default when ``tenant`` is None.
+        Unfitted tenants keep ``cfg.hedge_after_s``."""
+        self._hedge_budgets[tenant] = (None if budget_s is None
+                                       else float(budget_s))
+        self.metrics.transition("hedge_budget", tenant=tenant,
+                                budget_s=self._hedge_budgets[tenant],
+                                by=by, **evidence)
 
     # ---- introspection --------------------------------------------------
 
@@ -497,12 +759,29 @@ class ServingFrontend:
         return [r.state for r in self.replicas]
 
     def summary(self) -> dict:
+        """ONE structured snapshot: the whole-run + rolling-window
+        metrics, the mode-transition history, and per-replica
+        supervision/restart/hedge/shed counters — the autopilot's
+        input and the drills' assertion surface (schema:
+        docs/serving.md § Frontend summary)."""
         s = self.metrics.summary()
         s["mode"] = self.mode
+        s["mode_history"] = [t for t in self.metrics.transitions
+                             if t["event"] == "mode"]
+        s["n_replicas"] = len(self.replicas)
+        s["n_alive"] = self.n_alive
+        s["capacity"] = self.capacity
+        s["inflight"] = self.total_inflight
+        s["load_fraction"] = round(self.load_fraction, 4)
+        s["admission_limit"] = self._admission_limit
+        s["hedge_budgets"] = {("default" if t is None else t): b
+                              for t, b in self._hedge_budgets.items()}
         s["replicas"] = {
             r.replica_id: {"state": r.state, "restarts": r.restarts,
                            "generation": r.generation,
                            "engines_built": r.engines_built,
-                           "steps": r.steps}
+                           "steps": r.steps, "load": r.load,
+                           "retiring": r.replica_id in self._retiring,
+                           **self._rep_counters[r.replica_id]}
             for r in self.replicas}
         return s

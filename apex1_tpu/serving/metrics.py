@@ -25,13 +25,27 @@ drills to assert on.
 Failure-path counters (`incr`) ride `summary()["counters"]`: retries,
 hedges fired/won, sheds, evictions, replica restarts — the numbers an
 operator pages on, always present (0 when the path never fired).
+
+Two control-loop extensions (docs/autopilot.md):
+
+- **Rolling window**: whole-run aggregates freeze late-run signal under
+  early history (an hour of healthy traffic pins p99 no matter what the
+  last minute did), so the last ``window`` TERMINAL requests also land
+  in a ring buffer and `summary()["window"]` reports per-class /
+  per-tenant latency+TTFT percentiles over just that ring — the
+  autopilot's control signal. Whole-run fields keep their meaning.
+- **Injectable clock**: ``clock`` replaces `obs.spine.monotonic` as the
+  timestamp source, so `testing.fleetsim` can stamp every event with
+  VIRTUAL time and two replays of one trace produce bit-identical
+  event histories.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, Optional
+from collections import deque
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -59,6 +73,8 @@ class RequestRecord:
     t_done: Optional[float] = None
     status: str = "queued"
     reason: str = ""
+    qos: Optional[str] = None     # set when the queued event carries it
+    tenant: Optional[str] = None  #  (frontend lifecycle records do)
 
     @property
     def ttft(self) -> Optional[float]:
@@ -76,6 +92,12 @@ class RequestRecord:
     def latency(self) -> Optional[float]:
         if self.t_queued is None or self.t_done is None:
             return None
+        if self.status == "rejected":
+            # a refusal is terminal at its queued instant — calling
+            # that "0.0s latency" would deflate every percentile the
+            # control loop reads (a flood of rejections must read as
+            # missing done-rate, not as excellent latency)
+            return None
         return self.t_done - self.t_queued
 
 
@@ -84,11 +106,18 @@ class ServingMetrics:
     every event a JSON line; omit it for in-memory-only collection
     (tests, benches that only want `summary()`)."""
 
-    def __init__(self, logger: Optional[MetricsLogger] = None):
+    def __init__(self, logger: Optional[MetricsLogger] = None, *,
+                 window: int = 128,
+                 clock: Optional[Callable[[], float]] = None):
         self.logger = logger
+        self._clock = clock or spine.monotonic
         self.records: Dict[int, RequestRecord] = {}
         self.counters: Dict[str, int] = {}
         self.transitions: list = []
+        # the last `window` TERMINAL requests (qos/tenant/ttft/latency/
+        # status) — the rolling control signal summary()["window"]
+        # reports; deque drops the oldest, O(window) space forever
+        self._window: deque = deque(maxlen=max(1, int(window)))
         # step samples fold into RUNNING aggregates (count / occupancy
         # sum / peak queue) — a long-lived engine steps indefinitely,
         # so per-step dicts would leak host memory (review finding);
@@ -97,7 +126,7 @@ class ServingMetrics:
         self._occ_sum = 0.0
         self._peak_queue = 0
         self._event_seq = 0
-        self._t0 = spine.monotonic()
+        self._t0 = self._clock()
         # submit (and its queued/rejected events) may run on an ingest
         # thread (`runtime.RequestFeeder`) while the engine loop logs
         # token/terminal events — same cross-thread pattern the
@@ -108,7 +137,7 @@ class ServingMetrics:
 
     def event(self, req_id: int, name: str, now: Optional[float] = None,
               **fields) -> RequestRecord:
-        now = spine.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             return self._event_locked(req_id, name, now, fields)
 
@@ -121,6 +150,10 @@ class ServingMetrics:
             rec.status = "queued"
             rec.t_queued = now
             rec.n_prompt = int(fields.get("n_prompt", 0))
+            if fields.get("qos") is not None:
+                rec.qos = str(fields["qos"])
+            if fields.get("tenant") is not None:
+                rec.tenant = str(fields["tenant"])
         elif name == "prefill":
             rec.status = "prefill"
             rec.t_prefill = now
@@ -136,6 +169,9 @@ class ServingMetrics:
             rec.reason = str(fields.get("reason", ""))
             rec.n_generated = int(fields.get("n_generated",
                                              rec.n_generated))
+            self._window.append(
+                (rec.qos or "best_effort", rec.tenant, name,
+                 rec.ttft, rec.latency))
         else:
             raise ValueError(f"unknown lifecycle event {name!r}")
         if name != "token":
@@ -172,7 +208,7 @@ class ServingMetrics:
         transition is a JSON line when a logger is wired AND kept in
         ``transitions`` — the overload drill asserts each degradation
         step left a banked record."""
-        now = spine.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         rec = {"event": str(name), "t": now - self._t0, **fields}
         # rec's engine-relative "t" must NOT land on spine.emit's `t`
         # parameter (run-relative axis) — same origin rule as above
@@ -217,15 +253,20 @@ class ServingMetrics:
 
     def summary(self) -> dict:
         """Aggregate view: counts per terminal status, throughput over
-        the engine's wall clock, TTFT percentiles, occupancy."""
+        the engine's wall clock, TTFT percentiles, occupancy — plus
+        ``window``: the same percentiles per QoS class / tenant over
+        only the last ``window`` terminal requests (the rolling control
+        signal; whole-run fields keep their life-of-the-engine
+        meaning)."""
         with self._lock:
             recs = list(self.records.values())
             counters = dict(self.counters)
+            win = list(self._window)
         done = [r for r in recs if r.status == "done"]
         ttfts = sorted(r.ttft for r in recs if r.ttft is not None)
         lats = sorted(r.latency for r in recs if r.latency is not None)
         gen = sum(r.n_generated for r in recs)
-        wall = max(spine.monotonic() - self._t0, 1e-9)
+        wall = max(self._clock() - self._t0, 1e-9)
         out = {
             "requests": len(recs),
             "done": len(done),
@@ -249,4 +290,50 @@ class ServingMetrics:
         if self._step_n:
             out["mean_occupancy"] = self._occ_sum / self._step_n
             out["peak_queue_depth"] = self._peak_queue
+        out["window"] = self._window_summary(win)
         return out
+
+    def window_summary(self) -> dict:
+        """Just ``summary()["window"]`` — O(window), no whole-run
+        percentile sorts under the lock. The control loop's per-tick
+        read (whole-run sorts grow with every request ever served;
+        a 10 Hz controller must not pay that, nor stall the ingest
+        thread's `event()` calls while it does)."""
+        with self._lock:
+            win = list(self._window)
+        return self._window_summary(win)
+
+    @staticmethod
+    def _window_summary(win: list) -> dict:
+        """Per-class / per-tenant percentiles over the ring entries
+        ``(qos, tenant, status, ttft, latency)``. Percentile keys only
+        appear when the class has data — same contract as the
+        whole-run fields."""
+        def stats(entries, *, with_latency=True):
+            d = {"n": len(entries),
+                 "done": sum(e[2] == "done" for e in entries)}
+            ttfts = sorted(e[3] for e in entries if e[3] is not None)
+            lats = sorted(e[4] for e in entries if e[4] is not None)
+            if ttfts:
+                d["ttft_p50_ms"] = 1e3 * float(np.percentile(ttfts, 50))
+                d["ttft_p99_ms"] = 1e3 * float(np.percentile(ttfts, 99))
+            if with_latency and lats:
+                d["latency_p50_ms"] = 1e3 * float(np.percentile(lats, 50))
+                d["latency_p99_ms"] = 1e3 * float(np.percentile(lats, 99))
+            return d
+
+        by_class: Dict[str, list] = {}
+        by_tenant: Dict[str, list] = {}
+        for e in win:
+            by_class.setdefault(e[0], []).append(e)
+            if e[1] is not None:
+                by_tenant.setdefault(e[1], []).append(e)
+        return {
+            "size": len(win),
+            "per_class": {c: stats(es)
+                          for c, es in sorted(by_class.items())},
+            # tenants feed the per-tenant hedge/TTFT budget fit, which
+            # only needs the TTFT distribution
+            "per_tenant": {t: stats(es, with_latency=False)
+                           for t, es in sorted(by_tenant.items())},
+        }

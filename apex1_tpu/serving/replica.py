@@ -128,9 +128,14 @@ class ReplicaSupervisor:
                  replica_id: int = 0, *,
                  config: Optional[ReplicaConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 fault=None, seed: int = 0):
+                 fault=None, seed: int = 0,
+                 clock: Optional[Callable[[], float]] = None):
         self.make_engine = make_engine
         self.replica_id = int(replica_id)
+        self.clock = clock or time.monotonic  # injectable so
+        #  testing.fleetsim can drive pump-mode supervision on VIRTUAL
+        #  time (deterministic replay); threaded mode needs a real
+        #  clock — heartbeats race the wall there by design
         self.seed = int(seed)         # base for derived request seeds —
         #  the supervisor pins seeds BEFORE the engine sees a request
         #  (resubmission may land on a fresh engine), so the engine's
@@ -147,7 +152,7 @@ class ReplicaSupervisor:
         self.steps = 0
         self.engines_built = 0
         self.step_ewma = 0.0          # smoothed iteration wall time —
-        self.heartbeat = time.monotonic()  # the router's feasibility prior
+        self.heartbeat = self.clock()      # the router's feasibility prior
         self.last_error: Optional[BaseException] = None
         self._inbox: deque = deque()  # ("submit", Submission)|("cancel", rid)
         self._inflight: Dict[int, Submission] = {}
@@ -179,7 +184,7 @@ class ReplicaSupervisor:
             # deterministically, instead of crashing the engine step
             seed=int(seed) & 0x7FFFFFFF, prefix=prefix,
             deadline=deadline, qos=qos,
-            tenant=tenant, submitted_at=time.monotonic())
+            tenant=tenant, submitted_at=self.clock())
         self.submit_sub(sub)
         return rid
 
@@ -273,7 +278,7 @@ class ReplicaSupervisor:
         """Spawn the serve thread (production mode). `pump` is the
         inline alternative; don't mix the two for one generation."""
         self.state = "alive"
-        self.heartbeat = time.monotonic()
+        self.heartbeat = self.clock()
         gen = self.generation
         self._thread = threading.Thread(
             target=self._serve, args=(gen,), daemon=True,
@@ -294,14 +299,14 @@ class ReplicaSupervisor:
         done = 0
         for _ in range(iterations):
             fresh = self.engine is None   # this iteration pays the
-            t0 = time.monotonic()         # engine build + first-call
+            t0 = self.clock()             # engine build + first-call
             try:                          # XLA compiles
                 self._ensure_engine()
                 self._iterate(gen)
             except BaseException as e:
                 self._mark_dead(e)
                 return done
-            took = time.monotonic() - t0
+            took = self.clock() - t0
             if not fresh:
                 self._observe_step(took)
             if not fresh and took > self.cfg.watchdog_s:
@@ -325,7 +330,7 @@ class ReplicaSupervisor:
             return self.state not in ("dead", "failed")
         if self._thread is None:      # pump mode: liveness is state
             return True
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         if now - self.heartbeat > self.cfg.watchdog_s:
             self._mark_dead(ReplicaKilled(
                 f"watchdog: no heartbeat for {now - self.heartbeat:.3f}s"))
@@ -388,7 +393,7 @@ class ReplicaSupervisor:
             error=repr(self.last_error))
         self.last_error = None
         self.state = "alive"
-        self.heartbeat = time.monotonic()
+        self.heartbeat = self.clock()
         if threaded:
             gen = self.generation
             self._thread = threading.Thread(
@@ -433,10 +438,10 @@ class ReplicaSupervisor:
             while not self._stop.is_set():
                 if gen != self.generation:
                     return            # abandoned: a new gen owns state
-                t0 = time.monotonic()
+                t0 = self.clock()
                 self._iterate(gen)
                 if gen == self.generation:
-                    self.heartbeat = time.monotonic()
+                    self.heartbeat = self.clock()
                     self._observe_step(self.heartbeat - t0)
                 if self.idle:
                     time.sleep(self.cfg.idle_sleep_s)

@@ -1,0 +1,454 @@
+"""Replayable fleet simulator — drill the serving fleet (and its
+autopilot) on a VIRTUAL clock, deterministically, at scales tier-1 can
+afford.
+
+The real multi-replica frontend is driven exactly as production drives
+it — real `ServingFrontend`, real `ReplicaSupervisor` pump loops, real
+`Engine`s over `testing.chaos.toy_decoder` — but every clock the
+serving tier reads is this module's `VirtualClock`, advanced a fixed
+``dt_s`` per supervision round. That closes every nondeterminism hole
+at once:
+
+- **Time** is simulated: latency/TTFT percentiles, hedge budgets, and
+  mode-transition timestamps are functions of queueing structure, not
+  of how loaded the CI box is.
+- **Arrivals** are a `Trace`: either synthetic (``bursty`` /
+  ``diurnal`` / ``adversarial_overload`` generators, seed-keyed) or
+  recorded (`Trace.load` of a banked JSONL). Request ids are the trace
+  indices, so derived sampling seeds — and therefore every token — are
+  functions of (trace, seed) alone.
+- **Faults** are seed-keyed `testing.chaos` schedules firing at exact
+  (replica, step) coordinates.
+
+Same (trace, seed) ⇒ bit-identical episode: `SimReport.fingerprint`
+hashes the full transition history, every autopilot actuation, and
+every request's outcome INCLUDING its token stream — the determinism
+drills pin two runs' fingerprints equal.
+
+What this does and does NOT prove (docs/autopilot.md): it proves
+control-loop LOGIC — detection, hysteresis, actuation ordering,
+recovery, SLO arithmetic — against real serving code paths. It does
+not prove wall-clock numbers: virtual seconds cost nothing, so a
+simulated "p99 = 0.4s" says nothing about silicon latency, and
+replica restarts are free of XLA recompile time. Hardware claims stay
+with the banked-bench queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "VirtualClock", "SimRequest", "Trace", "synthetic_trace",
+    "FleetSimConfig", "FleetSim", "SimReport", "run_fleet",
+]
+
+TRACE_SCHEMA = "apex1-fleettrace-v1"
+TRACE_KINDS = ("steady", "bursty", "diurnal", "adversarial_overload")
+
+
+class VirtualClock:
+    """The one clock of a simulated episode. Callable (drop-in for
+    ``time.monotonic``), advanced only by the simulator."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += float(dt)
+        return self.t
+
+
+@dataclasses.dataclass(frozen=True)
+class SimRequest:
+    """One arrival: WHEN it lands and its admission contract. Prompt
+    tokens are derived, not stored — request index x trace seed keys a
+    deterministic draw, so a trace file stays a few bytes per
+    request."""
+
+    t: float
+    qos: str
+    tenant: str
+    prompt_len: int
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class Trace:
+    """An arrival trace: replayable input to `FleetSim`. ``seed`` keys
+    BOTH the generator that built it and the per-request prompt-token
+    draws at replay."""
+
+    kind: str
+    seed: int
+    horizon_s: float
+    requests: List[SimRequest]
+
+    def fingerprint(self) -> str:
+        doc = {"schema": TRACE_SCHEMA, "kind": self.kind,
+               "seed": self.seed, "horizon_s": self.horizon_s,
+               "requests": [dataclasses.astuple(r)
+                            for r in self.requests]}
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()).hexdigest()[:16]
+
+    def save(self, path: str) -> str:
+        """Bank as JSONL (header + one line per arrival) — the
+        'recorded trace' format `load` replays."""
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"schema": TRACE_SCHEMA, "kind": self.kind,
+                 "seed": self.seed, "horizon_s": self.horizon_s,
+                 "n": len(self.requests)}) + "\n")
+            for r in self.requests:
+                f.write(json.dumps(dataclasses.astuple(r)) + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with open(path, encoding="utf-8") as f:
+            head = json.loads(f.readline())
+            if head.get("schema") != TRACE_SCHEMA:
+                raise ValueError(
+                    f"{path}: not a {TRACE_SCHEMA} trace "
+                    f"(schema={head.get('schema')!r})")
+            reqs = [SimRequest(float(t), str(q), str(tn), int(pl),
+                               int(mn))
+                    for t, q, tn, pl, mn in map(json.loads, f)]
+        return cls(kind=str(head["kind"]), seed=int(head["seed"]),
+                   horizon_s=float(head["horizon_s"]), requests=reqs)
+
+
+def synthetic_trace(kind: str, *, seed: int, horizon_s: float = 8.0,
+                    base_rate: float = 25.0,
+                    class_mix: Optional[Dict[str, float]] = None,
+                    tenants: tuple = ("acme", "zeta"),
+                    prompt_lens: tuple = (3, 9),
+                    new_tokens: tuple = (4, 10),
+                    burst_mult: float = 5.0,
+                    burst_len_s: float = 0.6,
+                    n_bursts: int = 3,
+                    diurnal_period_s: float = 4.0,
+                    overload_mult: float = 3.0,
+                    overload_span: tuple = (0.3, 0.8)) -> Trace:
+    """Seed-keyed arrival generator (inhomogeneous Poisson via
+    thinning). Kinds:
+
+    - ``steady``: flat ``base_rate`` req/s.
+    - ``bursty``: flat base + ``n_bursts`` seed-placed windows at
+      ``burst_mult`` x base — the anti-flap fixture (each burst is
+      shorter than any honest sustain threshold).
+    - ``diurnal``: sinusoidal rate between ~0.3x and 1x base.
+    - ``adversarial_overload``: base rate outside
+      ``overload_span`` (fractions of the horizon), ``overload_mult``
+      x base inside — sustained past any burst filter, the headline
+      drill's input.
+    """
+    if kind not in TRACE_KINDS:
+        raise ValueError(f"unknown trace kind {kind!r}; "
+                         f"one of {TRACE_KINDS}")
+    mix = dict(class_mix or {"guaranteed": 0.5, "best_effort": 0.25,
+                             "sheddable": 0.25})
+    classes = sorted(mix)
+    probs = np.asarray([mix[c] for c in classes], float)
+    probs = probs / probs.sum()
+    rng = np.random.default_rng(
+        [int(seed), TRACE_KINDS.index(kind), 0xF1EE7])
+    if kind == "bursty":
+        starts = np.sort(rng.uniform(
+            0.0, max(horizon_s - burst_len_s, 0.0), int(n_bursts)))
+    t_on, t_off = (overload_span[0] * horizon_s,
+                   overload_span[1] * horizon_s)
+
+    def rate(t: float) -> float:
+        if kind == "steady":
+            return base_rate
+        if kind == "bursty":
+            hot = any(s <= t < s + burst_len_s for s in starts)
+            return base_rate * (burst_mult if hot else 1.0)
+        if kind == "diurnal":
+            phase = math.sin(2.0 * math.pi * t / diurnal_period_s)
+            return base_rate * (0.65 + 0.35 * phase)
+        return base_rate * (overload_mult if t_on <= t < t_off else 1.0)
+
+    rmax = base_rate * max(burst_mult, overload_mult, 1.0)
+    reqs: List[SimRequest] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rmax))
+        if t >= horizon_s:
+            break
+        if rng.uniform() >= rate(t) / rmax:
+            continue                    # thinned
+        reqs.append(SimRequest(
+            t=round(t, 6),
+            qos=classes[int(rng.choice(len(classes), p=probs))],
+            tenant=str(tenants[int(rng.integers(len(tenants)))]),
+            prompt_len=int(rng.integers(prompt_lens[0],
+                                        prompt_lens[1] + 1)),
+            max_new_tokens=int(rng.integers(new_tokens[0],
+                                            new_tokens[1] + 1))))
+    return Trace(kind=kind, seed=int(seed), horizon_s=float(horizon_s),
+                 requests=reqs)
+
+
+@dataclasses.dataclass
+class FleetSimConfig:
+    """Simulator knobs (the serving knobs ride the `FrontendConfig`
+    the caller passes). ``dt_s`` is the virtual cost of ONE
+    supervision round — i.e. one decode step per replica — so a
+    replica's service rate is ``slots / (max_new_tokens * dt_s)``
+    req/s; provisioning arithmetic in the drills builds on that."""
+
+    dt_s: float = 0.02
+    control_interval_s: float = 0.1   # autopilot tick cadence (virtual)
+    slots_per_replica: int = 4
+    max_len: int = 48
+    prefill_chunk: int = 4
+    temperature: float = 0.8          # nonzero: determinism claims
+    #                                   cover real sampling, not greedy
+    vocab: int = 61                   # toy_decoder's default
+    drain_grace_s: float = 30.0       # virtual time allowed past the
+    #                                   horizon before declaring wedged
+    max_rounds: int = 500_000         # hard stop (wedged episode)
+
+
+@dataclasses.dataclass
+class SimReport:
+    """One episode's outcome — everything the drills assert on."""
+
+    trace_kind: str
+    trace_seed: int
+    trace_fingerprint: str
+    n_arrivals: int
+    n_submitted: int
+    rejected: Dict[str, int]          # per class, at the front door
+    outcomes: List[dict]              # per request: idx/qos/tenant/
+    #                                   status/latency/ttft/token digest
+    transitions: List[dict]           # full banked transition history
+    actions: List[dict]               # autopilot episode log ([] if off)
+    summary: dict                     # frontend.summary() at the end
+    virtual_s: float
+    rounds: int
+
+    def per_class(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for o in self.outcomes:
+            d = out.setdefault(o["qos"], {"n": 0, "done": 0, "full": 0,
+                                          "latencies": []})
+            d["n"] += 1
+            if o["status"] == "done":
+                d["done"] += 1
+                if o["full"]:
+                    d["full"] += 1
+                    if o["latency"] is not None:
+                        d["latencies"].append(o["latency"])
+        for cls, n in self.rejected.items():
+            out.setdefault(cls, {"n": 0, "done": 0, "full": 0,
+                                 "latencies": []})["n"] += n
+        return out
+
+    def latency_p99_s(self, qos: str) -> Optional[float]:
+        """Whole-episode p99 completion latency of the class's
+        full-service DONE requests (virtual seconds)."""
+        lats = self.per_class().get(qos, {}).get("latencies", [])
+        return float(np.percentile(lats, 99)) if lats else None
+
+    def slo_attainment(self, qos: str, latency_s: float) -> float:
+        """Fraction of the class's OFFERED load (accepted + rejected)
+        that finished 'done', AT FULL SERVICE, within ``latency_s``.
+        A rejected or shed request is a miss — admission control must
+        not launder SLO misses into non-measurements — and so is a
+        degrade-capped truncation: answering 4 of the 10 requested
+        tokens fast is not meeting the SLO, it is a cheap way to fake
+        one (the static-panic sweep point exists to prove the
+        distinction matters)."""
+        d = self.per_class().get(qos)
+        if not d or d["n"] == 0:
+            return 1.0
+        ok = sum(1 for x in d["latencies"] if x <= latency_s)
+        return ok / d["n"]
+
+    def goodput_tok_s(self) -> float:
+        """Generated tokens of DONE requests per virtual second."""
+        tok = sum(o["n_tokens"] for o in self.outcomes
+                  if o["status"] == "done")
+        return tok / max(self.virtual_s, 1e-9)
+
+    def fingerprint(self) -> str:
+        """The bit-determinism surface: sha256 over the transition
+        history, the autopilot episode, and every request outcome
+        (status + token digest). Same (trace, seed) ⇒ same value."""
+        doc = {"trace": self.trace_fingerprint,
+               "transitions": self.transitions,
+               "actions": self.actions,
+               "outcomes": self.outcomes,
+               "rejected": self.rejected,
+               "rounds": self.rounds}
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+    def to_json(self) -> dict:
+        per = {cls: {"offered": d["n"], "done": d["done"],
+                     "full": d["full"]}
+               for cls, d in sorted(self.per_class().items())}
+        return {"trace": self.trace_kind, "seed": self.trace_seed,
+                "trace_fingerprint": self.trace_fingerprint,
+                "n_arrivals": self.n_arrivals,
+                "n_submitted": self.n_submitted,
+                "rejected": self.rejected, "per_class": per,
+                "goodput_tok_per_virtual_s":
+                    round(self.goodput_tok_s(), 2),
+                "n_actions": len(self.actions),
+                "n_transitions": len(self.transitions),
+                "virtual_s": round(self.virtual_s, 3),
+                "rounds": self.rounds,
+                "fingerprint": self.fingerprint()}
+
+
+class FleetSim:
+    """One simulated episode over a `Trace`.
+
+    ``frontend_config`` is the real `serving.FrontendConfig` under
+    test (static baseline or autopilot-driven); ``autopilot`` an
+    `autopilot.AutopilotConfig` to attach a controller (None = static
+    fleet); ``chaos`` a `testing.chaos.ServingFault`. The toy-decoder
+    engines, the virtual clock, and the shared metrics window are
+    owned here.
+    """
+
+    def __init__(self, trace: Trace, frontend_config, *,
+                 sim: Optional[FleetSimConfig] = None,
+                 autopilot=None, chaos=None):
+        from apex1_tpu.serving import (Engine, EngineConfig,
+                                       ServingFrontend)
+        from apex1_tpu.testing.chaos import toy_decoder
+
+        self.trace = trace
+        self.cfg = sim or FleetSimConfig()
+        self.clock = VirtualClock()
+        apply_fn, make_cache, params = toy_decoder(self.cfg.vocab)
+        ecfg = EngineConfig(
+            max_slots=self.cfg.slots_per_replica,
+            max_len=self.cfg.max_len,
+            prefill_chunk=self.cfg.prefill_chunk,
+            vocab_size=self.cfg.vocab,
+            temperature=self.cfg.temperature,
+            seed=frontend_config.seed)
+
+        def make_engine(cache_dtype=None):
+            return Engine(apply_fn, make_cache, params, ecfg,
+                          cache_dtype=cache_dtype)
+
+        # no explicit metrics=: the frontend's own default wiring
+        # (window from the config, our virtual clock) IS the
+        # production wiring the simulator claims to drive
+        self.front = ServingFrontend(make_engine, frontend_config,
+                                     fault=chaos, clock=self.clock)
+        self.pilot = None
+        if autopilot is not None:
+            from apex1_tpu.autopilot import Autopilot
+            self.pilot = Autopilot(self.front, autopilot,
+                                   clock=self.clock)
+
+    def _prompt(self, idx: int, n: int) -> np.ndarray:
+        # prompt tokens are a pure function of (trace seed, request
+        # index): replaying the same trace re-derives identical prompts
+        rng = np.random.default_rng(
+            [int(self.trace.seed), 0x70C5, int(idx)])
+        return rng.integers(0, self.cfg.vocab, (n,)).astype(np.int32)
+
+    def run(self) -> SimReport:
+        from apex1_tpu.serving import Backpressure
+
+        trace, cfg, front = self.trace, self.cfg, self.front
+        reqs = trace.requests
+        rejected: Dict[str, int] = {}
+        submitted: Dict[int, int] = {}   # rid (== trace idx) -> idx
+        i = 0
+        rounds = 0
+        next_ctl = 0.0
+        deadline = trace.horizon_s + cfg.drain_grace_s
+        while i < len(reqs) or front.total_inflight > 0:
+            now = self.clock()
+            if now > deadline or rounds >= cfg.max_rounds:
+                raise TimeoutError(
+                    f"fleetsim wedged: {front.total_inflight} in "
+                    f"flight at virtual t={now:.2f}s "
+                    f"(deadline {deadline:.2f}s, round {rounds}; "
+                    f"replicas {front.replica_states()})")
+            while i < len(reqs) and reqs[i].t <= now:
+                r = reqs[i]
+                try:
+                    front.submit(self._prompt(i, r.prompt_len),
+                                 max_new_tokens=r.max_new_tokens,
+                                 qos=r.qos, tenant=r.tenant,
+                                 req_id=i)  # trace idx = stable id ⇒
+                    #  derived seeds (and tokens) replay bit-identical
+                    submitted[i] = i
+                except Backpressure:
+                    rejected[r.qos] = rejected.get(r.qos, 0) + 1
+                i += 1
+            front.pump(1)
+            if self.pilot is not None and now + 1e-12 >= next_ctl:
+                self.pilot.tick()
+                next_ctl += cfg.control_interval_s
+            self.clock.advance(cfg.dt_s)
+            rounds += 1
+        return self._report(submitted, rejected, rounds)
+
+    def _report(self, submitted: Dict[int, int],
+                rejected: Dict[str, int], rounds: int) -> SimReport:
+        front, trace = self.front, self.trace
+        outcomes = []
+        for rid in sorted(submitted):
+            res = front.poll(rid)
+            rec = front.metrics.records.get(rid)
+            req = trace.requests[rid]
+            toks = res.tokens if res is not None else np.zeros(0)
+            n_tokens = int(np.asarray(toks).size)
+            outcomes.append({
+                "idx": rid, "qos": req.qos, "tenant": req.tenant,
+                "status": res.status if res else "lost",
+                # full service = every REQUESTED token delivered (a
+                # degrade-capped truncation is not a fulfilled request)
+                "full": bool(res is not None and res.status == "done"
+                             and n_tokens >= req.max_new_tokens),
+                "latency": (None if rec is None or rec.latency is None
+                            else round(rec.latency, 6)),
+                "ttft": (None if rec is None or rec.ttft is None
+                         else round(rec.ttft, 6)),
+                "n_tokens": n_tokens,
+                "tokens_sha1": hashlib.sha1(
+                    np.ascontiguousarray(
+                        np.asarray(toks, np.int32)).tobytes()
+                ).hexdigest()[:12]})
+        return SimReport(
+            trace_kind=trace.kind, trace_seed=trace.seed,
+            trace_fingerprint=trace.fingerprint(),
+            n_arrivals=len(trace.requests),
+            n_submitted=len(submitted),
+            rejected=dict(sorted(rejected.items())),
+            outcomes=outcomes,
+            transitions=list(front.metrics.transitions),
+            actions=(list(self.pilot.actions) if self.pilot else []),
+            summary=front.summary(),
+            virtual_s=self.clock(), rounds=rounds)
+
+
+def run_fleet(trace: Trace, frontend_config, *,
+              sim: Optional[FleetSimConfig] = None, autopilot=None,
+              chaos=None) -> SimReport:
+    """Build + run one episode (the one-call form the drills and
+    benches use)."""
+    return FleetSim(trace, frontend_config, sim=sim,
+                    autopilot=autopilot, chaos=chaos).run()

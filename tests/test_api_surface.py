@@ -144,6 +144,12 @@ SURFACE = {
         "roofline", "kernel_cases", "flash_flops_bytes",
         "linear_xent_flops", "ring_attention_comms",
         "sp_boundary_comms", "allreduce_bytes"],
+    "apex1_tpu.autopilot": [
+        "Autopilot", "AutopilotConfig", "SLOTarget", "FleetView",
+        "ControllerState", "Action", "decide", "default_slo"],
+    "apex1_tpu.testing.fleetsim": [
+        "VirtualClock", "SimRequest", "Trace", "synthetic_trace",
+        "FleetSimConfig", "FleetSim", "SimReport", "run_fleet"],
     "apex1_tpu.planner": [
         "ModelShape", "Layout", "Violation", "BANKED_SHAPES",
         "check_layout", "check_plan_model", "enumerate_layouts",

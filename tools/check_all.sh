@@ -22,10 +22,14 @@ echo "== graftlint kernels (APX1xx + APX2xx: JAX hazards, Pallas semaphore/DMA p
 python tools/lint.py --kernels
 echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
 python tools/tune_kernels.py --validate
+echo "== drift gate (calibrated_ratio bands + re-fit drift over the banked perf_results corpus; jax-free, fail-closed) =="
+python tools/check_drift.py
 echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --smoke
 echo "== serving chaos smoke (replica-kill token parity + poison quarantine, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --serve-smoke
+echo "== autopilot smoke (static ladder sweep misses SLO, autopilot holds it, replay bit-identical; CPU) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.autopilot --smoke
 echo "== obs smoke (CPU trace -> per-op report -> calibration fit, non-empty) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.obs --smoke
 echo "== planner smoke (enumerate -> price -> emit -> llama_3d dryrun from the plan, CPU mesh) =="
