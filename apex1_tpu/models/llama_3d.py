@@ -621,10 +621,10 @@ def build_step(cfg: Llama3DConfig, mesh):
     import optax
 
     from apex1_tpu.core import loss_scale as ls
-    from apex1_tpu.optim.fused_adam import FusedAdamState, fused_adam
+    from apex1_tpu.optim.fused_adam import FusedAdamState
 
     m = cfg.model
-    tx = fused_adam(cfg.learning_rate)
+    tx = _make_tx(cfg)
     scaler = _make_scaler(cfg)
     param_specs = {"chunk": chunk_param_specs(cfg),
                    "shared": shared_param_specs()}
@@ -687,14 +687,25 @@ def build_step(cfg: Llama3DConfig, mesh):
     return step, state_specs, data_spec, tx
 
 
-def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
-    """Returns ``(step, state, data_spec)`` with a materialized initial
-    state, fused Adam on fp32 masters. ``params`` overrides the random
-    init (e.g. `from_llama_params` output)."""
-    if mesh is None:
-        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, cp=cfg.cp, ep=cfg.ep,
-                         tp=cfg.tp)
-    step, _state_specs, data_spec, tx = build_step(cfg, mesh)
+def _make_tx(cfg: Llama3DConfig):
+    """THE optimizer construction — `build_step` and `state_template`
+    both consume this one definition, so the trained state and the
+    restore/reshard template structurally cannot drift (a cfg-driven
+    optimizer change lands in both or neither)."""
+    from apex1_tpu.optim.fused_adam import fused_adam
+
+    return fused_adam(cfg.learning_rate)
+
+
+def state_template(cfg: Llama3DConfig, params=None):
+    """Host-side state pytree with the exact structure/shapes/dtypes
+    `make_train_step` trains — built WITHOUT a mesh or any device
+    count, which is what makes it usable as a checkpoint restore /
+    reshard template on a fleet that can no longer build the saving
+    topology (`resilience.reshard_checkpoint`,
+    `resilience.elastic_resume`). Shares `_make_tx` (and
+    `_make_scaler`) with `build_step`, so the two can't drift."""
+    tx = _make_tx(cfg)
     if params is None:
         chunk, shared = init_params(cfg)
         params = {"chunk": chunk, "shared": shared}
@@ -703,4 +714,16 @@ def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
     _scaler = _make_scaler(cfg)
     if _scaler is not None:
         state["scale"] = _scaler.init()
+    return state
+
+
+def make_train_step(cfg: Llama3DConfig, mesh=None, params=None):
+    """Returns ``(step, state, data_spec)`` with a materialized initial
+    state, fused Adam on fp32 masters. ``params`` overrides the random
+    init (e.g. `from_llama_params` output)."""
+    if mesh is None:
+        mesh = make_mesh(dp=cfg.dp, pp=cfg.pp, cp=cfg.cp, ep=cfg.ep,
+                         tp=cfg.tp)
+    step, _state_specs, data_spec, _tx = build_step(cfg, mesh)
+    state = state_template(cfg, params=params)
     return step, state, data_spec

@@ -121,6 +121,51 @@ def fsdp_param_specs(params, *, axis=AXIS_FSDP, min_size: int = 2 ** 12,
     return jax.tree_util.tree_map(spec, params)
 
 
+def flat_param_len(params) -> int:
+    """True (unpadded) length of the flat float buffer `flatten_tree`
+    packs for ``params`` — float leaves only, in tree order, exactly
+    the set `distributed_fused_adam`/`_lamb` shard. Host-side: this is
+    the reshard hook `resilience.reshard` uses to strip/re-apply the
+    per-world padding of a checkpointed ``…_shard`` buffer."""
+    return sum(int(np.prod(jnp.shape(p)) or 1)
+               for p in jax.tree_util.tree_leaves(params)
+               if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating))
+
+
+def shard_padded_len(n: int, world: int) -> int:
+    """Flat length after padding ``n`` to a multiple of ``world`` (the
+    `_pad` rule both distributed optimizers apply)."""
+    return int(n) + (-int(n)) % int(world)
+
+
+def repack_flat_shard(flat, *, flat_len: int, world_from: int,
+                      world_to: int) -> np.ndarray:
+    """Remap a GLOBAL flat optimizer-shard buffer (the host view of a
+    dp-sharded ``exp_avg_shard``-class leaf: ``world_from`` per-rank
+    slices concatenated) from one world size to another: strip the old
+    padding at ``flat_len``, zero-pad for ``world_to``.
+
+    Zero-padding is EXACT, not approximate: the padded tail of the
+    flat buffer carries zero params and zero grads on every step, so
+    Adam/LAMB moments there stay identically zero (``m = b1·0 +
+    (1-b1)·0``) — the repacked buffer equals what a from-scratch run
+    at ``world_to`` would have accumulated. Host-side numpy; the
+    reshard hook for `resilience.reshard_state`."""
+    a = np.asarray(flat)
+    if a.ndim != 1:
+        raise ValueError(f"flat shard buffer must be 1-D, got {a.shape}")
+    want = shard_padded_len(flat_len, world_from)
+    if a.shape[0] != want:
+        raise ValueError(
+            f"flat shard buffer has {a.shape[0]} elements, expected "
+            f"{want} (= {flat_len} padded for world {world_from})")
+    pad = shard_padded_len(flat_len, world_to) - int(flat_len)
+    core = a[:int(flat_len)]
+    if pad == 0:
+        return core.copy()
+    return np.concatenate([core, np.zeros((pad,), a.dtype)])
+
+
 class DistributedAdamState(NamedTuple):
     step: jnp.ndarray
     exp_avg_shard: jnp.ndarray     # (flat/N,) this rank's slice

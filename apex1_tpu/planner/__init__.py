@@ -28,28 +28,34 @@ caveats: docs/planner.md.
 
 from apex1_tpu.planner.cost import (calibration_factor, price_layout,
                                     step_flops)
-from apex1_tpu.planner.emit import (PLAN_SCHEMA, build_plan,
-                                    check_plan_model, layout_from_plan,
+from apex1_tpu.planner.emit import (PLAN_SCHEMA, PLAN_SPEC_KEYS,
+                                    build_plan, check_plan_model,
+                                    layout_from_plan,
                                     llama3d_config_from_plan, load_plan,
+                                    model_shape_from_plan,
                                     partition_rules, plan_json,
-                                    plan_param_specs, rules_to_specs,
-                                    save_plan)
+                                    plan_param_specs, plan_spec,
+                                    rules_to_specs, save_plan)
 from apex1_tpu.planner.layouts import (BANKED_SHAPES, SP_MODES, Layout,
                                        ModelShape, Violation,
                                        check_layout, enumerate_layouts)
 from apex1_tpu.planner.memory import (fit_check, hbm_breakdown,
                                       params_per_device)
 from apex1_tpu.planner.search import (PlanError, make_plan,
-                                      search_layouts)
+                                      plan_for_layout, search_layouts)
 
 __all__ = [
     "BANKED_SHAPES", "Layout", "ModelShape", "PLAN_SCHEMA",
+    "PLAN_SPEC_KEYS",
     "PlanError", "SP_MODES", "Violation", "build_plan",
     "calibration_factor", "check_layout", "check_plan_model",
     "enumerate_layouts",
     "fit_check", "hbm_breakdown", "layout_from_plan",
     "llama3d_config_from_plan", "load_plan", "make_plan",
-    "params_per_device", "partition_rules", "plan_json",
-    "plan_param_specs", "price_layout", "rules_to_specs", "save_plan",
+    "model_shape_from_plan",
+    "params_per_device", "partition_rules", "plan_for_layout",
+    "plan_json",
+    "plan_param_specs", "plan_spec", "price_layout", "rules_to_specs",
+    "save_plan",
     "search_layouts", "step_flops",
 ]

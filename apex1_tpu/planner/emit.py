@@ -180,6 +180,47 @@ def check_plan_model(plan: dict, shape: ModelShape) -> list:
             if pm.get(k) != getattr(shape, k)]
 
 
+#: the plan fields that define LAYOUT IDENTITY — two checkpoints are
+#: layout-compatible (restorable into each other's state without a
+#: reshard) iff their plan_spec dicts are equal. Pricing/provenance
+#: fields are deliberately excluded: a re-search against a newer
+#: calibration table that lands on the same layout is the SAME spec.
+PLAN_SPEC_KEYS = ("schema", "n_devices", "mesh", "schedule", "zero",
+                  "model")
+
+
+def plan_spec(plan: dict) -> dict:
+    """The layout-identity subset of a plan document (see
+    `PLAN_SPEC_KEYS`) — what `resilience.ResilientCheckpointer` banks
+    compares, and what `resilience.elastic_resume` checks to decide
+    "same layout, plain resume" vs "re-plan + reshard"."""
+    out = {}
+    for k in PLAN_SPEC_KEYS:
+        v = plan.get(k)
+        out[k] = dict(v) if isinstance(v, dict) else v
+    z = out.get("zero")
+    if isinstance(z, dict):
+        # the consumer pointer is documentation, not identity
+        out["zero"] = {"enabled": bool(z.get("enabled")),
+                       "axis": z.get("axis")}
+    return out
+
+
+def model_shape_from_plan(plan: dict) -> ModelShape:
+    """Round-trip the banked model dims back into a `ModelShape` — the
+    input `search.make_plan` needs to re-plan the SAME model for a
+    different chip count (elastic resume reads the checkpoint's plan
+    meta, never the command line, for the model)."""
+    pm = dict(plan["model"])
+    fields = {f.name for f in dataclasses.fields(ModelShape)}
+    unknown = set(pm) - fields
+    if unknown or not set(pm) >= {"name", "num_layers"}:
+        raise ValueError(
+            f"plan model dims do not round-trip into ModelShape "
+            f"(unknown keys {sorted(unknown)})")
+    return ModelShape(**pm)
+
+
 def layout_from_plan(plan: dict) -> Layout:
     m, s = plan["mesh"], plan["schedule"]
     return Layout(dp=m["dp"], pp=m["pp"], cp=m["cp"], ep=m["ep"],

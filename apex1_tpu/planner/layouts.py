@@ -270,6 +270,7 @@ def enumerate_layouts(shape: ModelShape, n_devices: int, *,
                       allow_cp: bool = True,
                       allow_ep: Optional[bool] = None,
                       allow_zero: bool = True,
+                      require_zero: Optional[bool] = None,
                       sp_modes: Sequence[str] = ("overlap", "fused"),
                       microbatch_size: int = 1
                       ) -> Iterator[Layout]:
@@ -287,6 +288,13 @@ def enumerate_layouts(shape: ModelShape, n_devices: int, *,
     only when dp >= 2, ``sp_mode`` beyond the first only when tp >= 2
     (no SP boundary exists at tp=1) — otherwise the same physical
     config would be enumerated (and priced) twice.
+
+    ``require_zero`` (None = don't care) filters to layouts whose
+    ``zero`` flag MATCHES — the elastic-resume constraint: a
+    checkpoint's optimizer-state tree structure is fixed, so a re-plan
+    for a changed fleet must keep the ZeRO setting, not merely be
+    allowed to (`resilience.elastic_resume` passes the source plan's
+    setting here).
     """
     if allow_ep is None:
         allow_ep = shape.moe
@@ -314,6 +322,11 @@ def enumerate_layouts(shape: ModelShape, n_devices: int, *,
                         continue
                     zeros = (False, True) if (allow_zero and dp >= 2) \
                         else (False,)
+                    if require_zero is not None:
+                        zeros = tuple(z for z in zeros
+                                      if z == require_zero)
+                        if not zeros:
+                            continue
                     modes = tuple(sp_modes) if tp >= 2 \
                         else tuple(sp_modes[:1])
                     for zero in zeros:

@@ -118,6 +118,40 @@ def make_plan(shape: ModelShape, n_devices: int, *,
         provenance=provenance)
 
 
+def plan_for_layout(shape: ModelShape, layout: Layout, *,
+                    generation: Optional[str] = None,
+                    results_dir: Optional[str] = None,
+                    use_calibration: bool = True) -> dict:
+    """A full plan document for a STATED layout (no search): legality-
+    checked, priced, and emitted exactly like a searched plan, with
+    ``search.stated = True`` marking that nothing was enumerated.
+
+    This is what makes hand-picked runs self-describing: a training
+    loop driven by ``--dp 2 --pp 2 --tp 2`` can bank the same
+    ``apex1-plan-v1`` spec in its checkpoints that ``--plan auto``
+    would, so elastic resume (`resilience.elastic`) works from either.
+    An illegal layout raises :class:`PlanError` naming the rules; the
+    HBM verdict is recorded in ``memory`` but deliberately not
+    enforced — a stated layout is the operator's claim, and the AOT
+    gate stays the real guard."""
+    gen = generation or "v5e"
+    violations = check_layout(shape, layout)
+    if violations:
+        raise PlanError(
+            f"stated layout {layout.mesh_str()} is illegal for "
+            f"{shape.name}: "
+            + "; ".join(str(v) for v in violations))
+    price = cost.price_layout(shape, layout, generation=gen,
+                              results_dir=results_dir,
+                              use_calibration=use_calibration)
+    mem = memory.hbm_breakdown(shape, layout, gen)
+    return emit.build_plan(
+        shape, layout, price, mem, generation=gen,
+        search={"n_enumerated": 0, "n_hbm_rejected": 0,
+                "ranked_top": [], "stated": True},
+        provenance=_calibration_provenance(results_dir))
+
+
 def _calibration_provenance(results_dir: Optional[str] = None) -> dict:
     """Identity of the calibration table the prices rode on — banked
     fields only (deterministic for a given file; no clock reads)."""

@@ -14,6 +14,12 @@ story, built as four cooperating pieces (see `docs/robustness.md`):
 - `retry_call` / `backoff_delays` / `TransientError` (`.retry`): the one
   bounded-exponential-backoff-with-deterministic-jitter policy, shared
   with `runtime.RequestFeeder`.
+- `reshard_state` / `reshard_checkpoint` / `LayoutMismatch`
+  (`.reshard`) + `elastic_resume` / `ElasticDecision` / the elastic
+  drill (`.elastic`): plan-carrying checkpoints remapped onto a fresh
+  planner layout when the fleet shrinks/grows — manifest-verified end
+  to end, every decision banked as obs-spine events (ISSUE 14,
+  docs/robustness.md § Elastic resume).
 
 Every recovery path is exercised deterministically on CPU by the chaos
 harness (`apex1_tpu.testing.chaos`) — injected NaNs, truncated and
@@ -24,10 +30,14 @@ from apex1_tpu.resilience.checkpointer import (ResilientCheckpointer,
                                                find_restorable,
                                                is_valid_checkpoint,
                                                step_dir_name)
+from apex1_tpu.resilience.elastic import ElasticDecision, elastic_resume
 from apex1_tpu.resilience.manifest import (IntegrityError, Manifest,
                                            read_manifest, verify_files,
                                            verify_tree, write_manifest)
 from apex1_tpu.resilience.preemption import EXIT_RESUMABLE, PreemptionHandler
+from apex1_tpu.resilience.reshard import (LayoutMismatch, read_plan,
+                                          reshard_checkpoint,
+                                          reshard_state)
 from apex1_tpu.resilience.retry import (TransientError, backoff_delays,
                                         retry_call)
 from apex1_tpu.resilience.sentinel import (DivergenceError, Sentinel,
@@ -41,6 +51,8 @@ __all__ = [
     "IntegrityError", "Manifest", "read_manifest", "verify_files",
     "verify_tree", "write_manifest",
     "EXIT_RESUMABLE", "PreemptionHandler",
+    "ElasticDecision", "LayoutMismatch", "elastic_resume", "read_plan",
+    "reshard_checkpoint", "reshard_state",
     "TransientError", "backoff_delays", "retry_call",
     "DivergenceError", "Sentinel", "SentinelState", "guard_train_step",
     "health_flag", "refold_key", "refold_seed", "sentinel_init",

@@ -55,6 +55,8 @@ from apex1_tpu.resilience.manifest import (IntegrityError, Manifest,
                                            read_manifest, tree_entries,
                                            verify_files, verify_tree,
                                            write_manifest)
+from apex1_tpu.resilience.reshard import (PLAN_SCHEMA, LayoutMismatch,
+                                          mesh_str)
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 _LATEST = "latest"
@@ -115,13 +117,25 @@ class ResilientCheckpointer:
     ``restore(template)`` / ``latest_valid()``. See module docstring."""
 
     def __init__(self, directory: str | os.PathLike, *, keep: int = 3,
-                 fingerprint: Optional[int] = None):
+                 fingerprint: Optional[int] = None,
+                 plan: Optional[dict] = None):
         self.directory = os.fspath(os.path.abspath(directory))
         os.makedirs(self.directory, exist_ok=True)
         if keep < 1:
             raise ValueError("keep must be >= 1")
         self.keep = int(keep)
         self.fingerprint = fingerprint
+        # the producing apex1-plan-v1 spec: banked in every save's
+        # manifest meta (self-describing, reshardable checkpoints) and
+        # compared on restore — a layout change is a typed
+        # LayoutMismatch pointing at elastic resume, never a shape
+        # error from deep inside the restore
+        if plan is not None and (not isinstance(plan, dict)
+                                 or plan.get("schema") != PLAN_SCHEMA):
+            raise ValueError(
+                f"plan= must be an {PLAN_SCHEMA} document "
+                "(planner.make_plan / planner.plan_for_layout)")
+        self.plan = plan
         self._q: queue.Queue = queue.Queue()
         # the real memory bound: a slot is taken BEFORE the device-side
         # snapshot is built and released only after the worker dropped
@@ -160,6 +174,8 @@ class ResilientCheckpointer:
             m = dict(meta or {})
             if milestone:
                 m["milestone"] = True
+            if self.plan is not None and "plan" not in m:
+                m["plan"] = self.plan
             self._q.put((int(step), snap, m))
         except BaseException:
             self._slots.release()
@@ -271,6 +287,30 @@ class ResilientCheckpointer:
                 raise CheckpointError(self.directory,
                                       "no valid checkpoint to restore")
         manifest = verify_files(path)
+        if self.plan is not None:
+            # the layout check FIRST: a topology change flips the
+            # program fingerprint too, and "your layout changed — go
+            # through elastic resume" is the actionable diagnosis,
+            # not "the program changed". Replaces the blanket
+            # fingerprint refusal for plan-aware checkpoints.
+            from apex1_tpu.planner.emit import plan_spec
+
+            ckpt_plan = manifest.meta.get("plan")
+            if not isinstance(ckpt_plan, dict):
+                raise LayoutMismatch(
+                    path, "no plan meta: this checkpoint predates "
+                    "plan-aware saves and cannot be layout-checked "
+                    "against the current plan; restore it with a "
+                    "plan-less checkpointer, or reshard it via "
+                    "resilience.reshard_checkpoint")
+            if plan_spec(ckpt_plan) != plan_spec(self.plan):
+                raise LayoutMismatch(
+                    path, f"checkpoint layout [{mesh_str(ckpt_plan)}] "
+                    f"!= current plan [{mesh_str(self.plan)}] — the "
+                    "mesh/schedule changed; resume through "
+                    "resilience.elastic_resume (planner re-plan + "
+                    "manifest-verified reshard), not an in-place "
+                    "restore")
         want_fp = (expect_fingerprint if expect_fingerprint is not None
                    else self.fingerprint)
         if (want_fp is not None and manifest.fingerprint is not None

@@ -12,8 +12,15 @@ Contract:
   only — a Python signal-handler restriction) that SET A FLAG; the
   training loop checks ``handler.triggered`` at step boundaries, writes
   one final SYNCHRONOUS checkpoint, and calls ``exit_resumable()``.
-- A second delivery of the same signal escalates to immediate
-  ``os._exit(128 + signum)`` — impatient schedulers double-tap.
+- A SECOND delivery of any installed signal — the impatient scheduler
+  double-tap, typically landing while the drain/final checkpoint is
+  still in flight — escalates to an immediate ``os._exit(75)``
+  (`EXIT_RESUMABLE`). Immediate because the scheduler is done waiting;
+  resumable (75, never ``128+signum``) because the last COMMITTED
+  checkpoint is still valid by the manifest/ring design — the job
+  should be re-queued, not recorded as a failed round. The escalation
+  is cross-signal on purpose (SIGINT then SIGTERM must escalate, not
+  be swallowed as a "different" first signal).
 - `EXIT_RESUMABLE` (75, BSD ``EX_TEMPFAIL``) is the exit-code half of
   the contract: ``tools/tpu_watch.sh`` re-queues an entry that exits 75
   at the head of the queue instead of recording a failed round, and the
@@ -83,9 +90,17 @@ class PreemptionHandler:
         self.uninstall()
 
     def _on_signal(self, signum, frame):
-        if self._event.is_set() and signum == self._signum:
-            # double-tap: the scheduler is done waiting
-            os._exit(128 + signum)
+        if self._event.is_set():
+            # double-tap while the drain/final checkpoint is in
+            # flight: exit NOW (the scheduler stopped waiting), but
+            # RESUMABLY — the previous committed checkpoint is valid,
+            # so 75 re-queues the job where 128+signum would record a
+            # failure and a swallowed flag would hang the drain.
+            # os.write, not print: a signal handler must not re-enter
+            # buffered I/O the interrupted frame may hold.
+            os.write(2, b"[preemption] second signal during drain: "
+                        b"immediate resumable exit (75)\n")
+            os._exit(EXIT_RESUMABLE)
         self._signum = signum
         self._t_signal = time.monotonic()
         self._event.set()

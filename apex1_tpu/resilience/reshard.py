@@ -1,0 +1,349 @@
+"""Deterministic host-side checkpoint resharding between
+``apex1-plan-v1`` layouts — the bridge between the PR 6 resilience
+substrate (bit-exact single-topology resume) and the PR 12 planner
+(which can pick a legal layout for ANY surviving chip count).
+
+A committed checkpoint that banks its producing plan in the manifest
+``meta["plan"]`` (`ResilientCheckpointer(plan=...)`) is
+SELF-DESCRIBING: `reshard_state` can remap its state tree onto any
+other legal plan for the same model without asking the training
+program anything. Three leaf classes, derived from the plans alone:
+
+- **pipeline-stacked leaves** (``['chunk']`` in the key path, leading
+  dims ``(num_chunks, pp, layers_per_stage)``): the chunk-major
+  layout assigns global layer ``(v·pp + s)·lps + j`` to slot
+  ``(v, s, j)`` — the row-major flattening of the stack axes
+  (`models.llama_3d.reshape_chunks`'s contract) — so re-partitioning
+  for any other ``(V', PP', lps')`` factorization of the same
+  ``num_layers`` is a plain reshape. Applies identically to params
+  and to optimizer moments mirroring the param tree.
+- **ZeRO flat shards** (``…_shard`` leaves of
+  `parallel.distributed_optimizer` states, 1-D, padded to a multiple
+  of the plan's dp): repacked via
+  `parallel.distributed_optimizer.repack_flat_shard` — strip the old
+  world's zero padding at the true flat length, re-pad for the new
+  world. Zero padding is EXACT, not approximate: the padded tail of
+  the flat buffer carries zero params and zero grads, so Adam/LAMB
+  moments there stay identically zero on every step — the repacked
+  state equals what a from-scratch run at the new world size would
+  have banked.
+- **everything else** (shared/vocab params, loss-scale state, step
+  counters, sentinel counters): layout-independent host bytes, copied
+  verbatim.
+
+NEVER TRUSTED, ALWAYS VERIFIED — the contract that makes a resharded
+checkpoint restorable with a straight face:
+
+1. the SOURCE is digest-verified before the remap (`verify_files` +
+   `verify_tree` against its manifest);
+2. the remap itself is conservation-checked (restacked leaves:
+   byte-identical flat content; repacked shards: byte-identical
+   unpadded prefix + all-zero new padding);
+3. every remapped leaf is re-digested through `manifest.tree_entries`
+   into a FRESH manifest, committed with the same
+   temp-dir → manifest → rename chain as a live save, and the result
+   is `verify_files`-checked before the path is returned — so the
+   later restore re-verifies leaves end-to-end exactly like any other
+   checkpoint.
+
+DETERMINISM: pure numpy on host bytes, no clocks, no environment
+probes — the same (checkpoint, target plan) always produces the same
+leaf digests (pinned in tests/test_elastic.py), which is what lets an
+elastic resume and its from-checkpoint control run start bit-equal.
+
+Structure CHANGES are refused, not guessed at: flipping ``zero`` on or
+off between plans changes the optimizer state's tree structure
+(moments-as-param-tree vs flat shards) — that is a re-plan constraint
+(`elastic_resume` pins the search via ``require_zero``), not a leaf
+remap, and raises a typed :class:`LayoutMismatch`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from apex1_tpu.checkpoint import (CheckpointError, restore_checkpoint,
+                                  save_checkpoint)
+from apex1_tpu.resilience.manifest import (Manifest, read_manifest,
+                                           tree_entries, verify_files,
+                                           verify_tree, write_manifest)
+
+#: must match planner.emit.PLAN_SCHEMA (asserted by test_elastic) —
+#: spelled here so reading a manifest's plan meta stays jax/planner-free
+PLAN_SCHEMA = "apex1-plan-v1"
+
+_STATE_SUBDIR = "state"
+
+
+class LayoutMismatch(CheckpointError):
+    """The checkpoint's banked layout (the ``apex1-plan-v1`` spec in
+    its manifest meta) and the layout being asked for disagree — or
+    the checkpoint has no banked plan at all. Subclasses
+    `checkpoint.CheckpointError` so existing typed handling still
+    catches it; the message always says what to do next (resume
+    through `resilience.elastic_resume` / `reshard_checkpoint`, or
+    re-save with ``ResilientCheckpointer(plan=...)``)."""
+
+
+def plan_meta(manifest: Manifest, path: str | os.PathLike) -> dict:
+    """The producing plan banked in a manifest's meta, or a typed
+    :class:`LayoutMismatch` — old checkpoints without it get a clear
+    error, never a traceback from whatever consumed the None."""
+    plan = manifest.meta.get("plan")
+    if not isinstance(plan, dict) or plan.get("schema") != PLAN_SCHEMA:
+        raise LayoutMismatch(
+            path, "no plan meta: this checkpoint does not bank its "
+            f"producing {PLAN_SCHEMA} spec, so it cannot be resharded "
+            "or layout-checked; re-save it with "
+            "ResilientCheckpointer(plan=...) (docs/robustness.md "
+            "§ Elastic resume)")
+    return plan
+
+
+def mesh_str(plan: dict) -> str:
+    """Compact ``dp2 pp2 cp1 ep1 tp2 /8`` label for messages/events."""
+    m = plan.get("mesh", {})
+    return (" ".join(f"{a}{m.get(a, '?')}"
+                     for a in ("dp", "pp", "cp", "ep", "tp"))
+            + f" /{plan.get('n_devices', '?')}")
+
+
+# -- remap geometry from the plans ------------------------------------------
+
+def _stack_dims(plan: dict, path: str) -> Tuple[int, int, int]:
+    """(num_chunks, pp, layers_per_stage) — the chunk-stack leading
+    dims the plan implies (`models.llama_3d` stacking)."""
+    layers = int(plan["model"]["num_layers"])
+    chunks = int(plan["schedule"]["num_chunks"])
+    pp = int(plan["mesh"]["pp"])
+    if chunks < 1 or pp < 1 or layers % (chunks * pp):
+        raise LayoutMismatch(
+            path, f"plan stacking is inconsistent: num_layers={layers} "
+            f"does not factor as num_chunks={chunks} x pp={pp} x "
+            f"layers_per_stage")
+    return chunks, pp, layers // (chunks * pp)
+
+
+def _zero_world(plan: dict) -> Optional[int]:
+    """dp world of the flat optimizer shards, or None when the plan
+    runs the unsharded optimizer."""
+    return (int(plan["mesh"]["dp"])
+            if plan.get("zero", {}).get("enabled") else None)
+
+
+def _sha(a: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
+def _bytes_equal(x: np.ndarray, y: np.ndarray) -> bool:
+    """Bytewise equality — NaN-safe (a diverged-but-saved checkpoint
+    must not spuriously fail conservation: NaN != NaN under
+    array_equal) and dtype-agnostic (int8 rejects equal_nan), without
+    paying a hash over multi-GB leaves."""
+    x, y = np.ascontiguousarray(x), np.ascontiguousarray(y)
+    return (x.dtype == y.dtype and x.shape == y.shape
+            and np.array_equal(x.view(np.uint8), y.view(np.uint8)))
+
+
+# -- the remap --------------------------------------------------------------
+
+def reshard_state(state: Any, plan_from: dict, plan_to: dict, *,
+                  flat_len: Optional[int] = None,
+                  path: str = "<state>") -> Tuple[Any, dict]:
+    """Remap a HOST state pytree saved under ``plan_from`` onto
+    ``plan_to``. Returns ``(new_state, report)`` where ``report``
+    carries the banked evidence (leaf counts per remap class and the
+    conservation verdicts). Pure host-side numpy; deterministic.
+
+    ``flat_len`` is the true (unpadded) flat float-param length the
+    ZeRO shards pack; when None and shard leaves are present it is
+    derived from ``state["params"]`` via
+    `parallel.distributed_optimizer.flat_param_len`.
+    """
+    import jax
+
+    for key in ("model",):
+        if plan_from.get(key) != plan_to.get(key):
+            raise LayoutMismatch(
+                path, f"plans disagree on {key!r}: elastic resume "
+                "changes the topology, never the model "
+                f"({plan_from.get(key)} != {plan_to.get(key)})")
+    if bool(plan_from.get("zero", {}).get("enabled")) != \
+            bool(plan_to.get("zero", {}).get("enabled")):
+        raise LayoutMismatch(
+            path, "optimizer-shard layout change (zero on<->off) is a "
+            "tree-STRUCTURE change, not a leaf remap — re-plan with "
+            "the source checkpoint's zero setting (elastic_resume "
+            "pins the search via require_zero)")
+    stack_from = _stack_dims(plan_from, path)
+    stack_to = _stack_dims(plan_to, path)
+    w_from, w_to = _zero_world(plan_from), _zero_world(plan_to)
+
+    n_flat = flat_len
+    counts = {"restacked": 0, "repacked": 0, "copied": 0}
+    checks: list[dict] = []
+
+    def need_flat_len() -> int:
+        nonlocal n_flat
+        if n_flat is None:
+            from apex1_tpu.parallel.distributed_optimizer import (
+                flat_param_len)
+
+            params = state.get("params") if isinstance(state, dict) \
+                else None
+            if params is None:
+                raise LayoutMismatch(
+                    path, "cannot derive the flat shard length: state "
+                    "has no 'params' subtree — pass flat_len= "
+                    "explicitly")
+            n_flat = flat_param_len(params)
+        return n_flat
+
+    def leaf(kp, x) -> np.ndarray:
+        key = jax.tree_util.keystr(kp)
+        a = np.asarray(x)
+        if ("['chunk']" in key and a.ndim >= 3
+                and a.shape[:3] == stack_from):
+            if stack_from == stack_to:
+                counts["copied"] += 1
+                return a.copy()
+            out = np.ascontiguousarray(a).reshape(stack_to + a.shape[3:])
+            counts["restacked"] += 1
+            # INDEPENDENT per-layer provenance check — NOT a reshape
+            # compared to itself: global layer l must sit at
+            # unravel(l, stack) on each side, recomputed here by
+            # integer indexing, so a wrong remap (column-major,
+            # swapped stack axes) fails this even though it would
+            # pass any whole-buffer comparison of reshapes.
+            # bytewise, not hashed: same strictness, NaN-safe, and a
+            # multi-GB resume should not pay 2x sha256 per leaf
+            n_layers = stack_from[0] * stack_from[1] * stack_from[2]
+            ok = all(
+                _bytes_equal(a[np.unravel_index(layer, stack_from)],
+                             out[np.unravel_index(layer, stack_to)])
+                for layer in range(n_layers))
+            checks.append({"leaf": key, "kind": "restack", "ok": ok})
+            return out
+        if "['chunk']" in key and a.ndim >= 3:
+            raise LayoutMismatch(
+                path, f"leaf {key}: shape {a.shape} does not start "
+                f"with the banked plan's stack {stack_from} — the "
+                "checkpoint disagrees with its own plan meta")
+        if "_shard" in key and a.ndim == 1 and w_from is not None:
+            from apex1_tpu.parallel.distributed_optimizer import (
+                repack_flat_shard, shard_padded_len)
+
+            n = need_flat_len()
+            if a.shape[0] != shard_padded_len(n, w_from):
+                raise LayoutMismatch(
+                    path, f"leaf {key}: length {a.shape[0]} != flat "
+                    f"length {n} padded for dp={w_from} — the "
+                    "checkpoint disagrees with its own plan meta")
+            out = repack_flat_shard(a, flat_len=n, world_from=w_from,
+                                    world_to=w_to)
+            counts["repacked"] += 1
+            # the meaningful tail check is on the SOURCE: a nonzero
+            # padded tail means the zero-padding invariant broke
+            # upstream and the repack would silently discard data —
+            # refuse loudly (out's tail is zero by construction and
+            # proves nothing)
+            checks.append({"leaf": key, "kind": "repack",
+                           "ok": _bytes_equal(a[:n], out[:n])
+                           and not a[n:].any()})
+            return out
+        counts["copied"] += 1
+        return a.copy()
+
+    new_state = jax.tree_util.tree_map_with_path(leaf, state)
+    report = {
+        "n_leaves": sum(counts.values()),
+        "n_restacked": counts["restacked"],
+        "n_repacked": counts["repacked"],
+        "n_copied": counts["copied"],
+        "stack_from": list(stack_from), "stack_to": list(stack_to),
+        "conserved": all(c["ok"] for c in checks),
+        "n_checks": len(checks),
+    }
+    if not report["conserved"]:
+        bad = [c["leaf"] for c in checks if not c["ok"]]
+        raise LayoutMismatch(
+            path, f"reshard conservation check failed for {bad[:4]} — "
+            "remapped bytes do not match the source")
+    return new_state, report
+
+
+# -- checkpoint-level reshard ----------------------------------------------
+
+def reshard_checkpoint(src_dir: str | os.PathLike, template: Any,
+                       plan_to: dict, out_dir: str | os.PathLike, *,
+                       fingerprint: Optional[int] = None,
+                       flat_len: Optional[int] = None,
+                       manifest: Optional[Manifest] = None
+                       ) -> Tuple[str, Manifest, dict]:
+    """Reshard a COMMITTED checkpoint onto ``plan_to`` as a fresh
+    committed checkpoint at ``out_dir``. Returns
+    ``(out_dir, new_manifest, report)``.
+
+    ``template`` is a host-buildable state pytree with the SOURCE
+    plan's structure/shapes/dtypes (e.g.
+    `models.llama_3d.state_template` of the source config — no mesh
+    or device count required). The full verification chain from the
+    module docstring runs here; the returned directory restores
+    through `ResilientCheckpointer.restore(path=...)` like any other
+    checkpoint, re-verifying every leaf digest. Pass ``manifest`` when
+    the caller JUST ran `verify_files` on the source itself (what
+    `elastic_resume` does) — the file digests are skipped here, the
+    leaf-level `verify_tree` after restore still runs; a multi-GB
+    checkpoint should not be re-hashed back-to-back for nothing."""
+    src_dir = os.fspath(src_dir)
+    out_dir = os.fspath(os.path.abspath(out_dir))
+    man = manifest if manifest is not None else verify_files(src_dir)
+    plan_from = plan_meta(man, src_dir)
+    state = restore_checkpoint(os.path.join(src_dir, _STATE_SUBDIR),
+                               template=template)
+    verify_tree(src_dir, state, man)
+    new_state, report = reshard_state(state, plan_from, plan_to,
+                                      flat_len=flat_len, path=src_dir)
+
+    meta = dict(man.meta)
+    meta["plan"] = plan_to
+    meta["resharded_from"] = {
+        "path": src_dir, "step": man.step,
+        "mesh": mesh_str(plan_from), "to_mesh": mesh_str(plan_to),
+        "n_leaves": report["n_leaves"],
+        "n_restacked": report["n_restacked"],
+        "n_repacked": report["n_repacked"],
+    }
+    tmp = f"{out_dir}.tmp-{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(os.path.dirname(out_dir) or ".", exist_ok=True)
+    os.makedirs(tmp)
+    try:
+        save_checkpoint(os.path.join(tmp, _STATE_SUBDIR), new_state)
+        write_manifest(tmp, step=man.step, tree=tree_entries(new_state),
+                       fingerprint=fingerprint, meta=meta)
+        old = None
+        if os.path.exists(out_dir):
+            old = f"{out_dir}.old-{os.getpid()}"
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(out_dir, old)
+        os.rename(tmp, out_dir)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    new_man = verify_files(out_dir)
+    return out_dir, new_man, report
+
+
+def read_plan(ckpt_dir: str | os.PathLike) -> dict:
+    """The banked producing plan of a committed checkpoint dir (typed
+    errors for uncommitted/plan-less dirs)."""
+    ckpt_dir = os.fspath(ckpt_dir)
+    return plan_meta(read_manifest(ckpt_dir), ckpt_dir)

@@ -59,7 +59,8 @@ __all__ = [
     "poison_at_steps", "poison_tree_at_steps", "truncate_checkpoint",
     "bitflip_checkpoint", "sigterm_self_at", "Flaky", "TransientError",
     "ServingFault", "ChaosSchedule", "ReplicaKill", "ReplicaHang",
-    "SlowReplica", "PoisonPill", "kill_schedule", "toy_decoder",
+    "SlowReplica", "PoisonPill", "kill_schedule", "shrink_schedule",
+    "toy_decoder",
 ]
 
 
@@ -296,6 +297,34 @@ class PoisonPill(ServingFault):
             raise PoisonedRequest(
                 f"chaos: poison token {self.poison_token} in request "
                 f"{sub.req_id}", req_id=sub.req_id)
+
+
+def shrink_schedule(seed: int, *, n_devices: int, lo: int, hi: int,
+                    survivors: Optional[int] = None
+                    ) -> tuple[int, int]:
+    """Seed-keyed mid-run FLEET SHRINK pick for the elastic drill
+    (`resilience.elastic`): ``(kill_step, n_survivors)`` — the step at
+    which the training job dies, and the device count it must resume
+    on. The step is avalanche-derived from the seed (same family as
+    `kill_schedule`); survivors defaults to the largest proper divisor
+    of ``n_devices`` (kill half an even fleet — the k-of-n drill's
+    canonical k = n/2) so the planner always has a clean mesh product
+    to re-plan onto. Deterministic: the drill's "kill mid-run" is an
+    assertable property, not a flaky one."""
+    if hi <= lo:
+        raise ValueError(f"need hi > lo, got [{lo}, {hi})")
+    step = lo + _mix32(seed ^ 0xE1A57C) % (hi - lo)
+    if survivors is None:
+        divs = [d for d in range(1, n_devices) if n_devices % d == 0]
+        if not divs:
+            raise ValueError(
+                f"n_devices={n_devices} has no proper divisor to "
+                "shrink onto")
+        survivors = max(divs)
+    if not 1 <= survivors < n_devices:
+        raise ValueError(
+            f"survivors={survivors} must be in [1, {n_devices})")
+    return step, int(survivors)
 
 
 def kill_schedule(seed: int, *, n_replicas: int, lo: int, hi: int

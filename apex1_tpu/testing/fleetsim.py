@@ -452,3 +452,31 @@ def run_fleet(trace: Trace, frontend_config, *,
     benches use)."""
     return FleetSim(trace, frontend_config, sim=sim,
                     autopilot=autopilot, chaos=chaos).run()
+
+
+def kill_k_of_n(seed: int, *, n_replicas: int, k: int, lo: int,
+                hi: int):
+    """Seed-keyed PERMANENT shrink of a serving fleet: k distinct
+    replicas each get a repeating `chaos.ReplicaKill` at a derived
+    step, so every restart crashes again until the supervisor's budget
+    is spent and the frontend fails the replica's work over — the
+    fleet serves on the n−k survivors. The serving mirror of the
+    training side's `chaos.shrink_schedule` (ISSUE 14's kill-k-of-n
+    drill): same seed ⇒ same victims and steps, so "k of n replicas
+    die and every request still completes" is an assertable property.
+    """
+    from apex1_tpu.resilience.retry import _mix32
+    from apex1_tpu.testing.chaos import ChaosSchedule, ReplicaKill
+
+    if not 0 < k < n_replicas:
+        raise ValueError(
+            f"need 0 < k < n_replicas, got k={k} of {n_replicas}")
+    if hi <= lo:
+        raise ValueError(f"need hi > lo, got [{lo}, {hi})")
+    start = _mix32(seed ^ 0x51A7E) % n_replicas
+    kills = []
+    for j in range(k):
+        victim = (start + j) % n_replicas       # k DISTINCT victims
+        step = lo + _mix32(seed ^ 0xB10C ^ (j * 0x9E3779B9)) % (hi - lo)
+        kills.append(ReplicaKill(victim, step, repeat=True))
+    return ChaosSchedule(kills)

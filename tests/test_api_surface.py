@@ -36,7 +36,8 @@ SURFACE = {
         "convert_syncbn_model"],
     "apex1_tpu.parallel.distributed_optimizer": [
         "distributed_fused_adam", "distributed_fused_lamb",
-        "shard_opt_state_specs", "fsdp_param_specs"],
+        "shard_opt_state_specs", "fsdp_param_specs",
+        "flat_param_len", "shard_padded_len", "repack_flat_shard"],
     "apex1_tpu.parallel.ring_attention": ["ring_attention",
                                           "ring_attention_serial"],
     "apex1_tpu.parallel.ulysses": ["ulysses_attention"],
@@ -118,7 +119,12 @@ SURFACE = {
     "apex1_tpu.models.llama_3d": [
         "Llama3DConfig", "make_train_step", "build_step",
         "abstract_state", "from_llama_params", "reshape_chunks",
-        "combine_grads"],
+        "combine_grads", "state_template"],
+    "apex1_tpu.resilience.reshard": [
+        "LayoutMismatch", "reshard_state", "reshard_checkpoint",
+        "read_plan", "plan_meta", "mesh_str"],
+    "apex1_tpu.resilience.elastic": [
+        "ElasticDecision", "elastic_resume", "drill"],
     "apex1_tpu.utils.observability": ["MetricsLogger", "Timers"],
     "apex1_tpu.obs": ["ObsRun", "StopWatch", "default_run", "emit",
                       "read_events", "TraceError", "build_report",
@@ -149,7 +155,8 @@ SURFACE = {
         "ControllerState", "Action", "decide", "default_slo"],
     "apex1_tpu.testing.fleetsim": [
         "VirtualClock", "SimRequest", "Trace", "synthetic_trace",
-        "FleetSimConfig", "FleetSim", "SimReport", "run_fleet"],
+        "FleetSimConfig", "FleetSim", "SimReport", "run_fleet",
+        "kill_k_of_n"],
     "apex1_tpu.planner": [
         "ModelShape", "Layout", "Violation", "BANKED_SHAPES",
         "check_layout", "check_plan_model", "enumerate_layouts",
@@ -158,7 +165,8 @@ SURFACE = {
         "make_plan", "search_layouts", "PlanError", "plan_json",
         "save_plan", "load_plan", "partition_rules", "rules_to_specs",
         "plan_param_specs", "llama3d_config_from_plan",
-        "layout_from_plan", "PLAN_SCHEMA"],
+        "layout_from_plan", "PLAN_SCHEMA", "PLAN_SPEC_KEYS",
+        "plan_for_layout", "plan_spec", "model_shape_from_plan"],
 }
 
 

@@ -712,6 +712,17 @@ class TestDriftGate:
         d = _mini_corpus(tmp_path, measured_scale=0.5)  # 2x slower
         assert drift_mod.run_gate(str(d)) == 1
 
+    def test_failure_output_names_offending_record_path(
+            self, tmp_path, drift_mod, capsys):
+        """ISSUE 14 satellite: a drift failure must name the record
+        PATH that carries the out-of-band measurement, not just the
+        key — the fix is one open() away."""
+        d = _mini_corpus(tmp_path, measured_scale=0.5)
+        assert drift_mod.run_gate(str(d)) == 1
+        out = capsys.readouterr().out
+        assert ("offending record: "
+                + str(d / "bench_gpt2.log")) in out
+
     def test_uncalibrated_new_key_fails(self, tmp_path, drift_mod):
         d = _mini_corpus(tmp_path)
         cal = json.loads((d / "calibration.json").read_text())

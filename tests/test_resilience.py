@@ -305,6 +305,32 @@ class TestResilientCheckpointer:
         assert len(snaps) == 3
         assert os.path.basename(ck.latest_valid()) == "step_00000003"
 
+    def test_plan_banked_in_every_save_meta(self, tmp_path):
+        """ISSUE 14 satellite: a plan-aware checkpointer banks the
+        producing apex1-plan-v1 spec in every manifest meta, so any
+        committed checkpoint is self-describing and reshardable."""
+        from apex1_tpu import planner
+        from apex1_tpu.resilience import read_plan
+
+        shape = planner.ModelShape(
+            name="bank", num_layers=2, hidden_size=32, ffn_size=64,
+            num_heads=4, num_kv_heads=2, head_dim=8, vocab_size=64,
+            seq_len=16, global_batch=4)
+        plan = planner.plan_for_layout(
+            shape, planner.Layout(dp=2, num_microbatches=2))
+        tree = {"w": jnp.ones((4,))}
+        with ResilientCheckpointer(tmp_path / "ck", plan=plan) as ck:
+            ck.save_sync(1, tree, meta={"data_step": 1})
+            ck.save_sync(2, tree)
+            banked = read_plan(os.path.join(str(tmp_path / "ck"),
+                                            "step_00000002"))
+            assert banked == plan           # JSON round-trip intact
+            restored, man = ck.restore(template=tree)   # spec matches
+            assert man.meta["plan"]["mesh"] == plan["mesh"]
+        with pytest.raises(ValueError, match="apex1-plan-v1"):
+            ResilientCheckpointer(tmp_path / "ck2",
+                                  plan={"schema": "nope"})
+
     def test_uncommitted_save_is_invisible(self, tmp_path):
         """A step dir without a manifest (killed between payload and
         commit) is not restorable and is GC-collectable."""
@@ -465,6 +491,53 @@ class TestPreemption:
             pre.exit_resumable("test")
         assert ei.value.code == EXIT_RESUMABLE == 75
         assert "resumable" in capsys.readouterr().out
+
+    def _double_signal_child(self, first, second):
+        """Subprocess: install the handler, deliver two signals while
+        the 'drain' (a sleep standing in for the final checkpoint) is
+        in flight. The module is loaded by file path so the child
+        skips the package imports (stdlib-only, <1s)."""
+        import subprocess
+        import sys
+        import textwrap
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "apex1_tpu", "resilience", "preemption.py")
+        code = textwrap.dedent(f"""
+            import importlib.util, os, signal, sys, time
+            spec = importlib.util.spec_from_file_location(
+                "preemption", {path!r})
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            h = mod.PreemptionHandler().install()
+            os.kill(os.getpid(), signal.{first})
+            assert h.triggered          # drain begins...
+            os.kill(os.getpid(), signal.{second})
+            time.sleep(5)               # ...must never finish
+            sys.exit(3)
+        """)
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=60)
+
+    def test_second_sigterm_mid_drain_escalates_to_exit_75(self):
+        """ISSUE 14 satellite regression: a second SIGTERM while the
+        drain/final checkpoint is in flight must be an IMMEDIATE
+        `exit_resumable` (75 — the last committed checkpoint is still
+        valid, re-queue the job), not 128+signum (a recorded failure)
+        and not a swallowed flag (a hung drain)."""
+        r = self._double_signal_child("SIGTERM", "SIGTERM")
+        assert r.returncode == EXIT_RESUMABLE == 75, \
+            (r.returncode, r.stderr)
+        assert "immediate resumable exit" in r.stderr
+
+    def test_cross_signal_double_tap_also_escalates(self):
+        """SIGINT then SIGTERM was previously swallowed (the
+        same-signum guard): any second installed signal must
+        escalate."""
+        r = self._double_signal_child("SIGINT", "SIGTERM")
+        assert r.returncode == EXIT_RESUMABLE, (r.returncode, r.stderr)
 
 
 # ---------------------------------------------------------------------------

@@ -300,6 +300,11 @@ class TestSpeculativeRaggedAndQuant:
                 np.asarray(got[b]), np.asarray(solo[0]),
                 err_msg=f"row {b} (len {ln}) diverged from solo decode")
 
+    @pytest.mark.slow  # ~15s ragged x sampled COMPOSITION smoke; the
+    # halves stay tier-1: sampled accept/resample in
+    # test_sampled_self_draft_accepts_everything, ragged per-row
+    # alignment in test_rows_match_solo (greedy) directly above, and
+    # the ragged x int8 composition pins. Runs via check_all --all.
     def test_ragged_sampled_smoke(self):
         """Sampled ragged speculative: the accept rule runs per row under
         vmap with per-row alignment — valid tokens, reproducible."""

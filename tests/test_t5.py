@@ -74,6 +74,11 @@ class TestRelPosBucket:
 
 
 class TestT5Model:
+    @pytest.mark.slow  # ~28s whole-model value_and_grad compile; the
+    # COMPOSITION check. Halves pinned tier-1: the fused CE kernel's
+    # numerics/grads in test_linear_xent.py +
+    # test_vocab_parallel_linear_xent.py, and T5's behavioral pins
+    # (causal/pad/label-pad invariance) below. Runs via check_all --all.
     def test_fused_head_matches_gold_and_grads_alive(self, tiny):
         """One value_and_grad trace covers both the fused-vs-gold CE check
         and the no-dead-params check (compile time dominates on CPU)."""
@@ -178,6 +183,12 @@ class TestT5Model:
     # test_models.py::TestParamSpecs::test_t5_specs (the shared harness
     # GPT-2/BERT use).
 
+    @pytest.mark.slow  # ~26s two whole-model grad compiles; the
+    # COMPOSITION check. Halves pinned tier-1: per-op pallas-vs-xla
+    # parity (incl. the bias-bearing flash fwd/bwd and segment-ids
+    # paths) in test_ops.py/test_attention.py, and the regression this
+    # test once caught — the (B,1,1,Sk) mask shape — is covered by the
+    # encoder-pad invariance pin above. Runs via check_all --all.
     def test_pallas_xla_parity(self, tiny):
         """Whole-model loss AND grads, Pallas kernels (interpret on CPU)
         vs XLA composites — WITH a padded encoder batch, so the

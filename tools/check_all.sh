@@ -28,6 +28,8 @@ echo "== chaos smoke (injected-NaN rollback + corrupt-ckpt fallback, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --smoke
 echo "== serving chaos smoke (replica-kill token parity + poison quarantine, CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.testing.chaos --serve-smoke
+echo "== elastic drill (8->4 mid-run shrink: planner re-plan + manifest-verified reshard, bit-exact vs the 4-dev control, episode from banked events; CPU) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.resilience.elastic --drill
 echo "== autopilot smoke (static ladder sweep misses SLO, autopilot holds it, replay bit-identical; CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.autopilot --smoke
 echo "== obs smoke (CPU trace -> per-op report -> calibration fit, non-empty) =="

@@ -178,11 +178,21 @@ def run_gate(results_dir: str, *, calibration_path: str = None,
         r = "      -" if ratio is None else f"{ratio:7.3f}"
         print(f"  [{p.backend:9s}] {p.key:28s} ratio {r}  "
               f"({p.source})  {verdict}")
+        if verdict != "ok":
+            # a failure must NAME the offending record so the fix is
+            # one open() away, not a corpus-wide hunt
+            print(f"              offending record: "
+                  f"{os.path.join(results_dir, p.source)}")
     for (key, backend), b, g, why in refit_bad:
         bs = "-" if b is None else f"{b['slowdown']:.4f}"
         gs = "-" if g is None else f"{g['slowdown']:.4f}"
         print(f"  [refit    ] {f'{key} ({backend})':28s} banked {bs} "
               f"vs corpus {gs}  REFIT DRIFT: {why}")
+        srcs = sorted(set((g or b or {}).get("sources", [])))
+        if srcs:
+            print("              offending record(s): "
+                  + ", ".join(os.path.join(results_dir, s)
+                              for s in srcs))
     n_bad = len(drifted) + len(refit_bad)
     print(f"drift gate: {len(rows)} banked measurement(s) vs "
           f"{len(banked)} committed factor(s), band "

@@ -258,6 +258,14 @@ run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 run bench_serving_rep 1800 python tools/bench_serving.py --loads 8 \
                          --replicas 1 2 --chaos \
                          --out perf_results/bench_serving_replicas.json
+# elastic shrink-resume A/B (ISSUE 14) BEHIND the banked-bench
+# backlog: the n -> n/2 mid-run shrink through the planner re-plan +
+# manifest-verified reshard vs the from-checkpoint control, on the
+# LIVE device set (skip record on a single-chip window; with
+# JAX_PLATFORMS=cpu — the rehearsal — it runs the virtual 8->4 form).
+# The CPU drill proves the remap/determinism contract; this entry is
+# what proves it on silicon timings and a real multi-chip mesh.
+run elastic_ab      1200 python -m apex1_tpu.resilience.elastic --drill --real
 # final re-fit: the window's complete corpus (all bench groups + the
 # tuning sweeps) becomes the calibration the NEXT session commits
 run calibrate_refresh4 300 python -m apex1_tpu.obs.calibrate
