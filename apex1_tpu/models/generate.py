@@ -349,6 +349,29 @@ def _ragged_align(prompt_tokens, prompt_lens):
     return aligned, kw, pad
 
 
+def counter_sample(logits, seed, positions, *, temperature: float = 0.0,
+                   top_k: Optional[int] = None,
+                   vocab_size: Optional[int] = None):
+    """Counter-keyed sampling over an (S, V) logits chunk: the token at
+    output position ``positions[j]`` is drawn with
+    ``fold_in(key(seed), positions[j])`` — the per-request counter-PRNG
+    contract (`docs/serving.md` § Per-request sampling seeds) as ONE
+    shared function. The serving engine's speculative verify executable
+    samples the target's canonical stream through this, which is what
+    makes a draft/verify round emit tokens BIT-IDENTICAL to plain
+    step-decode of the same (params, prompt, seed) at any temperature —
+    and therefore resubmission-safe and hedging-compatible. ``seed`` and
+    ``positions`` (S,) may be traced."""
+    seed = jnp.asarray(seed, jnp.int32)
+
+    def one(lg, p):
+        key = jax.random.fold_in(jax.random.key(seed), p)
+        return sample_token(lg[None], key, temperature=temperature,
+                            top_k=top_k, vocab_size=vocab_size)[0]
+
+    return jax.vmap(one)(logits, jnp.asarray(positions, jnp.int32))
+
+
 def _masked_probs(logits, *, temperature: float, top_k: Optional[int],
                   vocab_size: Optional[int]):
     """The probability distribution `sample_token` samples from: fp32,

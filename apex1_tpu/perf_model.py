@@ -263,3 +263,64 @@ def allreduce_bytes(nbytes: float, n: int) -> float:
     if n <= 1:
         return 0.0
     return 2.0 * nbytes * (n - 1) / n
+
+
+# ---------------------------------------------------------------------------
+# serving-config pricing (ISSUE 15: the goodput-multiplier arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def kv_cache_bytes(num_layers: int, num_kv_heads: int, head_dim: int,
+                   positions: int, batch: int = 1,
+                   bytes_per_el: int = 2) -> int:
+    """HBM bytes of a K/V cache pytree (`models.generate.init_cache`
+    layout: K + V per layer, ``(batch, Hkv, positions, D)`` each) — the
+    analytic mirror of `serving.KVPool.pool_bytes`, jax-free so the
+    planner/bench can size pools without building one. ``bytes_per_el``
+    2 = bf16 (the default compute dtype), 1 = the int8 capacity tier,
+    4 = fp32 test configs."""
+    return (2 * int(num_layers) * int(batch) * int(num_kv_heads)
+            * int(positions) * int(head_dim) * int(bytes_per_el))
+
+
+def serving_capacity(hbm_budget_bytes: float, num_layers: int,
+                     num_kv_heads: int, head_dim: int, pool_len: int,
+                     bytes_per_el: int = 2) -> int:
+    """Resident batch (engine ``max_slots``) a KV-pool HBM budget buys:
+    ``budget // bytes-per-slot``. The int8 tier's headline is this
+    function at ``bytes_per_el=1`` — double the slots for the same
+    budget — which is capacity, not correctness: the dtype-flip parity
+    drills are what license flipping it on."""
+    per_slot = kv_cache_bytes(num_layers, num_kv_heads, head_dim,
+                              pool_len, 1, bytes_per_el)
+    if per_slot <= 0:
+        raise ValueError("per-slot KV bytes must be positive")
+    return int(hbm_budget_bytes // per_slot)
+
+
+def speculative_speedup(accept_rate: float, num_draft: int,
+                        verify_cost: float = 1.0,
+                        draft_cost: float = 0.0) -> float:
+    """Expected decode-dispatch speedup of the engine's speculative
+    mode: tokens emitted per verify round over its relative cost.
+
+    Per-position independent accept probability ``r`` gives
+    ``E[tokens/round] = 1 + r + r^2 + ... + r^K`` (the accepted prefix
+    is geometric, truncated at K drafts, plus the always-emitted
+    correction/bonus token). ``verify_cost`` is one (K+1)-wide chunk
+    verify relative to one plain decode step (~1 on TPU decode, which
+    is weight-streaming-bound: the same weights stream either way);
+    ``draft_cost`` is the per-draft-token proposal cost (0 for the
+    host-side n-gram default). An UPPER bound, like every number in
+    this module — the banked accept rates (`bench_serving`) are what
+    calibrate it."""
+    if not 0.0 <= accept_rate <= 1.0:
+        raise ValueError(f"accept_rate must be in [0, 1], "
+                         f"got {accept_rate}")
+    if num_draft < 1:
+        raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+    tokens = sum(accept_rate ** j for j in range(num_draft + 1))
+    cost = float(verify_cost) + num_draft * float(draft_cost)
+    if cost <= 0:
+        raise ValueError("round cost must be positive")
+    return tokens / cost

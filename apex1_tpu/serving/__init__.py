@@ -40,9 +40,11 @@ from apex1_tpu.serving.engine import (Engine, EngineConfig,  # noqa: F401
 from apex1_tpu.serving.frontend import (DegradeProfile,  # noqa: F401
                                         FrontendConfig,
                                         ServingFrontend)
-from apex1_tpu.serving.kv_pool import KVPool, PrefixPage  # noqa: F401
+from apex1_tpu.serving.kv_pool import (KVPool, PrefixPage,  # noqa: F401
+                                       RadixIndex)
 from apex1_tpu.serving.metrics import (RequestRecord,  # noqa: F401
                                        ServingMetrics)
+from apex1_tpu.serving.spec import ngram_propose  # noqa: F401
 from apex1_tpu.serving.replica import (PoisonedRequest,  # noqa: F401
                                        ReplicaConfig, ReplicaKilled,
                                        ReplicaSupervisor, Submission)

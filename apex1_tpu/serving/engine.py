@@ -24,6 +24,13 @@ EXACTLY TWO executables, traced once each for the life of the engine:
   point). Inactive lanes compute masked garbage into their free slot;
   retirement and admission change only ARRAY VALUES, never shapes.
 
+With ``num_draft > 0`` the decode executable is replaced by **verify**
+— same two-executable discipline, different second executable: a
+``vmap`` of a ``(1, num_draft + 1)`` chunk-decode forward that scores
+the previous token plus K host-proposed draft tokens in ONE dispatch
+and accepts the longest prefix matching the target's own counter-keyed
+samples (see SPECULATIVE DECODE below).
+
 ``Engine.trace_counts`` is the compilation-count hook: the counter
 increments inside each traced Python body, so a retrace — the thing
 this design forbids — is observable as a count > 1 (`test_serving::
@@ -41,6 +48,40 @@ engine and the regenerated stream is token-identical to the lost one,
 at ANY temperature — idempotent resubmission as a sampling property,
 not a greedy-only accident.
 
+RADIX PREFIX CACHE (``prefix_cache=True``, the default): admission
+consults the pool's radix matcher (`kv_pool.RadixIndex`) with the
+request's FULL prompt (explicit ``prefix=`` tuple, if any, simply
+concatenated in front — the explicit API is a thin wrapper that also
+pins the page's registration length), installs the longest registered
+page, and prefills only the remainder. Requests WITHOUT an explicit
+prefix auto-register a page at the chunk-aligned share point
+``((len - 1) // prefill_chunk) * prefill_chunk`` — canonical lengths,
+so requests that split prefix/prompt differently still converge on one
+key. Token parity is untouched by a hit: chunked prefill computes the
+same K/V whatever boundary it resumes from (fp32/toy exact; same bf16
+near-tie caveat as chained `generate`). Near capacity (queue deeper
+than free slots) admission becomes prefix-aware: within a QoS class,
+requests that would HIT are dequeued first — a hit turns a slot over
+sooner, which is the scarce resource under pressure.
+
+SPECULATIVE DECODE (``num_draft=K``): each step, the host proposes K
+tokens per active slot (`spec.ngram_propose` self-drafting by default;
+``draft_propose=`` plugs in a small draft model) and ONE verify
+dispatch scores all slots' chunks. Acceptance is EXACT-MATCH against
+the target's counter-keyed stream (`generate.counter_sample`): draft j
+is accepted iff it equals the token the engine would have sampled at
+that output position anyway. The emitted stream is therefore
+BIT-IDENTICAL to the non-speculative engine — and to solo `generate` —
+at ANY temperature; drafts are pure latency hints, and the counter-seed
+contract (resubmission idempotency, hedging, failover) survives
+verbatim. What speculation changes is DISPATCH COUNT: ~(1 + accepted)
+tokens land per verify instead of 1 per decode step — decode is
+weight-streaming-bound on TPU, so fewer dispatches ≈ proportionally
+fewer HBM weight streams. Accept rate is banked per request
+(`RequestRecord.n_drafted/n_accepted`) and per class (the metrics
+window), and the verify step reads back its per-slot accept counts —
+the one host sync speculation's variable-rate emission costs.
+
 ASYNC DISPATCH: the decode control vectors (token/index/active/seed/
 output-position per slot) live on DEVICE and are patched in place at
 join/leave boundaries, so the step chain is dispatch-only from the
@@ -51,13 +92,15 @@ dispatching the next — per-step outputs accumulate in a device-side
 log and are materialized once, at retirement. With an ``eos_id`` the
 engine must observe each step's tokens to retire rows (one small
 blocking readback per step) — the latency cost of data-dependent
-control, paid only when asked for.
+control, paid only when asked for. Speculative mode always reads back
+(drafting needs the history; accept counts gate retirement).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -65,11 +108,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex1_tpu.models.generate import last_real_logits, sample_token
+from apex1_tpu.models.generate import (counter_sample, last_real_logits,
+                                       sample_token)
 from apex1_tpu.resilience.retry import _mix32
 from apex1_tpu.serving.kv_pool import KVPool
 from apex1_tpu.serving.metrics import ServingMetrics
 from apex1_tpu.serving.scheduler import Backpressure, Request, Scheduler
+from apex1_tpu.serving.spec import ngram_propose
 from apex1_tpu.utils.observability import MetricsLogger, annotate
 
 
@@ -101,12 +146,30 @@ class EngineConfig:
                                  # (see derive_request_seed)
     max_queue: int = 64          # admission backpressure bound
     policy: str = "fifo"         # or "sjf" (see serving.scheduler)
+    prefix_cache: bool = True    # radix cross-request prefix matching
+    max_prefix_pages: int = 32   # LRU-by-last-hit page bound
+    num_draft: int = 0           # >0: speculative decode, K drafts per
+                                 # verify (the second executable becomes
+                                 # the (1, K+1) chunk-verify)
+    max_ngram: int = 3           # self-draft prompt-lookup n-gram cap
+    cache_dtype: Optional[object] = None  # e.g. jnp.int8 — the KV pool's
+    # steady-state capacity tier (half the bytes/slot ⇒ ~2x max_slots
+    # for the same HBM; perf_model.kv_cache_bytes is the sizing model).
+    # The Engine(cache_dtype=) kwarg still overrides (degraded-mode
+    # restarts use it); None = the decoder's compute dtype.
 
     def __post_init__(self):
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if self.max_len < 2:
             raise ValueError("max_len must be >= 2")
+        if self.num_draft < 0:
+            raise ValueError(
+                f"num_draft must be >= 0, got {self.num_draft}")
+        if self.max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {self.max_ngram}")
+        if self.max_prefix_pages < 1:
+            raise ValueError("max_prefix_pages must be >= 1")
 
 
 @dataclasses.dataclass
@@ -132,6 +195,12 @@ class _Slot:
     in_batch: bool = False       # joined the decode batch (not retired
     eos_seen: bool = False       #  at prefill)
     produced: List[int] = dataclasses.field(default_factory=list)
+    # speculative bookkeeping: the request's full known token history
+    # (prefix + prompt + emitted — the self-draft corpus) and the
+    # per-request accept-rate numerators the terminal event banks
+    history: List[int] = dataclasses.field(default_factory=list)
+    drafted: int = 0
+    accepted: int = 0
 
 
 class Engine:
@@ -140,35 +209,50 @@ class Engine:
 
     Drive it with `submit` + `step`/`run`; finished requests appear in
     `results`. One `step()` = retire (deadline/cancel) → admit queued
-    requests into free slots (chunked prefill) → one pooled decode
-    step. ``metrics`` collects the full lifecycle (`ServingMetrics`).
+    requests into free slots (chunked prefill) → one pooled decode (or
+    speculative verify) step. ``metrics`` collects the full lifecycle
+    (`ServingMetrics`). ``draft_propose(history, k) -> k ints`` plugs a
+    custom draft source into speculative mode (default: n-gram
+    prompt-lookup self-drafting, zero extra params).
     """
 
     def __init__(self, apply_fn: Callable, make_cache: Callable, params,
                  config: Optional[EngineConfig] = None, *,
                  metrics_logger: Optional[MetricsLogger] = None,
-                 cache_dtype=None):
+                 cache_dtype=None,
+                 draft_propose: Optional[Callable] = None):
         self.cfg = cfg = config or EngineConfig()
         self.params = params
         self._apply_fn = apply_fn
-        # the pool carries prefill_chunk-1 slack positions past the
-        # usable max_len: the FINAL prefill chunk is right-padded to the
-        # full chunk width, so its write can extend up to that far past
-        # the last real token — without the slack,
-        # `dynamic_update_slice` would CLAMP the start index and
-        # silently shift the whole chunk onto earlier K/V (the same
-        # hazard generate()'s capacity check guards). The pad K/V in
-        # the slack is masked (never attended) and overwritten by later
+        self._spec = cfg.num_draft > 0
+        # the pool carries slack positions past the usable max_len: the
+        # FINAL prefill chunk is right-padded to the full chunk width,
+        # so its write can extend up to prefill_chunk-1 past the last
+        # real token — without the slack, `dynamic_update_slice` would
+        # CLAMP the start index and silently shift the whole chunk onto
+        # earlier K/V (the same hazard generate()'s capacity check
+        # guards). A speculative verify writes num_draft+1 entries at
+        # the current index the same way, so the slack is the max of
+        # the two write widths minus one. The pad/rejected K/V in the
+        # slack is masked (never attended) and overwritten by later
         # writes; max_len itself stays the admission contract.
-        self.kv = KVPool(make_cache, cfg.max_slots,
-                         cfg.max_len + cfg.prefill_chunk - 1,
-                         dtype=cache_dtype)
+        slack = max(cfg.prefill_chunk, cfg.num_draft + 1) - 1
+        if cache_dtype is None:
+            cache_dtype = cfg.cache_dtype    # kwarg (degraded-mode
+        #                                      restarts) beats config
+        self.kv = KVPool(make_cache, cfg.max_slots, cfg.max_len + slack,
+                         dtype=cache_dtype,
+                         max_pages=cfg.max_prefix_pages)
         self.scheduler = Scheduler(max_queue=cfg.max_queue,
                                    policy=cfg.policy)
         self.metrics = ServingMetrics(metrics_logger)
         self.results: Dict[int, RequestResult] = {}
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        self.trace_counts = ({"prefill": 0, "verify": 0} if self._spec
+                             else {"prefill": 0, "decode": 0})
         self._slots: List[Optional[_Slot]] = [None] * cfg.max_slots
+        self._draft_propose = draft_propose or (
+            lambda hist, k: ngram_propose(hist, k,
+                                          max_ngram=cfg.max_ngram))
         # device-resident control vectors, patched in place at
         # join/leave boundaries — the steady-state step chain re-feeds
         # the previous step's outputs without ever touching the host.
@@ -183,10 +267,26 @@ class Engine:
         self._n_active = 0
         # eos_id=None: retirement is length-based, so step tokens are
         # only READ at retirement — the log keeps each step's (N,)
-        # output (device array until first fetch memoizes it as numpy)
-        self._defer = cfg.eos_id is None
+        # output (device array until first fetch memoizes it as numpy).
+        # Speculative mode always reads back (drafting needs history).
+        self._defer = cfg.eos_id is None and not self._spec
         self._tok_log: Dict[int, object] = {}
         self._step_no = 0
+        # the mid-admission cancel window: `cancel` from an ingest
+        # thread while `_admit` runs this request's prefill chain. The
+        # lock serializes the flag handshake (check+add vs clear+read)
+        # — without it a cancel that passed the _mid_admit check could
+        # land its _cancel_mid entry just after _admit drained the set,
+        # returning True for a cancel that never happens (review
+        # finding)
+        self._mid_admit: Optional[int] = None
+        self._cancel_mid: set = set()
+        self._admit_lock = threading.Lock()
+        # prefix-aware admission probe memo, invalidated whenever the
+        # page store changes (bounded by the queue: one bool per
+        # queued request per store version)
+        self._probe_cache: Dict[int, bool] = {}
+        self._probe_cache_ver = -1
         self._build_executables()
 
     # ---- the two executables -------------------------------------------
@@ -195,6 +295,7 @@ class Engine:
         cfg = self.cfg
         apply_fn = self._apply_fn
         C = cfg.prefill_chunk
+        K = cfg.num_draft
         sample_kw = dict(temperature=cfg.temperature, top_k=cfg.top_k,
                          vocab_size=cfg.vocab_size)
 
@@ -238,12 +339,46 @@ class Engine:
             adv = active.astype(jnp.int32)
             return nxt, idxs + adv, pos + adv, pool
 
+        def verify(params, pool, toks, idxs, active, seeds, pos,
+                   drafts):
+            self.trace_counts["verify"] += 1    # the compile-count hook
+
+            def row(tok, lane, idx, seed, p, dr):
+                lane = jax.tree_util.tree_map(lambda x: x[None], lane)
+                chunk = jnp.concatenate([tok[None], dr])      # (K+1,)
+                logits, lane = apply_fn(params, chunk[None], lane, idx,
+                                        chunk_decode=True)
+                # the target's CANONICAL stream at positions p..p+K —
+                # exact-match acceptance means emitted tokens are these
+                # samples verbatim, so speculation cannot perturb the
+                # (params, prompt, seed) purity resubmission rides
+                tgt = counter_sample(
+                    logits[0], seed, p + jnp.arange(K + 1, dtype=jnp.int32),
+                    **sample_kw)
+                a = jnp.sum(jnp.cumprod(
+                    (tgt[:K] == dr).astype(jnp.int32)))
+                return tgt, a, jax.tree_util.tree_map(
+                    lambda x: x[0], lane)
+
+            tgt, acc, pool = jax.vmap(row)(toks, pool, idxs, seeds, pos,
+                                           drafts)
+            acc = jnp.where(active, acc, 0)
+            adv = jnp.where(active, acc + 1, 0)
+            nxt = jnp.where(
+                active,
+                jnp.take_along_axis(tgt, acc[:, None], 1)[:, 0],
+                cfg.pad_id)
+            return tgt, acc, nxt, idxs + adv, pos + adv, pool
+
         # donate the pool so XLA updates the cache in place; CPU lacks
         # input/output aliasing for some buffers — skip there to avoid
         # per-call warnings (semantics identical, one extra copy)
         donate = () if jax.default_backend() == "cpu" else (1,)
         self._prefill = jax.jit(prefill, donate_argnums=donate)
-        self._decode = jax.jit(decode, donate_argnums=donate)
+        if self._spec:
+            self._verify = jax.jit(verify, donate_argnums=donate)
+        else:
+            self._decode = jax.jit(decode, donate_argnums=donate)
 
     # ---- submission -----------------------------------------------------
 
@@ -289,10 +424,17 @@ class Engine:
         shared-prefix page are released before this returns, not at
         the next step boundary — a frontend cancelling a hedge loser
         (or shedding load) must get the capacity back now, and an idle
-        engine that is never stepped again must not leak the slot."""
+        engine that is never stepped again must not leak the slot. A
+        request whose ADMISSION is being built right now (an ingest
+        thread racing the engine loop's prefill chain) is flagged and
+        retired the moment the chain completes."""
         if self.scheduler.cancel(req_id):
             self._finish(req_id, "cancelled", "cancelled queued", [])
             return True
+        with self._admit_lock:
+            if req_id == self._mid_admit:
+                self._cancel_mid.add(req_id)
+                return True
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.req_id == req_id:
                 self._retire(i, "cancelled", "cancelled running")
@@ -303,8 +445,8 @@ class Engine:
 
     def step(self) -> int:
         """One engine iteration: retire (deadline/cancel) → admit → one
-        decode step over every occupied slot. Returns the number of
-        active slots that decoded (0 = idle)."""
+        decode (or speculative verify) step over every occupied slot.
+        Returns the number of active slots that decoded (0 = idle)."""
         now = time.monotonic()
         for req in self.scheduler.expire(now):
             self._finish(req.req_id, "evicted", "deadline (queued)", [])
@@ -320,6 +462,15 @@ class Engine:
             self.metrics.step_sample(0, self.cfg.max_slots,
                                      self.scheduler.depth)
             return 0
+        if self._spec:
+            self._spec_step()
+        else:
+            self._decode_step()
+        self.metrics.step_sample(n_active, self.cfg.max_slots,
+                                 self.scheduler.depth)
+        return n_active
+
+    def _decode_step(self):
         with annotate("serving/decode_step"):
             nxt, idxs, pos, self.kv.cache = self._decode(
                 self.params, self.kv.cache, self._d_toks, self._d_idxs,
@@ -339,15 +490,71 @@ class Engine:
             if toks is not None:
                 tok = int(toks[i])
                 slot.produced.append(tok)
+                slot.history.append(tok)
                 if tok == self.cfg.eos_id:
                     slot.eos_seen = True
                     self._retire(i, "done", "eos")
                     continue
             if slot.n_out >= slot.req.max_new_tokens:
                 self._retire(i, "done", "length")
-        self.metrics.step_sample(n_active, self.cfg.max_slots,
-                                 self.scheduler.depth)
-        return n_active
+
+    def _spec_step(self):
+        """One draft → verify round for every occupied slot: the host
+        proposes K tokens per slot from its own history, ONE verify
+        dispatch scores all slots, and each slot emits its accepted
+        prefix + the correction token (1..K+1 tokens per round). The
+        per-slot accept counts gate retirement, so this path always
+        reads the (small) verify outputs back."""
+        cfg = self.cfg
+        K = cfg.num_draft
+        drafts = np.zeros((cfg.max_slots, K), np.int32)
+        for i, st in enumerate(self._slots):
+            if st is not None and st.in_batch:
+                drafts[i] = np.asarray(
+                    self._draft_propose(st.history, K),
+                    np.int32).reshape(K)
+        with annotate("serving/verify_step"):
+            tgt, acc, nxt, idxs, pos, self.kv.cache = self._verify(
+                self.params, self.kv.cache, self._d_toks, self._d_idxs,
+                self._d_active, self._d_seeds, self._d_pos,
+                jnp.asarray(drafts))
+        self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
+        tgt_np = np.asarray(tgt)
+        acc_np = np.asarray(acc)
+        self._step_no += 1
+        for i, st in enumerate(self._slots):
+            if st is None or not st.in_batch:
+                continue
+            a = int(acc_np[i])
+            remaining = st.req.max_new_tokens - st.n_out
+            emitted = [int(t) for t in tgt_np[i, :a + 1][:remaining]]
+            # accept-rate accounting clamps to the EMISSION window:
+            # only `remaining` draft positions could ever land, so a
+            # truncated final round must not credit drafts past it —
+            # uncapped counts systematically overstate draft quality
+            # on short completions (review finding)
+            d_used = min(K, remaining)
+            a_used = min(a, d_used)
+            st.drafted += d_used
+            st.accepted += a_used
+            self.metrics.incr("spec_drafted", d_used)
+            self.metrics.incr("spec_accepted", a_used)
+            done_reason = None
+            n_emit = 0
+            for t in emitted:
+                st.produced.append(t)
+                st.history.append(t)
+                st.n_out += 1
+                n_emit += 1
+                if cfg.eos_id is not None and t == cfg.eos_id:
+                    st.eos_seen = True
+                    done_reason = "eos"
+                    break
+            self.metrics.event(st.req.req_id, "token", n=n_emit)
+            if done_reason is None and st.n_out >= st.req.max_new_tokens:
+                done_reason = "length"
+            if done_reason is not None:
+                self._retire(i, "done", done_reason)
 
     def run(self, max_steps: Optional[int] = None) -> Dict[int,
                                                            RequestResult]:
@@ -365,49 +572,175 @@ class Engine:
 
     def _admit_all(self):
         while self.kv.n_free > 0:
-            batch = self.scheduler.pop(1)
+            prefer = None
+            if (self.cfg.prefix_cache
+                    and self.scheduler.depth > self.kv.n_free):
+                # near capacity: slots are the scarce resource, and a
+                # radix hit turns one over sooner — prefer hits WITHIN
+                # a class (the scheduler never lets this cross the
+                # QoS lattice)
+                prefer = self._would_hit
+            batch = self.scheduler.pop(1, prefer=prefer)
             if not batch:
                 return
             self._admit(batch[0])
 
+    def _full_prompt(self, req: Request) -> np.ndarray:
+        if req.prefix:
+            return np.concatenate([np.asarray(req.prefix, np.int32),
+                                   req.tokens])
+        return req.tokens
+
+    def _would_hit(self, req: Request) -> bool:
+        """Prefix-aware admission probe: would this queued request hit
+        a registered page right now? A host-side radix walk — never a
+        device op — and memoized per (request, page-store version):
+        `pop(prefer=)` evaluates it for every queued request on every
+        admission, so an uncached probe would cost O(depth x prompt)
+        host work per freed slot while the queue stays deep (review
+        finding)."""
+        ver = self.kv.store_version
+        if self._probe_cache_ver != ver:
+            self._probe_cache_ver = ver
+            self._probe_cache.clear()
+        hit = self._probe_cache.get(req.req_id)
+        if hit is None:
+            full = self._full_prompt(req)
+            hit = self.kv.match(full, int(full.size) - 1)[1] is not None
+            if len(self._probe_cache) >= 2 * self.cfg.max_queue:
+                # entries for long-departed requests only die on a
+                # store-version bump; an all-hit steady state never
+                # bumps, so cap the memo outright (a wholesale clear
+                # just re-probes the <= max_queue live entries) —
+                # review finding
+                self._probe_cache.clear()
+            self._probe_cache[req.req_id] = hit
+        return hit
+
     def _admit(self, req: Request):
         cfg = self.cfg
+        if (req.deadline is not None
+                and req.deadline <= time.monotonic()):
+            # expired between the step's expire() sweep and this
+            # admission (e.g. while an earlier admission's prefill ran)
+            # — evict before paying prefill or touching the pool
+            self._finish(req.req_id, "evicted", "deadline (queued)", [])
+            return
         slot = self.kv.alloc()
         assert slot is not None
-        self.metrics.event(req.req_id, "prefill")
-        with annotate("serving/prefill"):
-            idx0 = 0
-            install_lane = self.kv.zeros_lane
-            if req.prefix:
-                if self.kv.has_prefix(req.prefix):
-                    page = self.kv.acquire_prefix(req.prefix, slot)
+        prefix = tuple(req.prefix) if req.prefix else ()
+        full = self._full_prompt(req)
+        key = page = None
+        if cfg.prefix_cache:
+            # cap at len-1: a full-prompt hit must still leave >= 1
+            # real token to prefill (the logit the first token samples
+            # from)
+            key, page = self.kv.match(full, int(full.size) - 1)
+            self.metrics.incr("prefix_lookups")
+            if page is not None:
+                self.metrics.incr("prefix_hits")
+                self.metrics.incr("prefix_saved_tokens", page.length)
+        elif prefix:
+            # radix matching off: the PR-7 exact-tuple contract still
+            # holds — a second sharer of the same explicit prefix must
+            # reuse (not re-register: put_prefix would raise) the page
+            # (review finding)
+            page = self.kv.get_prefix(prefix)
+            key = prefix if page is not None else None
+        hit = page is not None
+        self.metrics.event(
+            req.req_id, "prefill",
+            prefix_hit=(hit if cfg.prefix_cache else None),
+            prefix_saved=(page.length if hit else 0))
+        with self._admit_lock:
+            self._mid_admit = req.req_id
+        try:
+            with annotate("serving/prefill"):
+                if hit:
+                    self.kv.acquire_prefix(key, slot)
                     install_lane, idx0 = page.lane, page.length
-                else:
+                    if (prefix and idx0 < len(prefix)
+                            and not self.kv.has_prefix(prefix)):
+                        # partial hit below the caller's stated share
+                        # point: pay the prefix remainder, then pin the
+                        # explicit page at its stated length so later
+                        # sharers hit in full
+                        self._run_chunks(slot, full[idx0:len(prefix)],
+                                         idx0, install_lane, req.seed)
+                        self._register_page(slot, prefix, len(prefix))
+                        install_lane, idx0 = None, len(prefix)
+                    tok0 = self._run_chunks(slot, full[idx0:], idx0,
+                                            install_lane, req.seed)
+                elif prefix:
                     # first sharer pays: run the prefix's own chunks,
                     # snapshot the lane as the page, keep going
-                    self._run_chunks(slot, np.asarray(req.prefix,
-                                                      np.int32),
-                                     0, self.kv.zeros_lane, req.seed)
-                    lane = jax.tree_util.tree_map(
-                        lambda x: x[slot:slot + 1], self.kv.cache)
-                    self.kv.put_prefix(req.prefix, lane,
-                                       len(req.prefix))
-                    self.kv.acquire_prefix(req.prefix, slot)
-                    install_lane, idx0 = None, len(req.prefix)
-            tok0 = self._run_chunks(slot, req.tokens, idx0, install_lane,
-                                    req.seed)
+                    self._run_chunks(slot, full[:len(prefix)], 0,
+                                     self.kv.zeros_lane, req.seed)
+                    self._register_page(slot, prefix, len(prefix))
+                    tok0 = self._run_chunks(slot, full[len(prefix):],
+                                            len(prefix), None, req.seed)
+                else:
+                    tok0 = self._run_chunks(slot, full, 0,
+                                            self.kv.zeros_lane, req.seed)
+            if cfg.prefix_cache and not prefix:
+                # auto-registration at the CHUNK-ALIGNED share point:
+                # canonical lengths, so requests that split the same
+                # prompt differently converge on one key. The last
+                # token stays uncached (a future identical prompt must
+                # still prefill >= 1 token).
+                C = cfg.prefill_chunk
+                lstar = ((int(full.size) - 1) // C) * C
+                if lstar >= C and lstar > (page.length if hit else 0):
+                    akey = tuple(int(t) for t in full[:lstar])
+                    if not self.kv.has_prefix(akey):
+                        self._register_page(slot, akey, lstar)
+        except BaseException:
+            # the first-sharer stranding window (ISSUE 15 satellite): a
+            # prefill chain that dies mid-flight (chaos kill, XLA
+            # error) must not leak the allocated slot or any acquired
+            # page refs — free() releases both, fully-registered pages
+            # stay (their snapshots completed), and the request's
+            # verdict belongs to the caller's supervision (re-raise)
+            self.kv.free(slot)
+            with self._admit_lock:
+                self._mid_admit = None
+                self._cancel_mid.discard(req.req_id)
+            raise
         self.metrics.event(req.req_id, "first_token")
-        idx = idx0 + int(req.tokens.size)
-        st = _Slot(req=req, first_tok=tok0, start_step=self._step_no)
+        idx = int(full.size)
+        st = _Slot(req=req, first_tok=tok0, start_step=self._step_no,
+                   history=[int(t) for t in full])
         self._slots[slot] = st
+        # close the mid-admission window only AFTER the slot is
+        # published (a cancel arriving from here on routes to the
+        # _slots scan), then drain any cancel that landed during the
+        # chain under the handshake lock — clearing before publication
+        # left a gap where a concurrent cancel found neither
+        # _mid_admit nor _slots and returned a false False (review
+        # finding)
+        with self._admit_lock:
+            self._mid_admit = None
+            cancelled = req.req_id in self._cancel_mid
+            self._cancel_mid.discard(req.req_id)
+        first = None
         if not self._defer:
             first = int(np.asarray(tok0))
             st.produced.append(first)
+            st.history.append(first)
             st.first_tok = first
-            if first == cfg.eos_id:
-                st.eos_seen = True
-                self._retire(slot, "done", "eos")
-                return
+        if cancelled:
+            # the cancel preceded any published result, so it wins
+            # over an eos/length completion in this same admission —
+            # the caller already holds cancel()'s True (review
+            # finding: this used to lose to the eos retire and leak
+            # the _cancel_mid entry)
+            self._retire(slot, "cancelled", "cancelled running")
+            return
+        if (not self._defer and cfg.eos_id is not None
+                and first == cfg.eos_id):
+            st.eos_seen = True
+            self._retire(slot, "done", "eos")
+            return
         if req.max_new_tokens == 1:
             # finished at prefill: never occupies a decode step
             self._retire(slot, "done", "length")
@@ -423,6 +756,16 @@ class Engine:
         self._d_pos = self._d_pos.at[slot].set(1)
         st.in_batch = True
         self._n_active += 1
+
+    def _register_page(self, slot: int, pkey: tuple, length: int):
+        """Snapshot ``slot``'s lane (which holds ``length`` completed
+        positions) as a refcounted prefix page — put + acquire as one
+        step, so no exception window can leave a registered page
+        without its owner's ref."""
+        lane = jax.tree_util.tree_map(lambda x: x[slot:slot + 1],
+                                      self.kv.cache)
+        self.kv.put_prefix(pkey, lane, length)
+        self.kv.acquire_prefix(pkey, slot)
 
     def _run_chunks(self, slot: int, tokens: np.ndarray, idx0: int,
                     install_lane, seed: int):
@@ -486,14 +829,16 @@ class Engine:
             self._d_active = self._d_active.at[slot_idx].set(False)
             self._n_active -= 1
         self.kv.free(slot_idx)
-        self._finish(slot.req.req_id, status, reason, produced)
+        spec = ({"n_drafted": slot.drafted, "n_accepted": slot.accepted}
+                if self._spec else {})
+        self._finish(slot.req.req_id, status, reason, produced, **spec)
 
     def _finish(self, req_id: int, status: str, reason: str,
-                produced: List[int]):
+                produced: List[int], **fields):
         if status == "evicted" and not reason.startswith("shed"):
             self.metrics.incr("evictions")  # sheds counted separately
         self.metrics.event(req_id, status, reason=reason,
-                           n_generated=len(produced))
+                           n_generated=len(produced), **fields)
         self.results[req_id] = RequestResult(
             req_id=req_id, status=status,
             tokens=np.asarray(produced, np.int32), reason=reason)

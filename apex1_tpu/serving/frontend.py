@@ -118,6 +118,14 @@ class FrontendConfig:
     #  attached autopilot drives transitions from latency percentiles)
     metrics_window: int = 128      # rolling-percentile ring size for a
     #                                frontend-constructed ServingMetrics
+    cache_dtype: Optional[object] = None  # STEADY-STATE KV tier for
+    #  every replica build (e.g. jnp.int8 — half the bytes/slot buys
+    #  ~2x resident batch for the same HBM; docs/serving.md § int8
+    #  capacity tier). Distinct from DegradeProfile.cache_dtype, which
+    #  only kicks in for replicas (re)built while degraded and takes
+    #  precedence there. Requires a make_engine that accepts
+    #  ``cache_dtype``; silently unused otherwise (same rule as the
+    #  degrade profile).
 
 
 class ServingFrontend:
@@ -390,9 +398,11 @@ class ServingFrontend:
 
     def _build_engine(self) -> Engine:
         prof = self.cfg.degrade
-        if (self.mode == "degraded" and self._takes_cache_dtype
-                and prof.cache_dtype is not None):
-            return self._make_engine(cache_dtype=prof.cache_dtype)
+        dtype = self.cfg.cache_dtype        # the steady-state tier;
+        if self.mode == "degraded" and prof.cache_dtype is not None:
+            dtype = prof.cache_dtype        # degraded relief wins
+        if dtype is not None and self._takes_cache_dtype:
+            return self._make_engine(cache_dtype=dtype)
         return self._make_engine()
 
     def _alive(self) -> List[ReplicaSupervisor]:
@@ -784,4 +794,23 @@ class ServingFrontend:
                            "retiring": r.replica_id in self._retiring,
                            **self._rep_counters[r.replica_id]}
             for r in self.replicas}
+        # goodput-multiplier rates, aggregated across the CURRENT
+        # replica engines (engine metrics die with their engine — these
+        # are live-fleet rates, not all-time; fields-only-when-data,
+        # same contract as the percentiles)
+        agg = {k: 0 for k in ("prefix_lookups", "prefix_hits",
+                              "prefix_saved_tokens", "spec_drafted",
+                              "spec_accepted")}
+        for r in self.replicas:
+            eng = r.engine
+            if eng is None:
+                continue
+            for k in agg:
+                agg[k] += eng.metrics.get_counter(k)
+        if agg["prefix_lookups"]:
+            s["prefix_hit_rate"] = (agg["prefix_hits"]
+                                    / agg["prefix_lookups"])
+            s["prefix_saved_tokens"] = agg["prefix_saved_tokens"]
+        if agg["spec_drafted"]:
+            s["accept_rate"] = agg["spec_accepted"] / agg["spec_drafted"]
         return s

@@ -1,4 +1,5 @@
-"""Fixed-slot KV pool + shared-prefix store for the serving engine.
+"""Fixed-slot KV pool + radix-matched shared-prefix store for the
+serving engine.
 
 The pool IS the existing cache layout (`models.generate.init_cache`:
 ``{"layer{i}": {"k","v": (max_slots, Hkv, max_len, D)}}``) — slot s is
@@ -10,19 +11,39 @@ jitted executables. This module is the HOST-side bookkeeping around
 that device pytree: which lanes are free, and which shared-prefix
 K/V snapshots exist.
 
-Prefix sharing is at SLOT granularity (not paged): a common system
-prompt's K/V is computed once, snapshotted as a batch-1 lane pytree
+Prefix sharing is at SLOT granularity (not paged): a common prompt
+prefix's K/V is computed once, snapshotted as a batch-1 lane pytree
 ("page"), and INSTALLED (one on-device lane copy inside the prefill
 executable) into each slot that reuses it — the prefix's attention
 FLOPs are paid once per distinct prefix, not once per request. Pages
 are refcounted: a page acquired by a live slot can never be evicted
 (`test_serving::TestPrefixRefcounts::test_refcount_never_frees_live_page`).
+
+CROSS-REQUEST MATCHING (`RadixIndex` + `match`): pages are keyed by
+their token tuple and indexed in a token-granular radix trie, so an
+arriving request deduplicates against the LONGEST registered prefix of
+its full prompt automatically — no caller-passed ``prefix=`` tuple
+required (the explicit API registers its page at the caller's stated
+length; the engine's auto path registers at chunk-aligned lengths so
+requests that split prefix/prompt differently converge on the same
+keys). A page installed into a slot is a VALUE copy (the install is a
+``jnp.where`` inside the prefill executable), so matching a page
+shorter than the snapshot it was cut from is safe: positions past the
+matched length hold the donor request's stale K/V, which the engine's
+attention horizon (``pos <= idx``) can never reach before the sharer's
+own chunk writes overwrite them.
+
+EVICTION is LRU-by-last-hit under page pressure (``max_pages``): a
+registration that pushes the store past the bound evicts the
+least-recently-hit refcount-0 pages first; live pages are never
+touched, so a store full of live pages simply runs over its soft
+bound — correctness before memory.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,10 +59,83 @@ class PrefixPage:
     length: int                  # real positions held
     refcount: int = 0            # live slots currently built on it
     hits: int = 0                # admissions served (the saved prefills)
+    last_hit: int = 0            # LRU stamp (pool tick at last acquire)
+
+
+class _Node:
+    """One radix-trie node (token-granular; chunk alignment is a
+    REGISTRATION policy, not a structural constraint — explicit
+    ``prefix=`` pages land at arbitrary lengths in the same index)."""
+
+    __slots__ = ("children", "terminal")
+
+    def __init__(self):
+        self.children: Dict[int, "_Node"] = {}
+        self.terminal = False
+
+
+class RadixIndex:
+    """Longest-prefix matcher over registered token tuples.
+
+    ``insert``/``remove`` maintain the trie; ``match(tokens, max_len)``
+    returns the longest registered key that is a prefix of ``tokens``
+    with length <= ``max_len`` (None when nothing matches). All walks
+    are O(len(tokens)) dict hops — host-side bookkeeping, never on the
+    dispatch path.
+    """
+
+    def __init__(self):
+        self._root = _Node()
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def insert(self, key: Tuple[int, ...]) -> None:
+        node = self._root
+        for t in key:
+            node = node.children.setdefault(int(t), _Node())
+        if not node.terminal:
+            node.terminal = True
+            self._n += 1
+
+    def remove(self, key: Tuple[int, ...]) -> None:
+        path = [self._root]
+        for t in key:
+            node = path[-1].children.get(int(t))
+            if node is None:
+                return
+            path.append(node)
+        if not path[-1].terminal:
+            return
+        path[-1].terminal = False
+        self._n -= 1
+        # prune now-empty suffix nodes so dead keys cost no memory
+        for depth in range(len(key), 0, -1):
+            node = path[depth]
+            if node.children or node.terminal:
+                break
+            del path[depth - 1].children[int(key[depth - 1])]
+
+    def match(self, tokens, max_len: int) -> Optional[Tuple[int, ...]]:
+        node = self._root
+        best = 0
+        for depth, t in enumerate(tokens):
+            if depth >= max_len:
+                break
+            node = node.children.get(int(t))
+            if node is None:
+                break
+            if node.terminal:
+                best = depth + 1
+        if best == 0:
+            return None
+        return tuple(int(t) for t in tokens[:best])
 
 
 class KVPool:
-    """Slot allocator + prefix-page store over one pooled cache pytree.
+    """Slot allocator + radix-matched prefix-page store over one pooled
+    cache pytree.
 
     The device pytree itself is handed back and forth with the engine
     (its jitted calls donate and return it); the pool only tracks lane
@@ -50,11 +144,12 @@ class KVPool:
     """
 
     def __init__(self, make_cache, max_slots: int, max_len: int,
-                 dtype=None):
+                 dtype=None, max_pages: Optional[int] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
         self.max_len = int(max_len)
+        self.max_pages = None if max_pages is None else int(max_pages)
         kw = {} if dtype is None else {"dtype": dtype}
         self.cache = make_cache(self.max_slots, self.max_len, **kw)
         # a zeroed batch-1 lane: installed on admission so a fresh
@@ -64,8 +159,14 @@ class KVPool:
         self.zeros_lane = jax.tree_util.tree_map(
             lambda x: jnp.zeros((1,) + x.shape[1:], x.dtype), self.cache)
         self._free: List[int] = list(range(self.max_slots))
-        self._slot_prefix: Dict[int, tuple] = {}   # slot -> prefix key
+        # slot -> prefix keys it holds refs on (a slot that MATCHED one
+        # page and REGISTERED a longer one holds two)
+        self._slot_prefix: Dict[int, List[tuple]] = {}
         self._prefixes: Dict[tuple, PrefixPage] = {}
+        self._radix = RadixIndex()
+        self._tick = 0               # LRU clock (acquires only)
+        self._version = 0            # bumps on register/evict — lets
+        #                              match() consumers cache probes
 
     # ---- slots ----------------------------------------------------------
 
@@ -86,26 +187,69 @@ class KVPool:
             raise ValueError(f"slot {slot} double-freed")
         if not 0 <= slot < self.max_slots:
             raise ValueError(f"slot {slot} out of range")
-        key = self._slot_prefix.pop(slot, None)
-        if key is not None:
+        for key in self._slot_prefix.pop(slot, []):
             self.release_prefix(key)
         self._free.append(slot)
         self._free.sort()
+
+    @property
+    def store_version(self) -> int:
+        """Monotonic page-store version (bumped by register/evict) —
+        the invalidation token for consumers caching `match` probes
+        (the engine's prefix-aware admission)."""
+        return self._version
+
+    def lane_bytes(self) -> int:
+        """HBM bytes of ONE slot's lane (the unit the int8 capacity
+        tier halves — `perf_model.kv_cache_bytes` is the analytic
+        mirror)."""
+        return sum(x.nbytes for x in
+                   jax.tree_util.tree_leaves(self.zeros_lane))
+
+    def pool_bytes(self) -> int:
+        """HBM bytes of the whole pooled cache pytree."""
+        return sum(x.nbytes for x in
+                   jax.tree_util.tree_leaves(self.cache))
 
     # ---- prefix pages ---------------------------------------------------
 
     def has_prefix(self, key: tuple) -> bool:
         return tuple(key) in self._prefixes
 
+    def get_prefix(self, key: tuple) -> Optional[PrefixPage]:
+        """Exact-tuple page lookup (no radix walk) — the engine's
+        explicit-``prefix=`` path when the radix matcher is disabled."""
+        return self._prefixes.get(tuple(key))
+
+    def match(self, tokens, max_len: int
+              ) -> Tuple[Optional[tuple], Optional[PrefixPage]]:
+        """Longest registered prefix of ``tokens`` not exceeding
+        ``max_len`` positions (the engine caps at ``len(tokens) - 1``
+        so a full-prompt hit still leaves one real token to sample
+        from). Returns ``(key, page)`` or ``(None, None)``."""
+        key = self._radix.match(tokens, int(max_len))
+        if key is None:
+            return None, None
+        return key, self._prefixes[key]
+
     def put_prefix(self, key: tuple, lane, length: int) -> PrefixPage:
         """Register a computed prefix snapshot. ``lane`` is a batch-1
         cache pytree (the engine slices it out of the pool right after
-        the prefix chunks complete)."""
+        the prefix chunks complete). Registration may evict
+        least-recently-hit refcount-0 pages past ``max_pages``."""
         key = tuple(key)
         if key in self._prefixes:
             raise ValueError(f"prefix {key!r} already registered")
-        page = PrefixPage(lane=lane, length=int(length))
+        page = PrefixPage(lane=lane, length=int(length),
+                          last_hit=self._tick)
         self._prefixes[key] = page
+        self._radix.insert(key)
+        self._version += 1
+        # the page being registered is refcount-0 until its owner
+        # acquires it — excluding it here keeps put-then-acquire (the
+        # engine's _register_page) from evicting its own page when
+        # every OTHER page is live (review finding)
+        self.evict_lru(exclude=key)
         return page
 
     def acquire_prefix(self, key: tuple, slot: int) -> PrefixPage:
@@ -114,7 +258,9 @@ class KVPool:
         page = self._prefixes[key]
         page.refcount += 1
         page.hits += 1
-        self._slot_prefix[slot] = key
+        self._tick += 1
+        page.last_hit = self._tick
+        self._slot_prefix.setdefault(slot, []).append(key)
         return page
 
     def release_prefix(self, key: tuple) -> None:
@@ -140,9 +286,30 @@ class KVPool:
                     f"refusing to free a live page")
             return False
         del self._prefixes[key]
+        self._radix.remove(key)
+        self._version += 1
         return True
+
+    def evict_lru(self, exclude: Optional[tuple] = None) -> int:
+        """Walk the store back under ``max_pages``: evict refcount-0
+        pages least-recently-hit first. Live pages are skipped (never
+        freed), so the bound is soft under all-live pressure; so is a
+        page named by ``exclude`` (a just-registered page whose owner
+        has not acquired it yet). Returns pages evicted."""
+        if self.max_pages is None:
+            return 0
+        evicted = 0
+        while len(self._prefixes) > self.max_pages:
+            dead = [(p.last_hit, k) for k, p in self._prefixes.items()
+                    if p.refcount == 0 and k != exclude]
+            if not dead:
+                break                      # all live: soft bound
+            _, dead_key = min(dead)
+            self.evict_prefix(dead_key)
+            evicted += 1
+        return evicted
 
     def prefix_stats(self) -> dict:
         return {repr(k): {"length": p.length, "refcount": p.refcount,
-                          "hits": p.hits}
+                          "hits": p.hits, "last_hit": p.last_hit}
                 for k, p in self._prefixes.items()}

@@ -75,6 +75,14 @@ class RequestRecord:
     reason: str = ""
     qos: Optional[str] = None     # set when the queued event carries it
     tenant: Optional[str] = None  #  (frontend lifecycle records do)
+    # goodput-multiplier observables (ISSUE 15): radix-cache outcome at
+    # admission (None = the engine never looked — prefix cache off or a
+    # frontend-level record) and the speculative accept-rate numerators
+    # the terminal event banks
+    prefix_hit: Optional[bool] = None
+    prefix_saved: int = 0         # cached positions the hit skipped
+    n_drafted: int = 0
+    n_accepted: int = 0
 
     @property
     def ttft(self) -> Optional[float]:
@@ -87,6 +95,15 @@ class RequestRecord:
         if self.t_queued is None or self.t_first_token is None:
             return None
         return self.t_first_token - self.t_queued
+
+    @property
+    def accept_rate(self) -> Optional[float]:
+        """Speculative draft accept rate (None when the request never
+        ran under speculation — fields-only-when-data, like the
+        percentile keys)."""
+        if self.n_drafted <= 0:
+            return None
+        return self.n_accepted / self.n_drafted
 
     @property
     def latency(self) -> Optional[float]:
@@ -114,9 +131,10 @@ class ServingMetrics:
         self.records: Dict[int, RequestRecord] = {}
         self.counters: Dict[str, int] = {}
         self.transitions: list = []
-        # the last `window` TERMINAL requests (qos/tenant/ttft/latency/
-        # status) — the rolling control signal summary()["window"]
-        # reports; deque drops the oldest, O(window) space forever
+        # the last `window` TERMINAL requests (qos/tenant/status/ttft/
+        # latency/prefix_hit/accept_rate) — the rolling control signal
+        # summary()["window"] reports; deque drops the oldest, O(window)
+        # space forever
         self._window: deque = deque(maxlen=max(1, int(window)))
         # step samples fold into RUNNING aggregates (count / occupancy
         # sum / peak queue) — a long-lived engine steps indefinitely,
@@ -157,6 +175,9 @@ class ServingMetrics:
         elif name == "prefill":
             rec.status = "prefill"
             rec.t_prefill = now
+            if fields.get("prefix_hit") is not None:
+                rec.prefix_hit = bool(fields["prefix_hit"])
+                rec.prefix_saved = int(fields.get("prefix_saved", 0))
         elif name == "first_token":
             rec.status = "decode"
             rec.t_first_token = now
@@ -169,9 +190,13 @@ class ServingMetrics:
             rec.reason = str(fields.get("reason", ""))
             rec.n_generated = int(fields.get("n_generated",
                                              rec.n_generated))
+            rec.n_drafted = int(fields.get("n_drafted", rec.n_drafted))
+            rec.n_accepted = int(fields.get("n_accepted",
+                                            rec.n_accepted))
             self._window.append(
                 (rec.qos or "best_effort", rec.tenant, name,
-                 rec.ttft, rec.latency))
+                 rec.ttft, rec.latency, rec.prefix_hit,
+                 rec.accept_rate))
         else:
             raise ValueError(f"unknown lifecycle event {name!r}")
         if name != "token":
@@ -200,6 +225,14 @@ class ServingMetrics:
         names are allowed — they appear in the counters dict too)."""
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def get_counter(self, name: str) -> int:
+        """One counter, under the lock — the cheap cross-object read
+        (`ServingFrontend.summary` aggregates each replica engine's
+        prefix/spec counters through this instead of paying a whole-run
+        `summary()` per replica)."""
+        with self._lock:
+            return int(self.counters.get(name, 0))
 
     def transition(self, name: str, now: Optional[float] = None,
                    **fields) -> dict:
@@ -290,6 +323,19 @@ class ServingMetrics:
         if self._step_n:
             out["mean_occupancy"] = self._occ_sum / self._step_n
             out["peak_queue_depth"] = self._peak_queue
+        # goodput-multiplier rates (fields-only-when-data, same contract
+        # as the percentiles): cumulative over every admission/draft the
+        # engine ever made; the rolling view rides window.per_class
+        lookups = counters.get("prefix_lookups", 0)
+        if lookups:
+            out["prefix_hit_rate"] = counters.get("prefix_hits",
+                                                  0) / lookups
+            out["prefix_saved_tokens"] = counters.get(
+                "prefix_saved_tokens", 0)
+        drafted = counters.get("spec_drafted", 0)
+        if drafted:
+            out["accept_rate"] = counters.get("spec_accepted",
+                                              0) / drafted
         out["window"] = self._window_summary(win)
         return out
 
@@ -306,9 +352,18 @@ class ServingMetrics:
     @staticmethod
     def _window_summary(win: list) -> dict:
         """Per-class / per-tenant percentiles over the ring entries
-        ``(qos, tenant, status, ttft, latency)``. Percentile keys only
-        appear when the class has data — same contract as the
-        whole-run fields."""
+        ``(qos, tenant, status, ttft, latency, prefix_hit,
+        accept_rate)``. Percentile/rate keys only appear when the class
+        has data — same contract as the whole-run fields."""
+        def rates(entries, d):
+            hits = [e[5] for e in entries if e[5] is not None]
+            if hits:
+                d["prefix_hit_rate"] = sum(hits) / len(hits)
+            accs = [e[6] for e in entries if e[6] is not None]
+            if accs:
+                d["accept_rate"] = float(np.mean(accs))
+            return d
+
         def stats(entries, *, with_latency=True):
             d = {"n": len(entries),
                  "done": sum(e[2] == "done" for e in entries)}
@@ -320,7 +375,7 @@ class ServingMetrics:
             if with_latency and lats:
                 d["latency_p50_ms"] = 1e3 * float(np.percentile(lats, 50))
                 d["latency_p99_ms"] = 1e3 * float(np.percentile(lats, 99))
-            return d
+            return rates(entries, d)
 
         by_class: Dict[str, list] = {}
         by_tenant: Dict[str, list] = {}
@@ -328,7 +383,7 @@ class ServingMetrics:
             by_class.setdefault(e[0], []).append(e)
             if e[1] is not None:
                 by_tenant.setdefault(e[1], []).append(e)
-        return {
+        return rates(win, {
             "size": len(win),
             "per_class": {c: stats(es)
                           for c, es in sorted(by_class.items())},
@@ -336,4 +391,4 @@ class ServingMetrics:
             # only needs the TTFT distribution
             "per_tenant": {t: stats(es, with_latency=False)
                            for t, es in sorted(by_tenant.items())},
-        }
+        })

@@ -265,21 +265,35 @@ class Scheduler:
                 dead.sort(key=lambda r: (r.rank, r.deadline))
             return dead
 
-    def pop(self, n: int) -> List[Request]:
+    def pop(self, n: int, prefer=None) -> List[Request]:
         """Up to ``n`` requests to admit: strongest QoS class first,
         the fifo/sjf policy within a class. Deadline expiry is the
         ENGINE's job (call `expire` first) so evictions are observed
-        in one place."""
+        in one place.
+
+        ``prefer`` (optional ``Request -> bool``) is a WITHIN-CLASS
+        tiebreak ranked between the class and the policy: preferred
+        requests dequeue first inside their QoS class, and the class
+        lattice is never crossed (a preferred sheddable request still
+        waits behind every guaranteed one). The engine's prefix-aware
+        admission passes its radix-hit probe here when the pool is
+        near capacity — a hit turns a slot over sooner."""
         with self._lock:
             if n <= 0 or not self._queue:
                 return []
+
+            def boost(i):
+                if prefer is None:
+                    return 0
+                return 0 if prefer(self._queue[i]) else 1
+
             if self.policy == "sjf":
                 def key(i):
-                    return (self._queue[i].rank,
+                    return (self._queue[i].rank, boost(i),
                             self._queue[i].tokens.size, i)
             else:
                 def key(i):
-                    return (self._queue[i].rank, i)
+                    return (self._queue[i].rank, boost(i), i)
             order = sorted(range(len(self._queue)), key=key)
             take = order[:n]
             out = [self._queue[i] for i in take]

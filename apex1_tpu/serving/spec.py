@@ -1,0 +1,67 @@
+"""Host-side draft proposal for the engine's speculative decode loop.
+
+The engine's speculative mode (``EngineConfig.num_draft > 0``) needs K
+proposed tokens per active slot per step. The ZERO-EXTRA-PARAMS default
+is prompt-lookup / n-gram self-drafting (`ngram_propose`): the request's
+own known history (prefix + prompt + everything emitted so far) is the
+draft model — the longest recent n-gram is looked up at its most recent
+earlier occurrence and the tokens that followed it are proposed. That
+captures the two regimes where speculation pays: extractive
+continuations (the answer repeats spans of the prompt) and the
+repetition attractors autoregressive decode falls into.
+
+A SMALL DRAFT MODEL rides the same seam: pass
+``Engine(draft_propose=fn)`` where ``fn(history, k) -> k ints`` — the
+engine does not care how the proposal was made, only that it is a
+host-side function of the request's own history (so drafting never
+perturbs the verified stream: acceptance is decided by the target's
+counter-keyed samples, see docs/serving.md § Speculative decode in the
+engine).
+
+Drafts are PURE LATENCY HINTS under the engine's exact-match verify:
+a wrong draft costs a wasted lane in one verify dispatch, never a
+changed token — so this module needs no seed plumbing and no determinism
+contract beyond being a function of its inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["ngram_propose"]
+
+
+def ngram_propose(history: Sequence[int], k: int, *,
+                  max_ngram: int = 3) -> np.ndarray:
+    """Propose ``k`` draft tokens by prompt-lookup over ``history``.
+
+    Tries the longest suffix n-gram first (``max_ngram`` down to 1):
+    finds its MOST RECENT earlier occurrence in the history and
+    proposes the tokens that followed it, padded by repeating the last
+    proposed (or last history) token when the occurrence sits too near
+    the end. Falls back to repeating the final token — the cheapest
+    guess that wins exactly when decode has entered a fixed point.
+
+    Returns an ``(k,)`` int32 array. ``history`` must be non-empty
+    (the engine always has at least the prompt's first token).
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    h = [int(t) for t in history]
+    n = len(h)
+    if n == 0:
+        raise ValueError("ngram_propose needs a non-empty history")
+    for g in range(min(int(max_ngram), n - 1), 0, -1):
+        pat = h[n - g:]
+        # most recent earlier occurrence (recency beats frequency for
+        # decode loops: the current cycle is the best predictor)
+        for s in range(n - g - 1, -1, -1):
+            if h[s:s + g] == pat:
+                cont = h[s + g:s + g + k]
+                if cont:
+                    while len(cont) < k:
+                        cont.append(cont[-1])
+                    return np.asarray(cont, np.int32)
+    return np.full((k,), h[-1], np.int32)

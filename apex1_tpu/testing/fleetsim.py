@@ -217,6 +217,14 @@ class FleetSimConfig:
     temperature: float = 0.8          # nonzero: determinism claims
     #                                   cover real sampling, not greedy
     vocab: int = 61                   # toy_decoder's default
+    num_draft: int = 0                # >0: replica engines run the
+    #  speculative verify loop. Tokens are UNCHANGED by construction
+    #  (exact-match counter-seed verify), so per-request token digests
+    #  replay bit-identically; rounds/latency shift (multi-token steps)
+    #  — same (trace, seed, config) stays bit-identical, and accept
+    #  rates flow into `summary`/`to_json` for the autopilot to read.
+    cache_dtype: Optional[object] = None  # e.g. jnp.int8 — the KV
+    #  capacity tier under sim (exact for toy_decoder: values < 128)
     drain_grace_s: float = 30.0       # virtual time allowed past the
     #                                   horizon before declaring wedged
     max_rounds: int = 500_000         # hard stop (wedged episode)
@@ -301,18 +309,25 @@ class SimReport:
         per = {cls: {"offered": d["n"], "done": d["done"],
                      "full": d["full"]}
                for cls, d in sorted(self.per_class().items())}
-        return {"trace": self.trace_kind, "seed": self.trace_seed,
-                "trace_fingerprint": self.trace_fingerprint,
-                "n_arrivals": self.n_arrivals,
-                "n_submitted": self.n_submitted,
-                "rejected": self.rejected, "per_class": per,
-                "goodput_tok_per_virtual_s":
-                    round(self.goodput_tok_s(), 2),
-                "n_actions": len(self.actions),
-                "n_transitions": len(self.transitions),
-                "virtual_s": round(self.virtual_s, 3),
-                "rounds": self.rounds,
-                "fingerprint": self.fingerprint()}
+        out = {"trace": self.trace_kind, "seed": self.trace_seed,
+               "trace_fingerprint": self.trace_fingerprint,
+               "n_arrivals": self.n_arrivals,
+               "n_submitted": self.n_submitted,
+               "rejected": self.rejected, "per_class": per,
+               "goodput_tok_per_virtual_s":
+                   round(self.goodput_tok_s(), 2),
+               "n_actions": len(self.actions),
+               "n_transitions": len(self.transitions),
+               "virtual_s": round(self.virtual_s, 3),
+               "rounds": self.rounds,
+               "fingerprint": self.fingerprint()}
+        # goodput-multiplier rates (ISSUE 15), when the episode banked
+        # them — ride the report, NOT the fingerprint (pre-existing
+        # traces must fingerprint bit-stably)
+        for k in ("prefix_hit_rate", "accept_rate"):
+            if k in self.summary:
+                out[k] = round(self.summary[k], 4)
+        return out
 
 
 class FleetSim:
@@ -343,9 +358,14 @@ class FleetSim:
             prefill_chunk=self.cfg.prefill_chunk,
             vocab_size=self.cfg.vocab,
             temperature=self.cfg.temperature,
+            num_draft=self.cfg.num_draft,
+            cache_dtype=self.cfg.cache_dtype,
             seed=frontend_config.seed)
 
         def make_engine(cache_dtype=None):
+            # a degraded-mode restart's explicit dtype overrides the
+            # sim's steady-state tier (the Engine kwarg-beats-config
+            # rule)
             return Engine(apply_fn, make_cache, params, ecfg,
                           cache_dtype=cache_dtype)
 

@@ -99,7 +99,12 @@ SURFACE = {
     "apex1_tpu.serving": [
         "Engine", "EngineConfig", "RequestResult", "Scheduler",
         "Request", "Backpressure", "KVPool", "PrefixPage",
+        "RadixIndex", "ngram_propose",
         "ServingMetrics", "RequestRecord"],
+    "apex1_tpu.models.generate": [
+        "generate", "speculative_generate", "beam_search", "t5_generate",
+        "init_cache", "cached_attention", "sample_token",
+        "counter_sample", "last_real_logits"],
     "apex1_tpu.core.mesh": [
         "make_mesh", "make_hybrid_mesh", "MeshConfig", "MeshResource",
         "shard_batch", "replicate"],
@@ -149,7 +154,8 @@ SURFACE = {
     "apex1_tpu.perf_model": [
         "roofline", "kernel_cases", "flash_flops_bytes",
         "linear_xent_flops", "ring_attention_comms",
-        "sp_boundary_comms", "allreduce_bytes"],
+        "sp_boundary_comms", "allreduce_bytes",
+        "kv_cache_bytes", "serving_capacity", "speculative_speedup"],
     "apex1_tpu.autopilot": [
         "Autopilot", "AutopilotConfig", "SLOTarget", "FleetView",
         "ControllerState", "Action", "decide", "default_slo"],

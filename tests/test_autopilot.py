@@ -730,6 +730,30 @@ class TestDriftGate:
         (d / "calibration.json").write_text(json.dumps(cal))
         assert drift_mod.run_gate(str(d)) == 1
 
+    def test_tolerates_spec_serving_record_fields(self, tmp_path,
+                                                  drift_mod):
+        """ISSUE 15 satellite: the new bench_serving record shape
+        (multiplier_sweep rows with prefix_hit_rate / accept_rate /
+        goodput + the int8 capacity block) banked into the corpus dir
+        must not move the gate — serving benches join no
+        predicted-step row, so they are NOT calibration evidence and
+        the gate must neither fit from them nor fail-closed on them."""
+        d = _mini_corpus(tmp_path)
+        rec = {"metric": "serving tokens/sec gpt2-serving [cpu]",
+               "value": 1234.5, "unit": "tokens/sec",
+               "multiplier_sweep": {
+                   "rows": [{"config": "radix_spec",
+                             "prefix_hit_rate": 0.92,
+                             "accept_rate": 0.41,
+                             "goodput_tokens_per_sec": 999.0}],
+                   "goodput_multiple": 1.31,
+                   "int8_capacity": {"slots_bf16": 8,
+                                     "slots_int8_same_budget": 16}}}
+        (d / "bench_spec_serving.json").write_text(json.dumps(rec))
+        (d / "bench_spec_serving_cpu.log").write_text(
+            json.dumps(rec) + "\n")
+        assert drift_mod.run_gate(str(d)) == 0
+
     def test_fail_closed_on_unreadable_evidence(self, tmp_path,
                                                 drift_mod):
         d = _mini_corpus(tmp_path)

@@ -258,6 +258,278 @@ class TestPrefixRefcounts:
         assert not eng.kv.has_prefix(sysp)
 
 
+class TestRadixIndex:
+    def test_longest_prefix_match_with_cap(self):
+        from apex1_tpu.serving import RadixIndex
+        idx = RadixIndex()
+        idx.insert((1, 2))
+        idx.insert((1, 2, 3, 4))
+        assert idx.match([1, 2, 3, 4, 5], 99) == (1, 2, 3, 4)
+        assert idx.match([1, 2, 3, 4, 5], 3) == (1, 2)   # cap honored
+        assert idx.match([1, 2, 9], 99) == (1, 2)
+        assert idx.match([9, 1, 2], 99) is None
+        assert idx.match([1, 2], 1) is None
+
+    def test_remove_prunes_and_keeps_shorter_keys(self):
+        from apex1_tpu.serving import RadixIndex
+        idx = RadixIndex()
+        idx.insert((1, 2))
+        idx.insert((1, 2, 3, 4))
+        idx.remove((1, 2, 3, 4))
+        assert len(idx) == 1
+        assert idx.match([1, 2, 3, 4], 99) == (1, 2)
+        idx.remove((1, 2))
+        assert len(idx) == 0 and not idx._root.children  # fully pruned
+        idx.remove((1, 2))                               # idempotent
+
+
+class TestRadixPrefixCache:
+    def test_cross_request_match_without_explicit_prefix(self, tiny,
+                                                         rng):
+        """The tentpole: two requests sharing a long prompt prefix —
+        NEITHER passes prefix= — dedupe through the radix matcher; the
+        second admission hits the first's chunk-aligned auto page, and
+        both decode token-identically to their solo runs."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2)
+        shared = rng.integers(0, cfg.vocab_size, (9,)).tolist()
+        p1, p2 = shared + [1, 2], shared + [3]
+        r1 = eng.submit(p1, max_new_tokens=5)
+        eng.run(max_steps=40)
+        r2 = eng.submit(p2, max_new_tokens=5)
+        eng.run(max_steps=40)
+        np.testing.assert_array_equal(eng.results[r1].tokens,
+                                      solo(p1, 5))
+        np.testing.assert_array_equal(eng.results[r2].tokens,
+                                      solo(p2, 5))
+        # chunk=4, len(p1)=11 -> auto page at ((11-1)//4)*4 = 8, which
+        # is a prefix of p2 as well
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["length"] == 8 and stats["hits"] >= 2
+        s = eng.metrics.summary()
+        assert s["prefix_hit_rate"] == 0.5           # miss then hit
+        assert s["prefix_saved_tokens"] == 8
+        rec = eng.metrics.records[r2]
+        assert rec.prefix_hit is True and rec.prefix_saved == 8
+        assert eng.metrics.records[r1].prefix_hit is False
+        assert eng.trace_counts == {"prefill": 1, "decode": 1}
+
+    def test_radix_hit_vs_cold_miss_parity(self, tiny, rng):
+        """Satellite parity pin: the same request admitted COLD (fresh
+        engine, full prefill) and WARM (radix hit installs a page)
+        emits identical tokens."""
+        cfg, _, _, _, _ = tiny
+        prompt = rng.integers(0, cfg.vocab_size, (10,)).tolist()
+        cold = _engine(tiny)
+        rc = cold.submit(prompt, max_new_tokens=6)
+        cold.run(max_steps=40)
+        warm = _engine(tiny)
+        w1 = warm.submit(prompt, max_new_tokens=6)
+        warm.run(max_steps=40)
+        w2 = warm.submit(prompt, max_new_tokens=6)   # the radix hit
+        warm.run(max_steps=40)
+        assert warm.metrics.records[w2].prefix_hit is True
+        np.testing.assert_array_equal(cold.results[rc].tokens,
+                                      warm.results[w1].tokens)
+        np.testing.assert_array_equal(warm.results[w1].tokens,
+                                      warm.results[w2].tokens)
+
+    def test_explicit_prefix_page_serves_auto_requests(self, tiny, rng):
+        """The explicit prefix= API is a thin wrapper over the radix
+        store: a later request whose FLAT prompt starts with the same
+        tokens hits the explicit page without naming it."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (7,)).tolist())
+        own = rng.integers(0, cfg.vocab_size, (3,)).tolist()
+        r1 = eng.submit(own, max_new_tokens=4, prefix=sysp)
+        eng.run(max_steps=40)
+        flat = list(sysp) + own
+        r2 = eng.submit(flat, max_new_tokens=4)      # no prefix=
+        eng.run(max_steps=40)
+        rec = eng.metrics.records[r2]
+        assert rec.prefix_hit is True and rec.prefix_saved == 7
+        np.testing.assert_array_equal(eng.results[r1].tokens,
+                                      eng.results[r2].tokens)
+        np.testing.assert_array_equal(eng.results[r2].tokens,
+                                      solo(flat, 4))
+
+    def test_lru_eviction_under_page_pressure(self, tiny, rng):
+        """max_prefix_pages bounds the store: the least-recently-hit
+        refcount-0 page goes first, live pages never."""
+        cfg, _, _, _, _ = tiny
+        eng = _engine(tiny, max_slots=1, max_prefix_pages=2)
+        prompts = [rng.integers(0, cfg.vocab_size, (9,)).tolist()
+                   for _ in range(3)]
+        keys = []
+        for p in prompts:
+            rid = eng.submit(p, max_new_tokens=3)
+            eng.run(max_steps=30)
+            assert eng.results[rid].status == "done"
+            keys.append(tuple(p[:8]))                # chunk-aligned
+        assert len(eng.kv.prefix_stats()) == 2
+        assert not eng.kv.has_prefix(keys[0])        # LRU evicted
+        assert eng.kv.has_prefix(keys[1])
+        assert eng.kv.has_prefix(keys[2])
+
+    def test_registration_never_evicts_its_own_page(self, tiny, rng):
+        """Review-finding regression: with the store at max_pages and
+        every OTHER page live, registering a new page must not evict
+        the page being registered (put-then-acquire would KeyError and
+        crash the step) — the bound goes soft instead."""
+        cfg, _, _, _, _ = tiny
+        eng = _engine(tiny, max_slots=2, max_prefix_pages=1)
+        p1 = rng.integers(0, cfg.vocab_size, (9,)).tolist()
+        r1 = eng.submit(p1, max_new_tokens=20)
+        eng.step()                       # r1 live, holds its auto page
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 1
+        p2 = rng.integers(0, cfg.vocab_size, (9,)).tolist()
+        r2 = eng.submit(p2, max_new_tokens=3)
+        eng.run(max_steps=60)            # must not crash the admission
+        assert eng.results[r2].status == "done"
+        assert eng.results[r1].status == "done"
+        # both registrations survived the all-live window (soft bound);
+        # a later registration with everything dead re-tightens it
+        assert len(eng.kv.prefix_stats()) == 2
+        p3 = rng.integers(0, cfg.vocab_size, (9,)).tolist()
+        eng.submit(p3, max_new_tokens=3)
+        eng.run(max_steps=30)
+        assert len(eng.kv.prefix_stats()) == 1
+
+    def test_prefix_aware_admission_near_capacity(self, tiny, rng):
+        """Near capacity (queue deeper than free slots) a same-class
+        radix HIT is dequeued before an older miss — and never across
+        the QoS lattice."""
+        cfg, _, _, _, _ = tiny
+        eng = _engine(tiny, max_slots=1)
+        warm = rng.integers(0, cfg.vocab_size, (9,)).tolist()
+        r0 = eng.submit(warm, max_new_tokens=3)      # registers a page
+        eng.run(max_steps=30)
+        blocker = eng.submit(rng.integers(0, cfg.vocab_size,
+                                          (4,)).tolist(),
+                             max_new_tokens=20)
+        eng.step()                                   # blocker holds it
+        miss = eng.submit(rng.integers(0, cfg.vocab_size,
+                                       (9,)).tolist(),
+                          max_new_tokens=3)
+        hit = eng.submit(warm + [5], max_new_tokens=3)
+        assert eng.cancel(blocker)
+        eng.step()                                   # one free slot
+        assert eng.slot_view()[0] == hit             # hit jumped miss
+        eng.run(max_steps=40)
+        assert eng.results[miss].status == "done"    # miss still served
+        # cross-class: a sheddable hit never jumps a guaranteed miss
+        blocker2 = eng.submit(warm, max_new_tokens=20)
+        eng.step()
+        g_miss = eng.submit(rng.integers(0, cfg.vocab_size,
+                                         (9,)).tolist(),
+                            max_new_tokens=3, qos="guaranteed")
+        s_hit = eng.submit(warm + [5], max_new_tokens=3,
+                           qos="sheddable")
+        assert eng.cancel(blocker2)
+        eng.step()
+        assert eng.slot_view()[0] == g_miss
+        eng.run(max_steps=60)
+        assert eng.results[s_hit].status == "done"
+
+    def test_prefix_cache_off_banks_no_rate(self, tiny, rng):
+        cfg, _, _, _, _ = tiny
+        eng = _engine(tiny, prefix_cache=False)
+        rid = eng.submit(rng.integers(0, cfg.vocab_size, (9,)).tolist(),
+                         max_new_tokens=3)
+        eng.run(max_steps=30)
+        assert eng.results[rid].status == "done"
+        s = eng.metrics.summary()
+        assert "prefix_hit_rate" not in s            # fields-only-when-data
+        assert not eng.kv.prefix_stats()
+        assert eng.metrics.records[rid].prefix_hit is None
+
+    def test_prefix_cache_off_keeps_exact_tuple_sharing(self, tiny,
+                                                        rng):
+        """Review-finding regression: with the radix matcher DISABLED,
+        the PR-7 explicit-prefix contract must survive — a second
+        sharer of the same prefix= tuple reuses the page (no
+        'already registered' crash, one page, two hits, parity)."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2, prefix_cache=False)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (7,)).tolist())
+        owns = [rng.integers(0, cfg.vocab_size, (3,)).tolist()
+                for _ in range(2)]
+        ids = [eng.submit(o, max_new_tokens=4, prefix=sysp)
+               for o in owns]
+        eng.run(max_steps=60)
+        for o, rid in zip(owns, ids):
+            np.testing.assert_array_equal(eng.results[rid].tokens,
+                                          solo(list(sysp) + o, 4))
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["hits"] == 2 and stats["refcount"] == 0
+
+
+class TestFirstSharerStranding:
+    def test_midprefill_failure_strands_nothing(self, tiny, rng):
+        """ISSUE 15 satellite regression: a prefill chain that dies
+        mid-flight (chaos kill, XLA error) while a first sharer is
+        paying for its prefix must not leak the slot, leave a dangling
+        page refcount, or register a half-built page — and the same
+        prefix must admit cleanly afterwards."""
+        cfg, _, _, _, solo = tiny
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (9,)).tolist())
+        own = rng.integers(0, cfg.vocab_size, (3,)).tolist()
+        orig = eng._prefill
+        calls = {"n": 0}
+
+        def boom(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:          # chunk 2 of 3: mid-prefix
+                raise RuntimeError("chaos: replica killed mid-prefill")
+            return orig(*a, **kw)
+
+        eng._prefill = boom
+        eng.submit(own, max_new_tokens=5, prefix=sysp)
+        with pytest.raises(RuntimeError, match="mid-prefill"):
+            eng.step()
+        # the stranding window: nothing half-built survives
+        assert eng.kv.n_free == 2
+        assert not eng.kv.prefix_stats()
+        assert eng.slot_view() == [None, None]
+        # the pool is consistent — the same prefix admits as a clean
+        # first sharer and decodes to parity
+        eng._prefill = orig
+        rid = eng.submit(own, max_new_tokens=5, prefix=sysp)
+        eng.run(max_steps=40)
+        np.testing.assert_array_equal(eng.results[rid].tokens,
+                                      solo(list(sysp) + own, 5))
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 0 and stats["hits"] == 1
+
+    def test_cancel_landing_mid_admission_is_honored(self, tiny, rng):
+        """A cancel that lands while the admission's prefill chain runs
+        (ingest thread racing the engine loop) retires the request the
+        moment the chain completes — no zombie slot, no lost cancel."""
+        cfg, _, _, _, _ = tiny
+        eng = _engine(tiny, max_slots=2)
+        sysp = tuple(rng.integers(0, cfg.vocab_size, (6,)).tolist())
+        own = rng.integers(0, cfg.vocab_size, (3,)).tolist()
+        rid = eng.submit(own, max_new_tokens=10, prefix=sysp)
+        orig = eng._prefill
+
+        def sneaky(*a, **kw):
+            out = orig(*a, **kw)
+            assert eng.cancel(rid)       # lands mid-admission
+            return out
+
+        eng._prefill = sneaky
+        eng.step()
+        eng._prefill = orig
+        res = eng.results[rid]
+        assert res.status == "cancelled"
+        assert eng.kv.n_free == 2 and eng.n_active == 0
+        (stats,) = eng.kv.prefix_stats().values()
+        assert stats["refcount"] == 0    # page released, evictable
+
+
 class TestScheduler:
     def _req(self, n, **kw):
         return Request(tokens=np.arange(1, n + 1), max_new_tokens=4, **kw)

@@ -500,3 +500,39 @@ class TestChaosScheduleCompose:
         front.run_until_drained(timeout_s=60.0)
         assert kill.fired == 1
         assert all(front.poll(r).status == "done" for r in rids)
+
+
+class TestSteadyStateInt8Tier:
+    def test_frontend_cache_dtype_builds_every_replica_on_the_tier(
+            self, toy, rng):
+        """ISSUE 15: FrontendConfig.cache_dtype is the STEADY-STATE
+        capacity tier — every replica engine's pool rides it from the
+        first build (not just degraded restarts), at a quarter of the
+        fp32 pool bytes, with streams token-identical to an fp32
+        reference engine (toy cache values are exact in int8)."""
+        import jax
+        import jax.numpy as jnp
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=6,
+                           seed=7, hedge_after_s=None,
+                           cache_dtype=jnp.int8,
+                           replica=ReplicaConfig(watchdog_s=60.0)))
+        rids = _submit_mix(front, rng, 4, new=8)
+        front.run_until_drained(timeout_s=60.0)
+        want = _reference(make_engine, front, rids)
+        for rid in rids:
+            res = front.poll(rid)
+            assert res.status == "done"
+            np.testing.assert_array_equal(res.tokens, want[rid])
+        for rep in front.replicas:
+            leaves = jax.tree_util.tree_leaves(rep.engine.kv.cache)
+            assert all(x.dtype == jnp.int8 for x in leaves)
+            # the capacity arithmetic the tier buys: 1/4 the fp32 pool
+            ref = make_engine()
+            assert rep.engine.kv.pool_bytes() * 4 \
+                == ref.kv.pool_bytes()
+        # degraded restarts still take precedence over the steady tier
+        # (DegradeProfile.cache_dtype wins while degraded) — pinned by
+        # TestOverloadDrill::test_degraded_restart_rides_quantized_kv

@@ -51,7 +51,7 @@ class TestChildCacheEnv:
                            raising=False)
         out = testing.child_cache_env()
         assert "JAX_COMPILATION_CACHE_DIR" not in out  # inherit the disable
-        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.5"
+        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.1"
 
     def test_disabled_path_still_lowers_min_compile_time(self, monkeypatch):
         monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
@@ -60,7 +60,7 @@ class TestChildCacheEnv:
         monkeypatch.setenv("APEX1_JAX_CACHE_DIR", "")  # disable convention
         out = testing.child_cache_env()
         assert "JAX_COMPILATION_CACHE_DIR" not in out
-        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.5"
+        assert out["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0.1"
 
     def test_exported_dir_wins_and_is_inherited(self, monkeypatch):
         monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/op_cache")

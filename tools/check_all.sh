@@ -56,7 +56,7 @@ jax.jit(fn)(*args)
 print("entry OK")
 g.dryrun_multichip(8)
 EOF
-echo "== serving engine smoke (CPU, correctness + two-executable gate) =="
+echo "== serving engine smoke (CPU: correctness + two-executable gate + radix-hit/speculative goodput-multiplier rows with token parity) =="
 python tools/bench_serving.py --smoke > /dev/null
 echo "== hlo overlap probe (ring fwd+bwd vs serialized, CPU-compiled) =="
 python -m apex1_tpu.testing.hlo_probe

@@ -258,6 +258,16 @@ run hw_numerics     1500 python tools/hw_numerics.py --timeout 1400 "${CPUQ[@]}"
 run bench_serving_rep 1800 python tools/bench_serving.py --loads 8 \
                          --replicas 1 2 --chaos \
                          --out perf_results/bench_serving_replicas.json
+# ISSUE 15 goodput multipliers ON SILICON: the shared-system-prompt
+# trace under baseline / radix / radix+spec at equal offered load.
+# The CPU proxy (perf_results/bench_spec_serving_cpu.log) banked
+# hit/accept rates and the radix win, but speculation's wall-clock is
+# TPU-shaped (weight-streaming-bound decode) — this entry is the first
+# honest measurement of the spec axis, plus the int8 capacity row on
+# real HBM geometry.
+run bench_spec_serving 1800 python tools/bench_serving.py --loads 8 \
+                         --prefix-len 24 --num-draft 4 \
+                         --out perf_results/bench_spec_serving.json
 # elastic shrink-resume A/B (ISSUE 14) BEHIND the banked-bench
 # backlog: the n -> n/2 mid-run shrink through the planner re-plan +
 # manifest-verified reshard vs the from-checkpoint control, on the
