@@ -69,13 +69,17 @@ class Autopilot:
         per-tick read must not pay that under the metrics lock)."""
         f = self.frontend
         win = f.metrics.window_summary()
+        pv = getattr(f, "pool_view", None)   # DisaggFrontend only —
+        #  a unified frontend's view carries pools=None and the
+        #  pool-ratio law stays inert
         return FleetView(
             mode=f.mode, load_fraction=f.load_fraction,
             inflight=f.total_inflight, capacity=f.capacity,
             n_replicas=len(f.replicas), n_alive=f.n_alive,
             admission_limit=f.admission_limit,
             window=win.get("per_class", {}),
-            per_tenant=win.get("per_tenant", {}))
+            per_tenant=win.get("per_tenant", {}),
+            pools=pv() if callable(pv) else None)
 
     # ---- the loop --------------------------------------------------------
 
@@ -115,6 +119,13 @@ class Autopilot:
                                tenant=act.params["tenant"],
                                by="autopilot", evidence=act.evidence)
             result.update(act.params)
+        elif act.kind == "shift_pool":
+            shifted = f.shift_pool(act.params["to"], by="autopilot",
+                                   evidence=act.evidence)
+            if shifted is None:        # donor at minimum after all —
+                result["noop"] = True  # banked as such, not hidden
+            else:
+                result.update(shifted)
         else:                          # a policy/controller version skew
             raise ValueError(f"unknown action kind {act.kind!r}")
         rec = {"t": round(self.clock(), 6), "tick": self.state.ticks,

@@ -54,6 +54,9 @@ class SLOTarget:
 
     latency_p99_ms: Optional[float] = None
     ttft_p99_ms: Optional[float] = None
+    tpot_p99_ms: Optional[float] = None   # decode-phase time per
+    #  output token — with ttft_p99_ms this is the PER-PHASE pair the
+    #  pool-ratio actuator balances on a disaggregated fleet
     success_rate: Optional[float] = None
 
 
@@ -91,6 +94,19 @@ class AutopilotConfig:
     hedge_multiplier: float = 3.0  # budget = mult x windowed ttft_p99
     hedge_floor_s: float = 0.05
     hedge_rel_tol: float = 0.1     # refit only on >10% movement
+    # ---- pool-ratio actuator (disaggregated fleets only: inert
+    # unless the view carries a `pools` snapshot AND some SLO'd class
+    # targets both ttft_p99_ms and tpot_p99_ms)
+    pool_ratio: bool = True
+    pool_deadband: float = 1.3     # one phase's normalized pressure
+    #  must exceed the other's by this factor before the imbalance
+    #  even counts — the hysteresis band that keeps the ratio from
+    #  thrashing on noise
+    pool_sustain: int = 4          # ticks the SAME side must stay
+    #                                pressured before a shift
+    pool_cooldown: int = 6         # refractory ticks after a shift (a
+    #  moved replica needs a window's worth of traffic to show up in
+    #  the percentiles — reacting faster would double-correct)
 
 
 @dataclasses.dataclass
@@ -108,6 +124,9 @@ class FleetView:
     admission_limit: Optional[int]
     window: dict                   # summary()["window"]["per_class"]
     per_tenant: dict               # summary()["window"]["per_tenant"]
+    pools: Optional[dict] = None   # DisaggFrontend.pool_view() on a
+    #  disaggregated fleet ({"prefill": {...}, "decode": {...}});
+    #  None on a unified fleet — the pool-ratio law stays inert
 
 
 @dataclasses.dataclass
@@ -120,6 +139,12 @@ class ControllerState:
     cooldown: int = 0
     hedge_budgets: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    # pool-ratio hysteresis (separate counters: the ratio law and the
+    # capacity ladder must not share a refractory period)
+    pool_side: str = ""            # which phase is pressured: ""/
+    #                                "prefill"/"decode"
+    pool_imbalance_ticks: int = 0
+    pool_cooldown: int = 0
 
 
 @dataclasses.dataclass
@@ -129,7 +154,7 @@ class Action:
     the actuation (spine + transitions)."""
 
     kind: str      # escalate|deescalate|scale_up|scale_down|
-    #                set_admission|fit_hedge
+    #                set_admission|fit_hedge|shift_pool
     params: dict
     evidence: dict
 
@@ -144,7 +169,8 @@ def _breaches(view: FleetView, cfg: AutopilotConfig) -> List[dict]:
         if not stats or stats.get("n", 0) < cfg.min_window:
             continue
         for metric, want in (("latency_p99_ms", target.latency_p99_ms),
-                             ("ttft_p99_ms", target.ttft_p99_ms)):
+                             ("ttft_p99_ms", target.ttft_p99_ms),
+                             ("tpot_p99_ms", target.tpot_p99_ms)):
             got = stats.get(metric)
             if want is not None and got is not None and got > want:
                 out.append({"class": cls, "metric": metric,
@@ -179,7 +205,8 @@ def _headroom_ok(view: FleetView, cfg: AutopilotConfig) -> bool:
         if not stats or stats.get("n", 0) < cfg.min_window:
             continue
         for metric, want in (("latency_p99_ms", target.latency_p99_ms),
-                             ("ttft_p99_ms", target.ttft_p99_ms)):
+                             ("ttft_p99_ms", target.ttft_p99_ms),
+                             ("tpot_p99_ms", target.tpot_p99_ms)):
             got = stats.get(metric)
             if want is not None and got is not None \
                     and got > cfg.scale_down_headroom * want:
@@ -228,6 +255,88 @@ def _relaxation(view: FleetView, cfg: AutopilotConfig,
     return None
 
 
+def _pool_pressures(view: FleetView,
+                    cfg: AutopilotConfig) -> Optional[dict]:
+    """Normalized per-phase pressure of a disaggregated fleet: over
+    every SLO'd class with enough window samples, the worst
+    ``measured p99 / target`` for TTFT (prefill-tier pressure) and for
+    TPOT (decode-tier pressure). None unless BOTH phases have a target
+    and a measurement — a one-sided reading says which phase is slow,
+    not which phase is slowER, and the ratio actuator must never act
+    on half a comparison."""
+    pre = dec = None
+    ev = {}
+    for cls, target in sorted(cfg.slo.items()):
+        stats = view.window.get(cls)
+        if not stats or stats.get("n", 0) < cfg.min_window:
+            continue
+        if target.ttft_p99_ms is not None:
+            got = stats.get("ttft_p99_ms")
+            if got is not None:
+                p = got / target.ttft_p99_ms
+                if pre is None or p > pre:
+                    pre = p
+                    ev["ttft"] = {"class": cls,
+                                  "value": round(got, 3),
+                                  "target": target.ttft_p99_ms}
+        if target.tpot_p99_ms is not None:
+            got = stats.get("tpot_p99_ms")
+            if got is not None:
+                p = got / target.tpot_p99_ms
+                if dec is None or p > dec:
+                    dec = p
+                    ev["tpot"] = {"class": cls,
+                                  "value": round(got, 3),
+                                  "target": target.tpot_p99_ms}
+    if pre is None or dec is None:
+        return None
+    return {"prefill": pre, "decode": dec, "evidence": ev}
+
+
+def _pool_ratio(view: FleetView, state: ControllerState,
+                cfg: AutopilotConfig) -> Optional[Action]:
+    """The pool-RATIO law: when one phase's normalized pressure has
+    exceeded the other's by ``pool_deadband`` for ``pool_sustain``
+    consecutive ticks, shift one replica toward the pressured phase —
+    capacity conserved, balance moved. Guardrails: the donor pool must
+    keep >= 1 replica (enforced here on the view AND again by
+    `shift_pool` itself), and every shift starts its own
+    ``pool_cooldown`` refractory period."""
+    if not cfg.pool_ratio or view.pools is None:
+        return None
+    p = _pool_pressures(view, cfg)
+    if p is None:
+        state.pool_side = ""
+        state.pool_imbalance_ticks = 0
+        return None
+    if p["prefill"] > cfg.pool_deadband * p["decode"]:
+        side = "prefill"
+    elif p["decode"] > cfg.pool_deadband * p["prefill"]:
+        side = "decode"
+    else:
+        side = ""
+    if side != state.pool_side:
+        state.pool_side = side
+        state.pool_imbalance_ticks = 1 if side else 0
+    elif side:
+        state.pool_imbalance_ticks += 1
+    if (not side or state.pool_imbalance_ticks < cfg.pool_sustain
+            or state.pool_cooldown > 0):
+        return None
+    donor = "decode" if side == "prefill" else "prefill"
+    if view.pools.get(donor, {}).get("n_alive", 0) <= 1:
+        return None                  # each phase always keeps a pool
+    evidence = {
+        "pressure_prefill": round(p["prefill"], 4),
+        "pressure_decode": round(p["decode"], 4),
+        "deadband": cfg.pool_deadband,
+        "imbalance_ticks": state.pool_imbalance_ticks,
+        "pools": view.pools, **p["evidence"]}
+    state.pool_imbalance_ticks = 0
+    state.pool_cooldown = cfg.pool_cooldown
+    return Action("shift_pool", {"to": side}, evidence)
+
+
 def _fit_hedges(view: FleetView, state: ControllerState,
                 cfg: AutopilotConfig) -> List[Action]:
     """Refit per-tenant hedge/TTFT budgets from the measured windowed
@@ -262,6 +371,8 @@ def decide(view: FleetView, state: ControllerState,
     state.ticks += 1
     if state.cooldown > 0:
         state.cooldown -= 1
+    if state.pool_cooldown > 0:
+        state.pool_cooldown -= 1
     if not _has_evidence(view, cfg):
         # thin evidence actuates nothing, in EITHER direction: freeze
         # the hysteresis counters (an evidence-free tick is not a
@@ -296,6 +407,12 @@ def decide(view: FleetView, state: ControllerState,
             actions.append(act)
             state.cooldown = cfg.cooldown_ticks
             state.clear_ticks = 0
+    # the ratio law runs BESIDE the capacity ladder (own hysteresis,
+    # own cooldown): rebalancing a fixed fleet and resizing it are
+    # orthogonal corrections
+    pool_act = _pool_ratio(view, state, cfg)
+    if pool_act is not None:
+        actions.append(pool_act)
     if cfg.fit_hedge and state.ticks % cfg.fit_every == 0:
         actions.extend(_fit_hedges(view, state, cfg))
     return actions

@@ -8,9 +8,11 @@ fixed-slot KV pool with refcounted shared-prefix pages (`kv_pool`),
 the two-executable continuous-batching loop itself (`engine`),
 per-request lifecycle metrics with failure-path counters (`metrics`),
 supervised replicas with watchdog + idempotent resubmission
-(`replica`), and the load/SLO-routed multi-replica frontend with
-hedging and degraded modes (`frontend`). See ``docs/serving.md``
-§ Engine and § Failure model.
+(`replica`), the load/SLO-routed multi-replica frontend with
+hedging and degraded modes (`frontend`), and the disaggregated
+prefill/decode two-pool frontend with manifest-verified KV handoff
+(`disagg`). See ``docs/serving.md`` § Engine, § Failure model, and
+§ Disaggregated serving.
 
 Quick start (single engine)::
 
@@ -51,3 +53,6 @@ from apex1_tpu.serving.replica import (PoisonedRequest,  # noqa: F401
 from apex1_tpu.serving.scheduler import (Backpressure,  # noqa: F401
                                          QOS_CLASSES, Request,
                                          Scheduler, new_request_id)
+from apex1_tpu.serving.disagg import (DisaggConfig,  # noqa: F401,E402
+                                      DisaggFrontend, HandoffError,
+                                      KVPage)

@@ -1,0 +1,20 @@
+"""Disaggregated prefill/decode serving: phase-aware pools with
+manifest-verified KV handoff (docs/serving.md § Disaggregated
+serving). `DisaggFrontend` is the drop-in two-pool frontend;
+`kv_transfer` is the digest-gated page transport it rides."""
+
+from apex1_tpu.serving.disagg.frontend import DisaggConfig, DisaggFrontend
+from apex1_tpu.serving.disagg.kv_transfer import (HandoffError, KVPage,
+                                                  extract_page,
+                                                  install_page,
+                                                  verify_page)
+
+__all__ = [
+    "DisaggConfig",
+    "DisaggFrontend",
+    "HandoffError",
+    "KVPage",
+    "extract_page",
+    "install_page",
+    "verify_page",
+]

@@ -1,0 +1,122 @@
+"""Manifest-verified KV page transfer — the handoff leg of
+disaggregated serving (docs/serving.md § Disaggregated serving).
+
+A prefill-pool engine finishes a prompt and leaves its chunk-aligned
+prefix page in the pool-local radix store; this module moves that page
+to a decode-pool engine with the SAME integrity contract
+`resilience.manifest` gives checkpoints:
+
+- `extract_page` copies the page's lane (a batch-1 cache pytree) to
+  host and digests every leaf at DEPARTURE (`manifest.tree_entries`:
+  path, shape, dtype, sha256 over C-contiguous little-endian bytes).
+- `verify_page` re-digests the SAME leaves at ARRIVAL and compares
+  entry-by-entry. A torn or corrupt transfer is a typed
+  `HandoffError` — the router re-routes (re-prefill on a survivor or
+  radix-hit skip), it never installs silent garbage.
+- `install_page` is verify + `KVPool.put_prefix` into the destination
+  engine: the decode-side admission then radix-hits the installed page
+  and prefills only the page-to-prompt remainder (>= 1 token — the
+  engine keeps the last prompt token uncached by contract).
+
+Token parity across the handoff is NOT this module's job — it falls
+out of the counter-keyed seed contract (PR 7): position ``i`` samples
+with ``fold_in(key(seed), i)`` on whichever engine holds the stream,
+so the decode pool regenerates the prefill pool's first token
+bit-identically at any temperature. The drills assert it per handoff.
+
+On the CPU proxy the "transfer" is a device→host→device round trip;
+on TPU the same page moves over ICI/DCN (the fused
+computation-collective shape of PAPERS.md 2305.06942) — the digest
+contract is transport-agnostic, which is the point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from apex1_tpu.resilience.manifest import tree_entries
+
+
+class HandoffError(RuntimeError):
+    """A KV handoff failed integrity or availability checks (corrupt/
+    torn page, page evicted before transfer, no live source). TYPED so
+    the router's answer is a re-route, never silent garbage tokens —
+    the serving-tier sibling of `resilience.manifest.IntegrityError`."""
+
+
+@dataclasses.dataclass
+class KVPage:
+    """One in-flight KV transfer: the page's radix key, its length in
+    cached positions, the HOST copy of its batch-1 cache lane (this
+    buffer IS the simulated wire), and its departure-time manifest
+    entries."""
+
+    key: Tuple[int, ...]
+    length: int
+    lane: Any                       # host (numpy-leaf) cache pytree
+    entries: List[dict]             # manifest.tree_entries at departure
+
+    def nbytes(self) -> int:
+        import jax
+
+        return sum(np.asarray(x).nbytes
+                   for x in jax.tree_util.tree_leaves(self.lane))
+
+
+def extract_page(engine, key: tuple) -> KVPage:
+    """Copy ``key``'s prefix page out of ``engine``'s pool to host and
+    digest it at departure. Raises `HandoffError` when the page is
+    gone (LRU-evicted between prefill completion and transfer — the
+    caller re-routes)."""
+    import jax
+
+    key = tuple(int(t) for t in key)
+    page = engine.kv.get_prefix(key)
+    if page is None:
+        raise HandoffError(
+            f"prefix page ({len(key)} tokens) not in the source "
+            f"engine's store (evicted before transfer?)")
+    lane = jax.tree_util.tree_map(np.asarray, page.lane)
+    return KVPage(key=key, length=int(page.length), lane=lane,
+                  entries=tree_entries(lane))
+
+
+def verify_page(page: KVPage) -> None:
+    """Re-digest ``page.lane`` and compare against the departure
+    entries — the ARRIVAL gate. Any structure/shape/dtype/content
+    mismatch is a `HandoffError` naming the first divergent leaf."""
+    got = tree_entries(page.lane)
+    want = page.entries
+    if len(got) != len(want):
+        raise HandoffError(
+            f"page ({page.length} tokens): {len(got)} leaves on "
+            f"arrival, {len(want)} at departure")
+    for g, w in zip(got, want):
+        for field in ("path", "shape", "dtype", "sha256"):
+            if g[field] != w[field]:
+                raise HandoffError(
+                    f"page ({page.length} tokens): leaf {w['path']} "
+                    f"{field} mismatch on arrival "
+                    f"({g[field]!r} != departed {w[field]!r})")
+
+
+def install_page(engine, page: KVPage) -> bool:
+    """Verify ``page`` at arrival, then register it in ``engine``'s
+    pool so the decode-side admission radix-hits it. Returns False
+    (page dropped, nothing installed) when the destination already
+    holds the key — `KVPool.put_prefix` treats duplicate keys as a
+    contract violation, and an already-present page serves the same
+    hit. Raises `HandoffError` on an integrity mismatch (BEFORE
+    touching the destination pool)."""
+    import jax.numpy as jnp
+    import jax
+
+    verify_page(page)
+    if engine.kv.has_prefix(page.key):
+        return False
+    lane = jax.tree_util.tree_map(jnp.asarray, page.lane)
+    engine.kv.put_prefix(page.key, lane, page.length)
+    return True

@@ -57,7 +57,13 @@ TERMINAL = ("done", "evicted", "cancelled", "rejected")
 
 #: failure-path counters always present in summary()["counters"]
 FAILURE_COUNTERS = ("retries", "hedges_fired", "hedges_won", "sheds",
-                    "evictions", "replica_restarts")
+                    "evictions", "replica_restarts",
+                    # disaggregated serving (docs/serving.md
+                    # § Disaggregated serving): a corrupt/torn KV
+                    # handoff caught by the manifest re-digest, and the
+                    # re-route that answered it — 0 on a healthy fleet
+                    # is an ASSERTED property, not missing data
+                    "handoff_failures", "handoff_reroutes")
 
 
 @dataclasses.dataclass
@@ -104,6 +110,19 @@ class RequestRecord:
         if self.n_drafted <= 0:
             return None
         return self.n_accepted / self.n_drafted
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time-per-output-token over the DECODE phase: first token →
+        terminal, per generated token past the first (None until both
+        stamps exist, or when at most one token was generated). TTFT
+        is the prefill phase's pressure signal; this is the decode
+        phase's — the pair is the disaggregated pool-ratio actuator's
+        input (docs/serving.md § Disaggregated serving)."""
+        if (self.t_first_token is None or self.t_done is None
+                or self.n_generated < 2):
+            return None
+        return (self.t_done - self.t_first_token) / (self.n_generated - 1)
 
     @property
     def latency(self) -> Optional[float]:
@@ -196,7 +215,7 @@ class ServingMetrics:
             self._window.append(
                 (rec.qos or "best_effort", rec.tenant, name,
                  rec.ttft, rec.latency, rec.prefix_hit,
-                 rec.accept_rate))
+                 rec.accept_rate, rec.tpot))
         else:
             raise ValueError(f"unknown lifecycle event {name!r}")
         if name != "token":
@@ -320,6 +339,10 @@ class ServingMetrics:
         if lats:
             out["latency_p50_ms"] = 1e3 * float(np.percentile(lats, 50))
             out["latency_p99_ms"] = 1e3 * float(np.percentile(lats, 99))
+        tpots = sorted(r.tpot for r in recs if r.tpot is not None)
+        if tpots:
+            out["tpot_p50_ms"] = 1e3 * float(np.percentile(tpots, 50))
+            out["tpot_p99_ms"] = 1e3 * float(np.percentile(tpots, 99))
         if self._step_n:
             out["mean_occupancy"] = self._occ_sum / self._step_n
             out["peak_queue_depth"] = self._peak_queue
@@ -353,8 +376,11 @@ class ServingMetrics:
     def _window_summary(win: list) -> dict:
         """Per-class / per-tenant percentiles over the ring entries
         ``(qos, tenant, status, ttft, latency, prefix_hit,
-        accept_rate)``. Percentile/rate keys only appear when the class
-        has data — same contract as the whole-run fields."""
+        accept_rate, tpot)``. Percentile/rate keys only appear when the
+        class has data — same contract as the whole-run fields. TTFT
+        and TPOT land side by side per QoS class: the per-phase split
+        (prefill pressure vs decode pressure) the disaggregated
+        pool-ratio actuator consumes."""
         def rates(entries, d):
             hits = [e[5] for e in entries if e[5] is not None]
             if hits:
@@ -375,6 +401,10 @@ class ServingMetrics:
             if with_latency and lats:
                 d["latency_p50_ms"] = 1e3 * float(np.percentile(lats, 50))
                 d["latency_p99_ms"] = 1e3 * float(np.percentile(lats, 99))
+            tpots = sorted(e[7] for e in entries if e[7] is not None)
+            if tpots:
+                d["tpot_p50_ms"] = 1e3 * float(np.percentile(tpots, 50))
+                d["tpot_p99_ms"] = 1e3 * float(np.percentile(tpots, 99))
             return rates(entries, d)
 
         by_class: Dict[str, list] = {}

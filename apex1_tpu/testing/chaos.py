@@ -59,7 +59,8 @@ __all__ = [
     "poison_at_steps", "poison_tree_at_steps", "truncate_checkpoint",
     "bitflip_checkpoint", "sigterm_self_at", "Flaky", "TransientError",
     "ServingFault", "ChaosSchedule", "ReplicaKill", "ReplicaHang",
-    "SlowReplica", "PoisonPill", "kill_schedule", "shrink_schedule",
+    "SlowReplica", "PoisonPill", "HandoffWindowKill",
+    "HandoffCorruption", "kill_schedule", "shrink_schedule",
     "toy_decoder",
 ]
 
@@ -196,6 +197,15 @@ class ServingFault:
         """Called just before a submission is admitted to the engine
         (``sub`` is a `serving.replica.Submission`)."""
 
+    def on_handoff(self, replica_id: int, req_id: int, page) -> None:
+        """Called by `serving.disagg.DisaggFrontend` in the handoff
+        window — after a prefill replica extracted a KV page for
+        ``req_id`` but BEFORE the decode pool acknowledged it
+        (``page`` is a `serving.disagg.kv_transfer.KVPage`, mutable
+        host copy). Raising `ReplicaKilled` here models the source
+        dying mid-transfer; mutating ``page.lane`` models a torn/
+        corrupt transfer the arrival re-digest must catch."""
+
 
 class ChaosSchedule(ServingFault):
     """Compose several faults; each sees every hook."""
@@ -210,6 +220,10 @@ class ChaosSchedule(ServingFault):
     def on_submit(self, replica_id, sub):
         for f in self.faults:
             f.on_submit(replica_id, sub)
+
+    def on_handoff(self, replica_id, req_id, page):
+        for f in self.faults:
+            f.on_handoff(replica_id, req_id, page)
 
 
 class ReplicaKill(ServingFault):
@@ -297,6 +311,65 @@ class PoisonPill(ServingFault):
             raise PoisonedRequest(
                 f"chaos: poison token {self.poison_token} in request "
                 f"{sub.req_id}", req_id=sub.req_id)
+
+
+class HandoffWindowKill(ServingFault):
+    """Kill the SOURCE prefill replica in the handoff window — after
+    its prefill completed but before the decode pool acknowledged the
+    KV page (the ISSUE 16 regression fixture: the request must be
+    re-routed, never stranded). Fires on the ``at_handoff``-th handoff
+    overall (0 = the first); ``repeat=True`` kills every handoff from
+    then on (the crash-loop form — bounded by the frontend's
+    ``max_handoff_attempts``)."""
+
+    def __init__(self, at_handoff: int = 0, *, repeat: bool = False):
+        self.at_handoff = int(at_handoff)
+        self.repeat = bool(repeat)
+        self.seen = 0
+        self.fired = 0
+
+    def on_handoff(self, replica_id, req_id, page):
+        k = self.seen
+        self.seen += 1
+        if k < self.at_handoff or (self.fired and not self.repeat):
+            return
+        self.fired += 1
+        from apex1_tpu.serving.replica import ReplicaKilled
+        raise ReplicaKilled(
+            f"chaos: killed replica {replica_id} in the handoff window "
+            f"of request {req_id} (handoff #{k})")
+
+
+class HandoffCorruption(ServingFault):
+    """Flip one byte of a transferred KV page AFTER its departure
+    digests were taken (the torn/bit-rot transfer model) — the decode
+    pool's arrival re-digest must surface a typed `HandoffError`, never
+    silently garbage tokens. Fires on the ``at_handoff``-th handoff
+    overall, once."""
+
+    def __init__(self, at_handoff: int = 0):
+        self.at_handoff = int(at_handoff)
+        self.seen = 0
+        self.fired = 0
+
+    def on_handoff(self, replica_id, req_id, page):
+        k = self.seen
+        self.seen += 1
+        if k != self.at_handoff or self.fired:
+            return
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(page.lane)
+        for i, leaf in enumerate(leaves):
+            arr = np.array(leaf)         # np.asarray views of device
+            #  arrays are read-only; a real copy is the writable
+            #  "wire buffer" the flipped bit lands in
+            flat = arr.reshape(-1).view(np.uint8)
+            if flat.size:
+                flat[0] ^= 0xFF
+                leaves[i] = arr
+                page.lane = jax.tree_util.tree_unflatten(treedef, leaves)
+                self.fired += 1
+                return
 
 
 def shrink_schedule(seed: int, *, n_devices: int, lo: int, hi: int,

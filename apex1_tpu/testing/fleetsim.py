@@ -50,7 +50,10 @@ __all__ = [
 ]
 
 TRACE_SCHEMA = "apex1-fleettrace-v1"
-TRACE_KINDS = ("steady", "bursty", "diurnal", "adversarial_overload")
+# APPEND-only: `TRACE_KINDS.index(kind)` keys each generator's rng
+# stream, so reordering would silently regenerate every banked trace
+TRACE_KINDS = ("steady", "bursty", "diurnal", "adversarial_overload",
+               "adversarial_long_prompt")
 
 
 class VirtualClock:
@@ -139,7 +142,8 @@ def synthetic_trace(kind: str, *, seed: int, horizon_s: float = 8.0,
                     n_bursts: int = 3,
                     diurnal_period_s: float = 4.0,
                     overload_mult: float = 3.0,
-                    overload_span: tuple = (0.3, 0.8)) -> Trace:
+                    overload_span: tuple = (0.3, 0.8),
+                    long_prompt_lens: tuple = (18, 30)) -> Trace:
     """Seed-keyed arrival generator (inhomogeneous Poisson via
     thinning). Kinds:
 
@@ -152,6 +156,12 @@ def synthetic_trace(kind: str, *, seed: int, horizon_s: float = 8.0,
       ``overload_span`` (fractions of the horizon), ``overload_mult``
       x base inside — sustained past any burst filter, the headline
       drill's input.
+    - ``adversarial_long_prompt``: FLAT base rate — the adversarial
+      axis is the prompt-length mix, not the rate: non-guaranteed
+      classes draw from ``long_prompt_lens`` while guaranteed keeps
+      ``prompt_lens``, so long prefills head-of-line-block short
+      interactive traffic at EQUAL offered load (the disaggregation
+      drill's input; pair with ``prefill_round_cost``).
     """
     if kind not in TRACE_KINDS:
         raise ValueError(f"unknown trace kind {kind!r}; "
@@ -178,6 +188,8 @@ def synthetic_trace(kind: str, *, seed: int, horizon_s: float = 8.0,
         if kind == "diurnal":
             phase = math.sin(2.0 * math.pi * t / diurnal_period_s)
             return base_rate * (0.65 + 0.35 * phase)
+        if kind == "adversarial_long_prompt":
+            return base_rate
         return base_rate * (overload_mult if t_on <= t < t_off else 1.0)
 
     rmax = base_rate * max(burst_mult, overload_mult, 1.0)
@@ -189,12 +201,15 @@ def synthetic_trace(kind: str, *, seed: int, horizon_s: float = 8.0,
             break
         if rng.uniform() >= rate(t) / rmax:
             continue                    # thinned
+        qos = classes[int(rng.choice(len(classes), p=probs))]
+        plens = (long_prompt_lens
+                 if (kind == "adversarial_long_prompt"
+                     and qos != "guaranteed") else prompt_lens)
         reqs.append(SimRequest(
             t=round(t, 6),
-            qos=classes[int(rng.choice(len(classes), p=probs))],
+            qos=qos,
             tenant=str(tenants[int(rng.integers(len(tenants)))]),
-            prompt_len=int(rng.integers(prompt_lens[0],
-                                        prompt_lens[1] + 1)),
+            prompt_len=int(rng.integers(plens[0], plens[1] + 1)),
             max_new_tokens=int(rng.integers(new_tokens[0],
                                             new_tokens[1] + 1))))
     return Trace(kind=kind, seed=int(seed), horizon_s=float(horizon_s),
@@ -228,6 +243,21 @@ class FleetSimConfig:
     drain_grace_s: float = 30.0       # virtual time allowed past the
     #                                   horizon before declaring wedged
     max_rounds: int = 500_000         # hard stop (wedged episode)
+    # ---- two-tier (disaggregated) fleet model; all defaults keep the
+    # unified path — and every banked fingerprint — byte-identical
+    disagg: bool = False              # split frontend_config.n_replicas
+    #  into a prefill pool + a decode pool behind a `DisaggFrontend`
+    #  (EQUAL total replicas vs the unified fleet — the A/B is fair)
+    prefill_replicas: int = 1         # pool split: prefill tier size;
+    #                                   decode gets the remainder (>= 1)
+    handoff_latency_s: float = 0.0    # virtual seconds a finished
+    #  prefill's KV page spends in flight before arrival verification
+    #  + decode admission (the ICI/DCN transfer knob)
+    prefill_round_cost: bool = False  # charge prefill its CHUNK count
+    #  in supervision rounds (a replica prefilling an 8-chunk prompt
+    #  stalls its decode slots 8 rounds) — the head-of-line cost that
+    #  makes unified vs disaggregated an honest A/B; off by default
+    #  (pre-existing traces replay with free prefills, as banked)
 
 
 @dataclasses.dataclass
@@ -252,7 +282,7 @@ class SimReport:
         out: Dict[str, dict] = {}
         for o in self.outcomes:
             d = out.setdefault(o["qos"], {"n": 0, "done": 0, "full": 0,
-                                          "latencies": []})
+                                          "latencies": [], "ttfts": []})
             d["n"] += 1
             if o["status"] == "done":
                 d["done"] += 1
@@ -260,9 +290,12 @@ class SimReport:
                     d["full"] += 1
                     if o["latency"] is not None:
                         d["latencies"].append(o["latency"])
+                    if o["ttft"] is not None:
+                        d["ttfts"].append(o["ttft"])
         for cls, n in self.rejected.items():
             out.setdefault(cls, {"n": 0, "done": 0, "full": 0,
-                                 "latencies": []})["n"] += n
+                                 "latencies": [],
+                                 "ttfts": []})["n"] += n
         return out
 
     def latency_p99_s(self, qos: str) -> Optional[float]:
@@ -284,6 +317,19 @@ class SimReport:
         if not d or d["n"] == 0:
             return 1.0
         ok = sum(1 for x in d["latencies"] if x <= latency_s)
+        return ok / d["n"]
+
+    def ttft_attainment(self, qos: str, ttft_s: float) -> float:
+        """Fraction of the class's OFFERED load whose first token
+        landed within ``ttft_s`` AND whose request finished done at
+        full service — the same no-laundering discipline as
+        `slo_attainment` (a fast first token on a request that was
+        then evicted is not an attained TTFT), and the disaggregation
+        drill's headline metric."""
+        d = self.per_class().get(qos)
+        if not d or d["n"] == 0:
+            return 1.0
+        ok = sum(1 for x in d["ttfts"] if x <= ttft_s)
         return ok / d["n"]
 
     def goodput_tok_s(self) -> float:
@@ -327,7 +373,63 @@ class SimReport:
         for k in ("prefix_hit_rate", "accept_rate"):
             if k in self.summary:
                 out[k] = round(self.summary[k], 4)
+        # disaggregated-episode visibility (ISSUE 16) — same rule:
+        # rides the report, never the fingerprint
+        cnt = self.summary.get("counters", {})
+        if "handoff_failures" in cnt:
+            out["handoffs"] = sum(1 for t in self.transitions
+                                  if t.get("event") == "handoff")
+            out["handoff_failures"] = cnt["handoff_failures"]
+            out["handoff_reroutes"] = cnt.get("handoff_reroutes", 0)
+            out["pool_shifts"] = sum(1 for t in self.transitions
+                                     if t.get("event") == "pool_shift")
         return out
+
+
+_METERED_CLS = None
+
+
+def _metered_engine_cls():
+    """Engine subclass charging prefill its chunk count in supervision
+    rounds (``FleetSimConfig.prefill_round_cost``): a step that admits
+    ``k`` total prefill chunks stalls the replica for ``k - 1`` further
+    rounds (every resident decode stream waits — the head-of-line cost
+    disaggregation removes from the decode tier, whose radix-hit
+    admissions prefill at most one remainder chunk). Built lazily so
+    the module imports without the serving stack."""
+    global _METERED_CLS
+    if _METERED_CLS is not None:
+        return _METERED_CLS
+    from apex1_tpu.serving import Engine
+
+    class _MeteredEngine(Engine):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._stall_rounds = 0
+            self._chunks_this_step = 0
+
+        def _run_chunks(self, slot, tokens, idx0, install_lane, seed):
+            C = self.cfg.prefill_chunk
+            self._chunks_this_step += math.ceil(int(tokens.size) / C)
+            return super()._run_chunks(slot, tokens, idx0,
+                                       install_lane, seed)
+
+        def step(self):
+            if self._stall_rounds > 0:
+                # still paying an earlier admission's prefill: no
+                # admissions, no decode — the round is burned
+                self._stall_rounds -= 1
+                self.metrics.step_sample(0, self.cfg.max_slots,
+                                         self.scheduler.depth)
+                return 0
+            self._chunks_this_step = 0
+            out = super().step()
+            if self._chunks_this_step > 1:
+                self._stall_rounds = self._chunks_this_step - 1
+            return out
+
+    _METERED_CLS = _MeteredEngine
+    return _MeteredEngine
 
 
 class FleetSim:
@@ -362,18 +464,41 @@ class FleetSim:
             cache_dtype=self.cfg.cache_dtype,
             seed=frontend_config.seed)
 
+        EngineCls = (_metered_engine_cls()
+                     if self.cfg.prefill_round_cost else Engine)
+
         def make_engine(cache_dtype=None):
             # a degraded-mode restart's explicit dtype overrides the
             # sim's steady-state tier (the Engine kwarg-beats-config
             # rule)
-            return Engine(apply_fn, make_cache, params, ecfg,
-                          cache_dtype=cache_dtype)
+            return EngineCls(apply_fn, make_cache, params, ecfg,
+                             cache_dtype=cache_dtype)
 
         # no explicit metrics=: the frontend's own default wiring
         # (window from the config, our virtual clock) IS the
         # production wiring the simulator claims to drive
-        self.front = ServingFrontend(make_engine, frontend_config,
-                                     fault=chaos, clock=self.clock)
+        if self.cfg.disagg:
+            from apex1_tpu.serving.disagg import (DisaggConfig,
+                                                  DisaggFrontend)
+            n_pre = max(1, int(self.cfg.prefill_replicas))
+            n_dec = max(1, int(frontend_config.n_replicas) - n_pre)
+            # split, never add: prefill + decode == the unified fleet's
+            # replica count, so a unified-vs-disagg A/B compares
+            # ROUTING, not provisioning
+            dcfg = DisaggConfig(
+                prefill=dataclasses.replace(frontend_config,
+                                            n_replicas=n_pre),
+                decode=dataclasses.replace(frontend_config,
+                                           n_replicas=n_dec),
+                prefill_chunk=self.cfg.prefill_chunk,
+                handoff_latency_s=self.cfg.handoff_latency_s,
+                seed=frontend_config.seed,
+                metrics_window=frontend_config.metrics_window)
+            self.front = DisaggFrontend(make_engine, dcfg,
+                                        fault=chaos, clock=self.clock)
+        else:
+            self.front = ServingFrontend(make_engine, frontend_config,
+                                         fault=chaos, clock=self.clock)
         self.pilot = None
         if autopilot is not None:
             from apex1_tpu.autopilot import Autopilot

@@ -764,12 +764,18 @@ class TestPerRequestSeeds:
         b.run(max_steps=60)
         np.testing.assert_array_equal(a.results[rid].tokens,
                                       b.results[rid].tokens)
-        # ...and a different id draws a different stream
+        # ...and a different id derives a different seed. (Seed-level,
+        # not token-level: the toy decoder's peaked distribution makes
+        # two DIFFERENT seeds sample identical short streams for ~25%
+        # of adjacent id pairs, so a token comparison flakes on where
+        # the global id counter happens to sit.)
+        from apex1_tpu.serving.engine import derive_request_seed
         c = self._toy_engine()
         rid2 = c.submit([5, 1, 2, 8], max_new_tokens=9)
         c.run(max_steps=60)
-        assert not np.array_equal(a.results[rid].tokens,
-                                  c.results[rid2].tokens)
+        assert rid2 != rid
+        assert (derive_request_seed(c.cfg.seed, rid2)
+                != derive_request_seed(c.cfg.seed, rid))
 
 
 class TestReplicaKillDrill:

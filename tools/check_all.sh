@@ -32,6 +32,8 @@ echo "== elastic drill (8->4 mid-run shrink: planner re-plan + manifest-verified
 JAX_PLATFORMS=cpu python -m apex1_tpu.resilience.elastic --drill
 echo "== autopilot smoke (static ladder sweep misses SLO, autopilot holds it, replay bit-identical; CPU) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.autopilot --smoke
+echo "== disagg smoke (1+1 pool drill: manifest-verified handoff parity + radix hit skips prefill + handoff-window kill re-routes; CPU) =="
+JAX_PLATFORMS=cpu python -m apex1_tpu.serving.disagg --smoke
 echo "== obs smoke (CPU trace -> per-op report -> calibration fit, non-empty) =="
 JAX_PLATFORMS=cpu python -m apex1_tpu.obs --smoke
 echo "== planner smoke (enumerate -> price -> emit -> llama_3d dryrun from the plan, CPU mesh) =="

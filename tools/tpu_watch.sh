@@ -268,6 +268,15 @@ run bench_serving_rep 1800 python tools/bench_serving.py --loads 8 \
 run bench_spec_serving 1800 python tools/bench_serving.py --loads 8 \
                          --prefix-len 24 --num-draft 4 \
                          --out perf_results/bench_spec_serving.json
+# ISSUE 16 disaggregation A/B: unified vs prefill/decode pools at
+# equal offered load + equal replicas on the adversarial long-prompt
+# trace (virtual clock — routing/control evidence; the CPU proxy
+# banked the same drill, this is the device-count-scaled rerun), with
+# per-phase TTFT/TPOT parsed back off the obs spine and cross-fleet
+# token parity asserted over every common completion.
+run bench_disagg    1800 python tools/bench_serving.py --loads 4 \
+                         --prefix-len 0 --disagg \
+                         --out perf_results/bench_disagg.json
 # elastic shrink-resume A/B (ISSUE 14) BEHIND the banked-bench
 # backlog: the n -> n/2 mid-run shrink through the planner re-plan +
 # manifest-verified reshard vs the from-checkpoint control, on the
