@@ -61,6 +61,17 @@ RULE_SLUGS: Dict[str, str] = {
     "APX207": "exclusive-knobs",
     "APX208": "vmem-budget",
     "APX209": "kernel-binding",
+    # APX3xx: the serving control-plane protocol model checker
+    # (lint/protocols/, opt-in via lint_*(protocols=True) /
+    # `tools/lint.py --protocols`)
+    "APX301": "protocol-model",
+    "APX302": "double-decode",
+    "APX303": "qos-inversion",
+    "APX304": "cancel-resurrect",
+    "APX305": "stranded-result",
+    "APX306": "capacity-leak",
+    "APX307": "ladder",
+    "APX308": "unbanked-transition",
 }
 
 _SLUG_TO_CODE = {v: k for k, v in RULE_SLUGS.items()}

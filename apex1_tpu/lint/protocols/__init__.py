@@ -1,0 +1,201 @@
+"""graftlint serving-protocol analyzer — the APX3xx rule family.
+
+The third leg of the static gate: APX1xx gates host-side JAX hazards,
+APX2xx gates the compiled-TPU kernel/collective protocols, and APX3xx
+gates the SERVING CONTROL PLANE — the scheduler/supervisor/frontend/
+disagg/autopilot state machines whose interleaving bugs dominated the
+PR 7 and PR 16 review rounds (stranded hedge losers, failover
+double-decode, cancel resurrection from the inbox,
+displacement-before-feasibility capacity destruction, handoff-window
+races).
+
+How it works (all stdlib-``ast`` + a plain BFS, no jax, no device, no
+threads):
+
+- **extract** reads the protocol guard conditions out of the real
+  source AST (`extract.py`): is the shed victim strictly weaker? does
+  `restart()` honor pending cancels? is feasibility checked before
+  displacement? Matching is structural (method signatures), so the
+  committed pre-fix fixtures under tests/fixtures/protocols/ are
+  checked by the same extractors as the live tree, and a refactor that
+  removes a required method is APX301 model drift — never silent.
+- **models** parameterizes five bounded state-machine models with the
+  extracted facts (`models.py`): scheduler shed ladder, replica
+  lifecycle (+ poison quarantine), frontend admission/hedge/failover,
+  disagg handoff + re-route ladder, autopilot evidence/pool actuators.
+- **explore** walks EVERY interleaving of every bounded configuration
+  (`explore.py`, <=3 replicas / <=4 requests / <=2 faults, hundreds to
+  thousands of states) and reports each invariant breach with a
+  shortest-path counterexample naming the exact interleaving.
+
+Entry points: ``tools/lint.py --protocols`` (the ``== graftlint
+protocols ==`` check_all step), ``lint_paths(..., protocols=True)``,
+and the tier-1 repo self-check. The APX1xx suppression grammar and
+exit-code contract apply unchanged: ``# graftlint: allow(APX304) --
+reason``.
+
+What this does NOT prove (docs/lint.md spells it out): wall-clock
+timing, hardware handoff-window behavior, real thread schedules beyond
+the modeled interleavings, or anything about configurations larger
+than the explored bounds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple
+
+from apex1_tpu.lint.core import Finding
+from apex1_tpu.lint.project import Project
+from apex1_tpu.lint.protocols.extract import (FAMILY_REQUIRED_BANKED,
+                                              Extraction, extract_all)
+from apex1_tpu.lint.protocols.models import run_protocol
+
+__all__ = ["PROTOCOL_RULES", "ProtocolRule", "check_protocols"]
+
+
+class ProtocolRule(NamedTuple):
+    code: str
+    slug: str
+    summary: str
+
+
+#: catalogue (exploration is model-level, not per-rule — docs/lint.md
+#: documents each invariant and the bounded-config contract)
+PROTOCOL_RULES = [
+    ProtocolRule("APX301", "protocol-model",
+                 "protocol model drift: a required method is gone, the "
+                 "guard extraction failed, or a bounded exploration "
+                 "blew its state budget — never silently skipped"),
+    ProtocolRule("APX302", "double-decode",
+                 "one request id live on two engine legs at once, or "
+                 "two terminal results published for one rid"),
+    ProtocolRule("APX303", "qos-inversion",
+                 "a shed victim not strictly weaker than the incoming "
+                 "class (equal-or-stronger-class shed)"),
+    ProtocolRule("APX304", "cancel-resurrect",
+                 "an acknowledged cancel later finishes done — via "
+                 "restart resubmission, failover drain, or the disagg "
+                 "handoff window"),
+    ProtocolRule("APX305", "stranded-result",
+                 "a request or a late leg result uncollectable at "
+                 "quiescence (no enabled action can ever reclaim it)"),
+    ProtocolRule("APX306", "capacity-leak",
+                 "capacity destroyed or double-spent: displacement "
+                 "before feasibility, a stale shed victim recounted, a "
+                 "hedge on a streaming request, a drained donor pool"),
+    ProtocolRule("APX307", "ladder",
+                 "a ladder rung unreachable, unexitable, or unbounded; "
+                 "a mandatory gate (verify-before-install, evidence "
+                 "freeze, poison quarantine, MODES_DOWN inverse) "
+                 "missing"),
+    ProtocolRule("APX308", "unbanked-transition",
+                 "a protocol transition the module never banks via "
+                 "metrics.transition(), or a policy Action kind the "
+                 "controller cannot actuate"),
+]
+
+_LADDER_MODES = ("shedding", "degraded")
+
+
+def _finding(code: str, ex: Extraction, line: int, msg: str) -> Finding:
+    return Finding(code, ex.path, line, 0, msg)
+
+
+def _family_findings(ex: Extraction, family: str) -> List[Finding]:
+    out = []
+    facts_key = frozenset(ex.facts.items())
+    for pf in run_protocol(family, facts_key):
+        line = ex.line_for(pf.anchor) if pf.anchor else ex.line
+        out.append(_finding(pf.code, ex, line,
+                            f"{ex.name}: {pf.message}"))
+    return out
+
+
+def _static_findings(ex: Extraction) -> List[Finding]:
+    """APX301 drift + APX308 banked-transition audit, all families."""
+    out = []
+    for meth in ex.missing:
+        out.append(_finding(
+            "APX301", ex, ex.line,
+            f"protocol model drift: {ex.family} family matched "
+            f"'{ex.name}' but required method '{meth}' is gone — "
+            "re-anchor the APX3xx extractor or restore the method "
+            "(the model cannot be checked against this source)"))
+    if not ex.missing:
+        for name in sorted(FAMILY_REQUIRED_BANKED.get(ex.family, set())
+                           - ex.banked):
+            out.append(_finding(
+                "APX308", ex, ex.line,
+                f"{ex.name}: protocol transition '{name}' is never "
+                "banked in this module via metrics.transition() — the "
+                f"{ex.family} episode record is unreconstructable from "
+                "banked events"))
+    return out
+
+
+def _controller_findings(ex: Extraction) -> List[Finding]:
+    out = []
+    md = ex.modes_down
+    if not md:
+        out.append(_finding(
+            "APX307", ex, ex.line,
+            f"{ex.name}: MODES_DOWN de-escalation table not found at "
+            "module scope — the mode ladder has no machine-checkable "
+            "inverse"))
+        return out
+    for mode in _LADDER_MODES:
+        if mode not in md:
+            out.append(_finding(
+                "APX307", ex, ex.line,
+                f"{ex.name}: mode '{mode}' has no MODES_DOWN edge — "
+                "the ladder can escalate into it but never de-escalate "
+                "out (unexitable rung)"))
+            continue
+        cur, hops = mode, 0
+        while cur in md and hops <= len(md) + 1:
+            cur, hops = md[cur], hops + 1
+        if cur != "normal":
+            out.append(_finding(
+                "APX307", ex, ex.line,
+                f"{ex.name}: MODES_DOWN chain from '{mode}' terminates "
+                f"at '{cur}', not 'normal' — relaxation cannot reach "
+                "the ground mode"))
+    return out
+
+
+def check_protocols(project: Project) -> List[Finding]:
+    """Extract + model-check every protocol-family match in the
+    project; cross-check policy Action kinds against controller
+    dispatch when both sides are present."""
+    findings: List[Finding] = []
+    policies: List[Extraction] = []
+    controllers: List[Extraction] = []
+    for mod in project.modules:
+        for ex in extract_all(mod):
+            findings.extend(_static_findings(ex))
+            if ex.missing:
+                continue
+            if ex.family in ("scheduler", "replica", "frontend",
+                             "disagg"):
+                findings.extend(_family_findings(ex, ex.family))
+            elif ex.family == "kv":
+                # the verify-before-install gate feeds the disagg
+                # handoff model (the only kv-side protocol fact)
+                findings.extend(_family_findings(ex, "disagg"))
+            elif ex.family == "policy":
+                policies.append(ex)
+                findings.extend(_family_findings(ex, "autopilot"))
+            elif ex.family == "controller":
+                controllers.append(ex)
+                findings.extend(_controller_findings(ex))
+    for pol in policies:
+        for ctl in controllers:
+            handled = set(ctl.kinds)
+            for kind in sorted(set(pol.kinds) - handled):
+                findings.append(_finding(
+                    "APX308", pol, pol.kinds[kind],
+                    f"policy emits Action kind '{kind}' that "
+                    f"{ctl.name}._apply never dispatches — actuation "
+                    "raises ValueError at runtime (policy/controller "
+                    "version skew)"))
+    return findings

@@ -1,0 +1,127 @@
+"""Bounded exhaustive state-space exploration for the APX3xx serving
+protocol models.
+
+Pure stdlib, jax-free (the lint CLI imports this with jax poisoned —
+`tests/test_lint_protocols.py` pins that). The explorer is a plain BFS
+over hashable model states:
+
+- every enabled action from every reachable state is taken (exhaustive
+  interleaving coverage within the model's bounded configuration);
+- BFS order means the FIRST time a violation is seen, the recorded
+  predecessor chain is a shortest-or-near-shortest counterexample — the
+  finding message names the exact interleaving, step by step, which is
+  the whole point (review rounds found these races by hand-simulating
+  interleavings; the checker hands the simulation back);
+- quiescent states (no enabled action) get the model's end-of-world
+  audit (nothing stranded, everything terminal).
+
+The model duck-type (see `models.py`):
+
+    model.name          -> str, family name ("replica", "frontend", ...)
+    model.config        -> str, bounded-config label for messages
+    model.initial()     -> hashable state
+    model.actions(s)    -> iterable of (label, next_state, violations)
+    model.check(s)      -> violations that hold in state ``s`` itself
+    model.quiescence(s) -> violations audited when no action is enabled
+
+Violations are deduplicated by ``key`` keeping the first (shortest
+trace) occurrence. State budget overruns are NEVER silent: the result
+carries ``truncated`` and the caller turns it into an APX301 finding.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterable, List, NamedTuple, Optional, Set, Tuple
+
+__all__ = ["Violation", "ExploreResult", "explore", "MAX_STATES"]
+
+#: Default per-(model, config) state budget. The shipped models sit in
+#: the hundreds-to-low-thousands of states; 200k is a runaway backstop
+#: (a model edit that explodes past it is itself a finding, not a hang).
+MAX_STATES = 200_000
+
+
+class Violation(NamedTuple):
+    """One invariant breach, before trace attachment.
+
+    ``key`` is the dedup identity (one finding per distinct breach, not
+    one per interleaving that exhibits it); ``anchor`` names the
+    extracted fact whose source line the finding should point at, or
+    None for the family's class/def line.
+    """
+
+    code: str                    # "APX302".."APX308"
+    key: str                     # stable dedup id within the family run
+    message: str                 # the invariant statement, no trace yet
+    anchor: Optional[str] = None  # fact name -> source line via extraction
+
+
+class ExploreResult(NamedTuple):
+    violations: Tuple[Tuple[Violation, Tuple[str, ...]], ...]
+    labels: Set[str]             # every action label that ever fired
+    n_states: int
+    truncated: bool
+
+
+def _trace_to(seen: Dict, state) -> List[str]:
+    out: List[str] = []
+    while True:
+        prev, label = seen[state]
+        if prev is None:
+            break
+        out.append(label)
+        state = prev
+    out.reverse()
+    return out
+
+
+def render_trace(trace: Iterable[str]) -> str:
+    steps = list(trace)
+    if not steps:
+        return "counterexample: (initial state)"
+    return ("counterexample (%d steps): %s"
+            % (len(steps), " -> ".join(steps)))
+
+
+def explore(model, max_states: int = MAX_STATES) -> ExploreResult:
+    """Exhaustive BFS of ``model``'s bounded state space."""
+    init = model.initial()
+    # state -> (predecessor state, action label); init has no parent
+    seen: Dict = {init: (None, None)}
+    frontier = deque([init])
+    found: Dict[str, Tuple[Violation, Tuple[str, ...]]] = {}
+    labels: Set[str] = set()
+    truncated = False
+
+    def note(viols, state, label=None):
+        for v in viols:
+            if v.key in found:
+                continue
+            trace = _trace_to(seen, state)
+            if label is not None:
+                trace.append(label)
+            found[v.key] = (v, tuple(trace))
+
+    note(model.check(init), init)
+    while frontier:
+        s = frontier.popleft()
+        acts = sorted(model.actions(s), key=lambda a: a[0])
+        if not acts:
+            note(model.quiescence(s), s)
+            continue
+        for label, ns, viols in acts:
+            labels.add(label)
+            note(viols, s, label)
+            if ns in seen:
+                continue
+            if len(seen) >= max_states:
+                truncated = True
+                continue
+            seen[ns] = (s, label)
+            note(model.check(ns), ns)
+            frontier.append(ns)
+
+    ordered = tuple(sorted(found.values(),
+                           key=lambda vt: (vt[0].code, vt[0].key)))
+    return ExploreResult(ordered, labels, len(seen), truncated)

@@ -1,0 +1,462 @@
+"""AST-side extraction of protocol facts from the real serving source.
+
+Same trick as the APX2xx kernel extraction: the model checker does not
+hardcode what the shipped code looks like — it READS the guard
+conditions out of the AST (`shed victim strictly weaker`, `restart
+honors pending cancels`, `feasibility before displacement`, ...) and
+parameterizes the bounded models with them. Three consequences:
+
+- shipped code with all its guards extracts to all-true facts and the
+  exploration runs clean;
+- a pre-fix fixture (or a regression that deletes a guard) extracts a
+  false fact and the exploration produces the race WITH the
+  interleaving trace;
+- a refactor that renames/removes a REQUIRED method breaks extraction
+  itself — surfaced loudly as APX301 model drift, never silently.
+
+Matching is structural (method-name signatures), not module-name based,
+so the committed pre-fix/post-fix fixtures under
+tests/fixtures/protocols/ are checked by the very same extractors that
+check the live tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["Extraction", "extract_all", "FAMILY_REQUIRED_METHODS",
+           "FAMILY_REQUIRED_BANKED"]
+
+
+@dataclasses.dataclass
+class Extraction:
+    """One protocol-family match in one module."""
+
+    family: str                  # scheduler|replica|frontend|disagg|kv|
+    #                              policy|controller
+    path: str
+    modname: str
+    name: str                    # class name or "<module>"
+    line: int                    # class/first-def line
+    facts: Dict[str, bool]
+    anchors: Dict[str, int]      # fact -> source line of the evidence
+    missing: List[str]           # required methods absent (APX301)
+    banked: Set[str]             # transition names banked module-wide
+    kinds: Dict[str, int]        # policy: Action kinds -> line;
+    #                              controller: handled kinds -> line
+    modes_down: Dict[str, str]   # controller: MODES_DOWN literal
+
+    def line_for(self, fact: str) -> int:
+        return self.anchors.get(fact, self.line)
+
+
+# Method signatures that identify a family. ALL listed names must be
+# present for a match-and-extract; a PARTIAL match (>= the detect set)
+# with some required method missing is APX301 drift.
+_DETECT: Dict[str, Set[str]] = {
+    "scheduler": {"_pick_shed_victim_locked", "submit"},
+    "replica": {"restart", "drain_inflight"},
+    "frontend": {"_displace_sheddable", "_hedge_blown_budgets"},
+    "disagg": {"_reroute", "_start_handoff"},
+}
+
+FAMILY_REQUIRED_METHODS: Dict[str, Set[str]] = {
+    "scheduler": {"_pick_shed_victim_locked", "submit", "pop"},
+    "replica": {"restart", "drain_inflight", "cancel", "_iterate"},
+    "frontend": {"submit", "_displace_sheddable", "_collect",
+                 "_failover", "_hedge_blown_budgets"},
+    "disagg": {"_reroute", "_start_handoff", "_process_pending",
+               "_retry_deferred", "cancel"},
+    "kv": {"extract_page", "verify_page", "install_page"},
+    "policy": {"decide", "_escalation", "_relaxation", "_pool_ratio"},
+    "controller": {"_apply", "tick"},
+}
+
+#: transition names each family MUST bank somewhere in its module
+#: (missing -> APX308 unbanked-transition).
+FAMILY_REQUIRED_BANKED: Dict[str, Set[str]] = {
+    "scheduler": set(),
+    "replica": {"replica_dead", "replica_restart", "replica_failed"},
+    "frontend": {"shed", "failover", "hedge", "mode"},
+    "disagg": {"handoff", "handoff_failure", "handoff_reroute",
+               "handoff_parity_mismatch", "pool_shift"},
+    "kv": set(),
+    "policy": set(),
+    "controller": {"autopilot"},
+}
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _module_funcs(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _attr_calls(node: ast.AST, name: str) -> List[ast.Call]:
+    out = []
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == name):
+            out.append(n)
+    return out
+
+
+def _any_calls(node: ast.AST, name: str) -> List[ast.Call]:
+    """Calls to ``name`` whether spelled bare or as an attribute."""
+    out = []
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if (isinstance(f, ast.Name) and f.id == name) or (
+                isinstance(f, ast.Attribute) and f.attr == name):
+            out.append(n)
+    return out
+
+
+def _first_pos(calls: List[ast.Call]) -> Optional[Tuple[int, int]]:
+    if not calls:
+        return None
+    return min((c.lineno, c.col_offset) for c in calls)
+
+
+def _refs_attr(node: ast.AST, attr: str) -> Optional[int]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == attr:
+            return n.lineno
+    return None
+
+
+def _compares(node: ast.AST) -> List[ast.Compare]:
+    return [n for n in ast.walk(node) if isinstance(n, ast.Compare)]
+
+
+def _comp_side_attr(cmp: ast.Compare, attr: str) -> bool:
+    sides = [cmp.left] + list(cmp.comparators)
+    return any(isinstance(x, ast.Attribute) and x.attr == attr
+               for x in sides)
+
+
+def _comprehension_compares_const(fn: ast.AST, const: str
+                                  ) -> Optional[int]:
+    """A comprehension whose `if` compares something to ``const`` —
+    the `[p for k, p in inbox if k == "cancel"]` honor-scan shape."""
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                for test in gen.ifs:
+                    for cmp in _compares(test):
+                        sides = [cmp.left] + list(cmp.comparators)
+                        if any(isinstance(x, ast.Constant)
+                               and x.value == const for x in sides):
+                            return n.lineno
+    return None
+
+
+def _banked_names(tree: ast.Module) -> Set[str]:
+    out = set()
+    for call in _attr_calls(tree, "transition"):
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            out.add(call.args[0].value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-family fact extraction. Each returns (facts, anchors).
+# ---------------------------------------------------------------------------
+
+
+def _fact(facts, anchors, name, line, ok):
+    facts[name] = bool(ok)
+    if line:
+        anchors[name] = line
+
+
+def _extract_scheduler(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    fn = m["_pick_shed_victim_locked"]
+    # the strictly-weaker gate: `if r.rank <= incoming: continue`.
+    # Pre-fix shape used `<` (skip only strictly-stronger -> equal-class
+    # victims slip through).
+    ok, line = False, fn.lineno
+    for n in ast.walk(fn):
+        if isinstance(n, ast.If):
+            for cmp in _compares(n.test):
+                if _comp_side_attr(cmp, "rank") or any(
+                        isinstance(x, ast.Name) and "rank" in x.id
+                        for x in [cmp.left] + list(cmp.comparators)):
+                    has_continue = any(isinstance(b, ast.Continue)
+                                       for b in ast.walk(n))
+                    if has_continue and any(isinstance(op, ast.LtE)
+                                            for op in cmp.ops):
+                        ok, line = True, n.lineno
+                    elif has_continue:
+                        line = n.lineno
+    _fact(facts, anchors, "shed_strictly_weaker", line, ok)
+    return facts, anchors
+
+
+def _extract_replica(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    for fact, meth in (("restart_honors_pending_cancels", "restart"),
+                       ("drain_honors_pending_cancels", "drain_inflight")):
+        fn = m[meth]
+        line = _comprehension_compares_const(fn, "cancel")
+        _fact(facts, anchors, fact, line or fn.lineno, line is not None)
+    fn = m["restart"]
+    line = _refs_attr(fn, "poison_threshold")
+    _fact(facts, anchors, "restart_quarantines_poison",
+          line or fn.lineno, line is not None)
+    it = m.get("_iterate")
+    ok, line = False, (it.lineno if it else m["restart"].lineno)
+    if it is not None:
+        for n in ast.walk(it):
+            if isinstance(n, ast.If):
+                for cmp in _compares(n.test):
+                    if _comp_side_attr(cmp, "generation") and any(
+                            isinstance(op, ast.NotEq) for op in cmp.ops):
+                        if any(isinstance(b, ast.Return)
+                               for b in ast.walk(n)):
+                            ok, line = True, n.lineno
+    _fact(facts, anchors, "generation_fenced", line, ok)
+    return facts, anchors
+
+
+def _extract_frontend(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    sub = m["submit"]
+    p_pick = _first_pos(_attr_calls(sub, "_pick_replica"))
+    p_disp = _first_pos(_attr_calls(sub, "_displace_sheddable"))
+    ok = p_pick is not None and (p_disp is None or p_pick < p_disp)
+    _fact(facts, anchors, "feasibility_before_displacement",
+          (p_disp or p_pick or (sub.lineno, 0))[0], ok)
+    disp = m["_displace_sheddable"]
+    line = None
+    for cmp in _compares(disp):
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in cmp.ops) \
+                and (_comp_side_attr(cmp, "_shed_rids")):
+            line = cmp.lineno
+    _fact(facts, anchors, "displace_skips_already_shed",
+          line or disp.lineno, line is not None)
+    col = m["_collect"]
+    line = _first_pos(_attr_calls(col, "pending"))
+    _fact(facts, anchors, "route_waits_for_pending_legs",
+          (line or (col.lineno, 0))[0], line is not None)
+    hedge = m["_hedge_blown_budgets"]
+    line = _first_pos(_attr_calls(hedge, "first_token_seen"))
+    _fact(facts, anchors, "hedge_requires_no_first_token",
+          (line or (hedge.lineno, 0))[0], line is not None)
+    line = None
+    for cmp in _compares(hedge):
+        if any(isinstance(op, ast.NotIn) for op in cmp.ops) and any(
+                isinstance(x, ast.Name) and x.id == "routed"
+                for x in cmp.comparators):
+            line = cmp.lineno
+    _fact(facts, anchors, "hedge_excludes_routed",
+          line or hedge.lineno, line is not None)
+    fo = m["_failover"]
+    line = None
+    for n in ast.walk(fo):
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                if _refs_attr(gen.iter, "_route") is not None:
+                    line = n.lineno
+    _fact(facts, anchors, "failover_skips_live_hedge",
+          line or fo.lineno, line is not None)
+    return facts, anchors
+
+
+def _extract_disagg(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    rr = m["_reroute"]
+    line = _refs_attr(rr, "max_handoff_attempts")
+    _fact(facts, anchors, "reroute_bounded", line or rr.lineno,
+          line is not None)
+    live_ok, live_line = True, None
+    for fact_meth in ("_process_pending", "_retry_deferred"):
+        fn = m[fact_meth]
+        found = None
+        for cmp in _compares(fn):
+            if any(isinstance(op, (ast.In, ast.NotIn))
+                   for op in cmp.ops) and _comp_side_attr(cmp, "_live"):
+                found = cmp.lineno
+        if found is None:
+            live_ok, live_line = False, fn.lineno
+        elif live_line is None:
+            live_line = found
+    _fact(facts, anchors, "pending_checks_live",
+          live_line or m["_process_pending"].lineno, live_ok)
+    can = m["cancel"]
+    line = None
+    for n in ast.walk(can):
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp)):
+            for gen in n.generators:
+                if _refs_attr(gen.iter, "_pending") is not None:
+                    line = n.lineno
+    _fact(facts, anchors, "cancel_purges_window", line or can.lineno,
+          line is not None)
+    return facts, anchors
+
+
+def _extract_kv(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    inst = m["install_page"]
+    p_ver = _first_pos(_any_calls(inst, "verify_page"))
+    p_put = _first_pos(_attr_calls(inst, "put_prefix"))
+    ok = p_ver is not None and (p_put is None or p_ver < p_put)
+    _fact(facts, anchors, "verify_before_install",
+          (p_put or p_ver or (inst.lineno, 0))[0], ok)
+    return facts, anchors
+
+
+def _extract_policy(m: Dict[str, ast.FunctionDef]):
+    facts: Dict[str, bool] = {}
+    anchors: Dict[str, int] = {}
+    dec = m["decide"]
+    ok, line = False, dec.lineno
+    for n in ast.walk(dec):
+        if isinstance(n, ast.If) and isinstance(n.test, ast.UnaryOp) \
+                and isinstance(n.test.op, ast.Not):
+            if _any_calls(n.test, "_has_evidence") and any(
+                    isinstance(b, ast.Return) for b in ast.walk(n)):
+                ok, line = True, n.lineno
+    _fact(facts, anchors, "evidence_freeze", line, ok)
+    pr = m["_pool_ratio"]
+    ok, line = False, pr.lineno
+    for cmp in _compares(pr):
+        if any(isinstance(op, ast.LtE) for op in cmp.ops) and any(
+                isinstance(x, ast.Constant) and x.value == 1
+                for x in cmp.comparators):
+            ok, line = True, cmp.lineno
+    _fact(facts, anchors, "donor_keeps_one", line, ok)
+    return facts, anchors
+
+
+def _policy_action_kinds(tree: ast.Module) -> Dict[str, int]:
+    kinds: Dict[str, int] = {}
+    for call in _any_calls(tree, "Action"):
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            kinds.setdefault(call.args[0].value, call.lineno)
+    return kinds
+
+
+def _controller_handled_kinds(apply_fn: ast.FunctionDef
+                              ) -> Dict[str, int]:
+    kinds: Dict[str, int] = {}
+    for cmp in _compares(apply_fn):
+        if not (isinstance(cmp.left, ast.Attribute)
+                and cmp.left.attr == "kind"
+                and any(isinstance(op, ast.Eq) for op in cmp.ops)):
+            continue
+        for x in cmp.comparators:
+            if isinstance(x, ast.Constant) and isinstance(x.value, str):
+                kinds.setdefault(x.value, cmp.lineno)
+    return kinds
+
+
+def _modes_down(tree: ast.Module) -> Dict[str, str]:
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "MODES_DOWN"
+                for t in n.targets) and isinstance(n.value, ast.Dict):
+            out = {}
+            for k, v in zip(n.value.keys, n.value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                        v, ast.Constant):
+                    out[k.value] = v.value
+            return out
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# the walk
+# ---------------------------------------------------------------------------
+
+_CLASS_EXTRACTORS = {
+    "scheduler": _extract_scheduler,
+    "replica": _extract_replica,
+    "frontend": _extract_frontend,
+    "disagg": _extract_disagg,
+}
+
+
+def extract_all(mod) -> List[Extraction]:
+    """All protocol-family matches in one parsed ``ModuleSource``."""
+    out: List[Extraction] = []
+    tree = mod.tree
+    if tree is None:
+        return out
+    banked = _banked_names(tree)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        meths = _methods(node)
+        names = set(meths)
+        for family, detect in _DETECT.items():
+            if not detect <= names:
+                continue
+            required = FAMILY_REQUIRED_METHODS[family]
+            missing = sorted(required - names)
+            facts: Dict[str, bool] = {}
+            anchors: Dict[str, int] = {}
+            if not missing:
+                facts, anchors = _CLASS_EXTRACTORS[family](meths)
+            out.append(Extraction(
+                family=family, path=mod.path, modname=mod.modname,
+                name=node.name, line=node.lineno, facts=facts,
+                anchors=anchors, missing=missing, banked=banked,
+                kinds={}, modes_down={}))
+        # the controller family: a class applying Action records
+        if "_apply" in names and "tick" in names:
+            missing = sorted(FAMILY_REQUIRED_METHODS["controller"]
+                             - names)
+            out.append(Extraction(
+                family="controller", path=mod.path, modname=mod.modname,
+                name=node.name, line=node.lineno, facts={}, anchors={},
+                missing=missing, banked=banked,
+                kinds=(_controller_handled_kinds(meths["_apply"])
+                       if "_apply" in meths else {}),
+                modes_down=_modes_down(tree)))
+
+    funcs = _module_funcs(tree)
+    fnames = set(funcs)
+    if {"install_page", "verify_page"} <= fnames:
+        missing = sorted(FAMILY_REQUIRED_METHODS["kv"] - fnames)
+        facts, anchors = ({}, {})
+        if not missing:
+            facts, anchors = _extract_kv(funcs)
+        out.append(Extraction(
+            family="kv", path=mod.path, modname=mod.modname,
+            name="<module>", line=funcs["install_page"].lineno,
+            facts=facts, anchors=anchors, missing=missing,
+            banked=banked, kinds={}, modes_down={}))
+    if {"decide", "_escalation"} <= fnames:
+        missing = sorted(FAMILY_REQUIRED_METHODS["policy"] - fnames)
+        facts, anchors = ({}, {})
+        if not missing:
+            facts, anchors = _extract_policy(funcs)
+        out.append(Extraction(
+            family="policy", path=mod.path, modname=mod.modname,
+            name="<module>", line=funcs["decide"].lineno, facts=facts,
+            anchors=anchors, missing=missing, banked=banked,
+            kinds=_policy_action_kinds(tree), modes_down={}))
+    return out

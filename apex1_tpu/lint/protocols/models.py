@@ -1,0 +1,1064 @@
+"""Bounded state-machine models of the serving control plane.
+
+Each model is a tiny, explicit abstraction of one protocol surface —
+the QoS scheduler's shed ladder, the `ReplicaSupervisor` lifecycle, the
+`ServingFrontend` admission/hedge/failover ladder, the disagg handoff +
+re-route ladder, and the autopilot's actuators — parameterized by FACTS
+extracted from the real source AST (`extract.py`). A fact is a named
+guard the shipped code carries ("restart honors pending cancels",
+"feasibility before displacement", ...). Shipped code extracts to
+all-true facts and every model explores clean; a pre-fix fixture (or a
+regression) extracts a fact to False and the exhaustive exploration
+finds the race and names the interleaving.
+
+The bounded configurations are deliberately small (<=3 replicas, <=4
+requests, <=2 faults): each model's state space sits in the
+hundreds-to-thousands of states, so `tools/lint.py --protocols`
+explores EVERY interleaving in well under a second. What that buys is
+exactly what review rounds kept doing by hand — and what it does NOT
+buy (timing, real thread schedules, hardware windows) is documented in
+docs/lint.md.
+
+Violation codes (registered in lint/core.py RULE_SLUGS):
+
+    APX302 double-decode      one rid live twice on one engine, or two
+                              terminal results published for one rid
+    APX303 qos-inversion      shed victim not strictly weaker than the
+                              incoming class
+    APX304 cancel-resurrect   an acknowledged cancel later finishes done
+    APX305 stranded-result    request or late result uncollectable at
+                              quiescence
+    APX306 capacity-leak      displacement/hedge/shift_pool destroys or
+                              double-spends capacity
+    APX307 ladder             a ladder rung unreachable, unexitable, or
+                              unbounded; a mandatory gate missing
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, FrozenSet, List, NamedTuple, Set, Tuple
+
+from apex1_tpu.lint.protocols.explore import (Violation, explore,
+                                              render_trace)
+
+__all__ = ["run_protocol", "ProtoFinding", "FAMILY_FACTS"]
+
+
+class ProtoFinding(NamedTuple):
+    code: str
+    key: str
+    anchor: str            # fact name, or "" for the family decl line
+    message: str           # invariant + counterexample trace
+
+
+#: fact names each family's extractor produces (True = shipped guard
+#: present). Used by extract.py to default unknown facts and by the
+#: tests to enumerate the flip surface.
+FAMILY_FACTS: Dict[str, Tuple[str, ...]] = {
+    "scheduler": ("shed_strictly_weaker",),
+    "replica": ("restart_honors_pending_cancels",
+                "drain_honors_pending_cancels",
+                "generation_fenced",
+                "restart_quarantines_poison"),
+    "frontend": ("feasibility_before_displacement",
+                 "displace_skips_already_shed",
+                 "route_waits_for_pending_legs",
+                 "hedge_requires_no_first_token",
+                 "hedge_excludes_routed",
+                 "failover_skips_live_hedge"),
+    "disagg": ("reroute_bounded", "pending_checks_live",
+               "cancel_purges_window", "verify_before_install"),
+    "autopilot": ("evidence_freeze", "donor_keeps_one"),
+}
+
+
+# ---------------------------------------------------------------------------
+# scheduler: the shed ladder (PR 7 round 1 — equal-class shed)
+# ---------------------------------------------------------------------------
+
+_SCHED_REQS = (("g1", 0), ("b1", 1), ("s1", 2), ("s2", 2))
+_SCHED_RANK = dict(_SCHED_REQS)
+_SCHED_CLS = {0: "guaranteed", 1: "best_effort", 2: "sheddable"}
+_SCHED_CAP = 2
+
+
+class _SchedState(NamedTuple):
+    queue: Tuple[str, ...]       # arrival order
+    subd: FrozenSet[str]
+
+
+class SchedulerModel:
+    name = "scheduler"
+
+    def __init__(self, facts: Dict[str, bool], config: str = "shed"):
+        self.config = config
+        self.strict = facts["shed_strictly_weaker"]
+
+    def initial(self):
+        return _SchedState((), frozenset())
+
+    def actions(self, s: _SchedState):
+        acts = []
+        for rid, rank in _SCHED_REQS:
+            if rid in s.subd:
+                continue
+            subd = s.subd | {rid}
+            if len(s.queue) < _SCHED_CAP:
+                acts.append((f"submit {rid}",
+                             s._replace(queue=s.queue + (rid,), subd=subd),
+                             ()))
+                continue
+            if self.strict:
+                eligible = [q for q in s.queue if _SCHED_RANK[q] > rank]
+            else:                # pre-fix: skipped only strictly-stronger
+                eligible = [q for q in s.queue if _SCHED_RANK[q] >= rank]
+            if not eligible:
+                acts.append((f"reject {rid} (queue full, no weaker victim)",
+                             s._replace(subd=subd), ()))
+                continue
+            # weakest class first, youngest (latest arrival) within it
+            victim = max(eligible,
+                         key=lambda q: (_SCHED_RANK[q], s.queue.index(q)))
+            viols: Tuple[Violation, ...] = ()
+            if _SCHED_RANK[victim] <= rank:
+                viols = (Violation(
+                    "APX303", "equal-class-shed",
+                    f"shed victim '{victim}' "
+                    f"({_SCHED_CLS[_SCHED_RANK[victim]]}) is not strictly "
+                    f"weaker than the incoming '{rid}' "
+                    f"({_SCHED_CLS[rank]}): an equal-or-stronger-class "
+                    "request was shed",
+                    anchor="shed_strictly_weaker"),)
+            queue = tuple(q for q in s.queue if q != victim) + (rid,)
+            acts.append((f"submit {rid} (sheds {victim})",
+                         s._replace(queue=queue, subd=subd), viols))
+        if s.queue:
+            best = min(s.queue,
+                       key=lambda q: (_SCHED_RANK[q], s.queue.index(q)))
+            acts.append((f"pop {best}",
+                         s._replace(queue=tuple(q for q in s.queue
+                                                if q != best)), ()))
+        return acts
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s):
+        return ()
+
+    def required_events(self) -> Set[str]:
+        req = {"pop g1", "reject s2 (queue full, no weaker victim)"}
+        if self.strict:
+            req.add("submit g1 (sheds s2)")
+        return req
+
+
+# ---------------------------------------------------------------------------
+# replica: supervisor lifecycle (restart/drain cancel honor, generation
+# fencing, poison quarantine)
+# ---------------------------------------------------------------------------
+
+_REP_RIDS = ("r0", "r1")
+_REP_KILLS = 2
+_REP_MAX_RESTARTS = 1
+
+
+class _RepState(NamedTuple):
+    rep: str                     # alive|dead|failed
+    restarts: int
+    inbox: Tuple[Tuple[str, str], ...]   # ("s"|"c", rid) FIFO
+    inflight: FrozenSet[str]
+    engine: FrozenSet[str]       # admitted to the CURRENT generation
+    abandoned: FrozenSet[str]    # threads of a pre-kill generation
+    results: FrozenSet[Tuple[str, str]]
+    acked: FrozenSet[str]        # cancel acknowledged to the caller
+    kills: int                   # kill budget remaining
+    drained: bool
+    survivor: FrozenSet[str]     # resubmitted to a surviving replica
+    subd: FrozenSet[str]
+
+
+class ReplicaLifecycleModel:
+    name = "replica"
+    config = "lifecycle"
+
+    def __init__(self, facts: Dict[str, bool]):
+        self.restart_honors = facts["restart_honors_pending_cancels"]
+        self.drain_honors = facts["drain_honors_pending_cancels"]
+        self.fenced = facts["generation_fenced"]
+
+    def initial(self):
+        return _RepState("alive", 0, (), frozenset(), frozenset(),
+                         frozenset(), frozenset(), frozenset(),
+                         _REP_KILLS, False, frozenset(), frozenset())
+
+    @staticmethod
+    def _honor_cancels(inbox, inflight, results):
+        for k, rid in inbox:
+            if k == "c" and rid in inflight:
+                inflight = inflight - {rid}
+                results = results | {(rid, "cancelled")}
+        return inflight, results
+
+    def actions(self, s: _RepState):
+        acts: List = []
+        for rid in _REP_RIDS:
+            if rid not in s.subd:
+                acts.append((f"submit {rid}", s._replace(
+                    subd=s.subd | {rid},
+                    inbox=s.inbox + (("s", rid),),
+                    inflight=s.inflight | {rid}), ()))
+            if rid in s.inflight and rid not in s.acked:
+                if ("s", rid) in s.inbox:   # cancelled before admission
+                    idx = s.inbox.index(("s", rid))
+                    acts.append((f"cancel {rid} (pre-admission)",
+                                 s._replace(
+                                     inbox=s.inbox[:idx] + s.inbox[idx + 1:],
+                                     inflight=s.inflight - {rid},
+                                     results=s.results | {(rid, "cancelled")},
+                                     acked=s.acked | {rid}), ()))
+                else:
+                    acts.append((f"cancel {rid}", s._replace(
+                        inbox=s.inbox + (("c", rid),),
+                        acked=s.acked | {rid}), ()))
+        if s.rep == "alive" and s.inbox:
+            (k, rid), rest = s.inbox[0], s.inbox[1:]
+            if k == "s":
+                acts.append((f"admit {rid}", s._replace(
+                    inbox=rest, engine=s.engine | {rid}), ()))
+            else:
+                acts.append((f"process cancel {rid}", s._replace(
+                    inbox=rest, engine=s.engine - {rid},
+                    inflight=s.inflight - {rid},
+                    results=s.results | {(rid, "cancelled")}), ()))
+        if s.rep == "alive":
+            for rid in sorted(s.engine):
+                if ("c", rid) in s.inbox:
+                    continue     # the inbox drain will cancel it first
+                viols: Tuple[Violation, ...] = ()
+                if rid in s.acked:
+                    viols = (Violation(
+                        "APX304", "cancel-resurrect-restart",
+                        f"acknowledged cancel resurrected: restart() "
+                        f"resubmitted {rid} while its cancel was pending "
+                        "in the inbox, and the new generation finished it "
+                        "done", anchor="restart_honors_pending_cancels"),)
+                acts.append((f"{rid} finishes done", s._replace(
+                    engine=s.engine - {rid},
+                    inflight=s.inflight - {rid},
+                    results=s.results | {(rid, "done")}), viols))
+        if s.rep == "alive" and s.kills > 0 and s.engine:
+            acts.append(("kill replica", s._replace(
+                rep="dead", abandoned=s.engine, engine=frozenset(),
+                kills=s.kills - 1), ()))
+        if s.rep == "dead":
+            if s.restarts >= _REP_MAX_RESTARTS:
+                acts.append(("restart budget spent -> failed",
+                             s._replace(rep="failed"), ()))
+            else:
+                inflight, results = s.inflight, s.results
+                if self.restart_honors:
+                    inflight, results = self._honor_cancels(
+                        s.inbox, inflight, results)
+                acts.append(("restart (resubmits inflight)", s._replace(
+                    rep="alive", restarts=s.restarts + 1,
+                    inflight=inflight, results=results,
+                    inbox=tuple(("s", rid) for rid in sorted(inflight))),
+                    ()))
+        if s.rep == "failed" and not s.drained:
+            inflight, results = s.inflight, s.results
+            if self.drain_honors:
+                inflight, results = self._honor_cancels(
+                    s.inbox, inflight, results)
+            acts.append(("failover drains inflight to survivor",
+                         s._replace(drained=True, inbox=(),
+                                    inflight=frozenset(), results=results,
+                                    survivor=inflight), ()))
+        for rid in sorted(s.survivor):
+            viols = ()
+            if rid in s.acked:
+                viols = (Violation(
+                    "APX304", "cancel-resurrect-drain",
+                    f"acknowledged cancel resurrected at failover: "
+                    f"drain_inflight() forwarded {rid} with its cancel "
+                    "still pending in the inbox, and a surviving replica "
+                    "finished it done",
+                    anchor="drain_honors_pending_cancels"),)
+            acts.append((f"survivor finishes {rid} done", s._replace(
+                survivor=s.survivor - {rid},
+                results=s.results | {(rid, "done")}), viols))
+        if not self.fenced:
+            for rid in sorted(s.abandoned):
+                acts.append((f"stale-generation thread publishes {rid}",
+                             s._replace(abandoned=s.abandoned - {rid},
+                                        results=s.results | {(rid, "done")}),
+                             ()))
+        return acts
+
+    def check(self, s: _RepState):
+        viols = []
+        for rid in _REP_RIDS:
+            statuses = sorted(st for r, st in s.results if r == rid)
+            if len(statuses) >= 2:
+                viols.append(Violation(
+                    "APX302", "dup-publish",
+                    f"two terminal results published for {rid} "
+                    f"({' + '.join(statuses)}): a thread from a pre-kill "
+                    "generation published after the supervisor restarted "
+                    "(publish is not fenced on the replica generation)",
+                    anchor="generation_fenced"))
+        return tuple(viols)
+
+    def quiescence(self, s: _RepState):
+        viols = []
+        done = {r for r, _ in s.results}
+        for rid in sorted(s.subd - done):
+            viols.append(Violation(
+                "APX305", f"stranded-{rid}",
+                f"request {rid} stranded at quiescence: submitted but no "
+                "terminal result (done/cancelled/evicted) was ever "
+                "published"))
+        return tuple(viols)
+
+    def required_events(self) -> Set[str]:
+        return {"kill replica", "restart (resubmits inflight)",
+                "restart budget spent -> failed",
+                "failover drains inflight to survivor"}
+
+
+_POISON_THRESHOLD = 1
+_POISON_MAX_RESTARTS = 3
+
+
+class _PoisonState(NamedTuple):
+    rep: str
+    restarts: int
+    kcount: int                  # times p0 killed the replica
+    inbox: Tuple[Tuple[str, str], ...]
+    inflight: FrozenSet[str]
+    results: FrozenSet[Tuple[str, str]]
+    subd: FrozenSet[str]
+    drained: bool
+    survivor: FrozenSet[str]
+
+
+class ReplicaPoisonModel:
+    name = "replica"
+    config = "poison"
+
+    def __init__(self, facts: Dict[str, bool]):
+        self.quarantines = facts["restart_quarantines_poison"]
+
+    def initial(self):
+        return _PoisonState("alive", 0, 0, (), frozenset(), frozenset(),
+                            frozenset(), False, frozenset())
+
+    def actions(self, s: _PoisonState):
+        acts: List = []
+        if "p0" not in s.subd:
+            acts.append(("submit p0 (poison)", s._replace(
+                subd=s.subd | {"p0"}, inbox=(("s", "p0"),),
+                inflight=frozenset({"p0"})), ()))
+        if s.rep == "alive" and s.inbox:
+            acts.append(("admit p0 -> poison kills replica", s._replace(
+                rep="dead", inbox=(), kcount=s.kcount + 1), ()))
+        if s.rep == "dead":
+            if s.restarts >= _POISON_MAX_RESTARTS:
+                acts.append(("restart budget spent -> failed",
+                             s._replace(rep="failed"), ()))
+            elif self.quarantines and s.kcount > _POISON_THRESHOLD:
+                acts.append(("restart quarantines p0 (evicted)",
+                             s._replace(rep="alive",
+                                        restarts=s.restarts + 1, inbox=(),
+                                        inflight=frozenset(),
+                                        results=s.results
+                                        | {("p0", "evicted")}), ()))
+            else:
+                acts.append(("restart (resubmits p0)", s._replace(
+                    rep="alive", restarts=s.restarts + 1,
+                    inbox=(("s", "p0"),)), ()))
+        if s.rep == "failed" and not s.drained:
+            viols: Tuple[Violation, ...] = ()
+            if s.inflight and s.kcount > _POISON_THRESHOLD:
+                viols = (Violation(
+                    "APX307", "poison-cascade",
+                    f"a request that killed its replica {s.kcount}x was "
+                    "never quarantined (restart() lacks the "
+                    "poison_threshold gate): the replica crash-looped to "
+                    "failure and the poison pill is forwarded to a "
+                    "survivor at failover",
+                    anchor="restart_quarantines_poison"),)
+            acts.append(("failover drains inflight to survivor",
+                         s._replace(drained=True, inflight=frozenset(),
+                                    survivor=s.inflight), viols))
+        return acts
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s: _PoisonState):
+        if "p0" in s.subd and not s.results and not s.survivor:
+            return (Violation(
+                "APX305", "stranded-p0",
+                "poison request p0 stranded at quiescence with no "
+                "terminal result"),)
+        return ()
+
+    def required_events(self) -> Set[str]:
+        req = {"admit p0 -> poison kills replica"}
+        if self.quarantines:
+            req.add("restart quarantines p0 (evicted)")
+        return req
+
+
+# ---------------------------------------------------------------------------
+# frontend: admission/displacement (PR 7 round 2) and hedge/failover
+# (PR 7 rounds 1-2)
+# ---------------------------------------------------------------------------
+
+
+class _AdmState(NamedTuple):
+    live: FrozenSet[str]
+    shed: FrozenSet[str]         # displaced, awaiting collection
+    subd: FrozenSet[str]
+    rejected: FrozenSet[str]
+    results: FrozenSet[Tuple[str, str]]
+
+
+class FrontendAdmissionModel:
+    """capacity-1 pool; sheddable + guaranteed arrivals; the two
+    PR 7 round-2 displacement races."""
+
+    name = "frontend"
+
+    def __init__(self, facts: Dict[str, bool], config: str,
+                 reqs, infeasible: FrozenSet[str]):
+        self.config = config
+        self.order_ok = facts["feasibility_before_displacement"]
+        self.skips_shed = facts["displace_skips_already_shed"]
+        self.reqs = reqs                      # ((rid, qos), ...)
+        self.infeasible = infeasible
+        self.cap = 1
+
+    def initial(self):
+        return _AdmState(frozenset(), frozenset(), frozenset(),
+                         frozenset(), frozenset())
+
+    def _submit(self, s: _AdmState, rid: str, qos: str):
+        subd = s.subd | {rid}
+        feasible = rid not in self.infeasible
+        if self.order_ok and not feasible:
+            return (f"reject {rid} (infeasible)",
+                    s._replace(subd=subd, rejected=s.rejected | {rid}), ())
+        displaced = None
+        live, shed = s.live, s.shed
+        if len(live) >= self.cap and qos == "guaranteed":
+            victims = [(r, q) for r, q in self.reqs
+                       if r in live and q == "sheddable"
+                       and not (self.skips_shed and r in shed)]
+            if victims:
+                displaced = victims[-1][0]    # youngest sheddable
+                shed = shed | {displaced}
+        if len(live) >= self.cap and displaced is None:
+            return (f"reject {rid} (at capacity, no victim)",
+                    s._replace(subd=subd, rejected=s.rejected | {rid}), ())
+        if not feasible:          # pre-fix order: capacity checked first
+            if displaced is None:
+                return (f"reject {rid} (infeasible)",
+                        s._replace(subd=subd,
+                                   rejected=s.rejected | {rid}), ())
+            viols = (Violation(
+                "APX306", "shed-for-nothing",
+                f"capacity destroyed: sheddable '{displaced}' was "
+                f"displaced for '{rid}' and THEN the admission was "
+                "rejected as infeasible — the victim is gone and the "
+                "slot it freed admits nothing (feasibility must be "
+                "checked before displacement)",
+                anchor="feasibility_before_displacement"),)
+            return (f"submit {rid} (displaces {displaced}; then "
+                    "rejected infeasible)",
+                    s._replace(subd=subd, shed=shed,
+                               rejected=s.rejected | {rid}), viols)
+        live = live | {rid}
+        viols = ()
+        if len(live - shed) > self.cap:
+            viols = (Violation(
+                "APX306", "stale-victim",
+                f"capacity leaked: already-displaced sheddable was "
+                f"picked as a victim again, so '{rid}' was admitted "
+                f"against a slot that was already spent (non-shed "
+                f"in-flight {len(live - shed)} > capacity {self.cap})",
+                anchor="displace_skips_already_shed"),)
+        label = (f"submit {rid} (displaces {displaced})" if displaced
+                 else f"submit {rid}")
+        return (label, s._replace(live=live, shed=shed, subd=subd), viols)
+
+    def actions(self, s: _AdmState):
+        acts = []
+        for rid, qos in self.reqs:
+            if rid not in s.subd:
+                acts.append(self._submit(s, rid, qos))
+        for rid in sorted(s.shed & s.live):
+            acts.append((f"collect shed {rid} (evicted)", s._replace(
+                live=s.live - {rid},
+                results=s.results | {(rid, "evicted")}), ()))
+        for rid in sorted(s.live - s.shed):
+            acts.append((f"finish {rid} done", s._replace(
+                live=s.live - {rid},
+                results=s.results | {(rid, "done")}), ()))
+        return acts
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s: _AdmState):
+        viols = []
+        done = {r for r, _ in s.results} | s.rejected
+        for rid in sorted(s.subd - done):
+            viols.append(Violation(
+                "APX305", f"stranded-{rid}",
+                f"request {rid} stranded at quiescence: admitted but "
+                "never finished, evicted, or rejected"))
+        return tuple(viols)
+
+    def required_events(self) -> Set[str]:
+        if self.config == "displace":
+            return {"submit g1 (displaces s0)", "collect shed s0 (evicted)",
+                    "finish g1 done"}
+        return set()
+
+
+_HREPS = ("A", "B")
+
+
+class _HedgeState(NamedTuple):
+    reps: Tuple[str, str]        # alive|dead|failed
+    legs: Tuple[Tuple[str, int], ...]    # (rid, replica idx), sorted
+    route: Tuple[int, ...]       # replicas ever routed, in order
+    ft: bool                     # first token seen on some routed leg
+    pub: Tuple[Tuple[str, int, str], ...]  # uncollected results
+    late: Tuple[Tuple[str, int], ...]      # cancelled legs, result due
+    tracked: bool                # the route entry still exists
+    terminal: bool
+    hedged: bool
+    killed: int
+    subd: bool
+    evicted: bool
+
+
+class FrontendHedgeModel:
+    """2 replicas, one guaranteed request, one kill: hedge, failover,
+    winner collection, loser settlement, route sweep."""
+
+    name = "frontend"
+    config = "hedge"
+
+    def __init__(self, facts: Dict[str, bool]):
+        self.waits = facts["route_waits_for_pending_legs"]
+        self.no_ft = facts["hedge_requires_no_first_token"]
+        self.excl_routed = facts["hedge_excludes_routed"]
+        self.skips_live = facts["failover_skips_live_hedge"]
+
+    def initial(self):
+        return _HedgeState(("alive", "alive"), (), (), False, (), (),
+                           False, False, False, 0, False, False)
+
+    @staticmethod
+    def _add(seq, item):
+        return tuple(sorted(seq + (item,)))
+
+    @staticmethod
+    def _drop(seq, item):
+        out = list(seq)
+        out.remove(item)
+        return tuple(out)
+
+    def actions(self, s: _HedgeState):
+        acts: List = []
+        if not s.subd:
+            acts.append(("submit g0 -> A", s._replace(
+                subd=True, legs=(("g0", 0),), route=(0,), tracked=True), ()))
+        if s.subd and not s.ft and any(s.reps[r] == "alive"
+                                       for _, r in s.legs):
+            acts.append(("first token streams", s._replace(ft=True), ()))
+        if (s.subd and not s.hedged and not s.terminal and s.tracked
+                and not (self.no_ft and s.ft)):
+            if self.excl_routed:
+                cands = [r for r in (0, 1)
+                         if s.reps[r] == "alive" and r not in s.route]
+            else:                # pre-fix: excluded only the primary leg
+                cands = [r for r in (0, 1)
+                         if s.reps[r] == "alive" and r != s.route[0]]
+            for r in cands:
+                viols = []
+                if ("g0", r) in s.legs:
+                    viols.append(Violation(
+                        "APX302", "hedge-double-decode",
+                        f"hedge fired onto replica {_HREPS[r]} which "
+                        "already holds a live leg for g0: one rid "
+                        "decoding concurrently twice on one engine",
+                        anchor="hedge_excludes_routed"))
+                if s.ft:
+                    viols.append(Violation(
+                        "APX306", "hedge-streaming",
+                        "hedge fired for a request that is already "
+                        "streaming (a routed leg has produced its first "
+                        "token): the duplicate full decode burns "
+                        "hedge-protected capacity for zero tail-latency "
+                        "win", anchor="hedge_requires_no_first_token"))
+                acts.append((f"hedge -> {_HREPS[r]}", s._replace(
+                    hedged=True, legs=self._add(s.legs, ("g0", r)),
+                    route=s.route + (r,)), tuple(viols)))
+        for r in (0, 1):
+            if s.reps[r] == "alive" and s.killed < 1 and ("g0", r) in s.legs:
+                acts.append((f"kill {_HREPS[r]}", s._replace(
+                    reps=tuple("dead" if i == r else st
+                               for i, st in enumerate(s.reps)),
+                    killed=s.killed + 1), ()))
+            if s.reps[r] == "dead":
+                acts.append(self._fail(s, r))
+        for rid, r in s.legs:
+            if s.reps[r] == "alive" and not s.terminal:
+                acts.append((f"{_HREPS[r]} publishes done", s._replace(
+                    legs=self._drop(s.legs, (rid, r)),
+                    pub=self._add(s.pub, (rid, r, "done"))), ()))
+        if s.tracked and not s.terminal:
+            for rid, r, st in s.pub:
+                losers = tuple(l for l in s.legs if l[0] == rid)
+                acts.append((f"collect {st} from {_HREPS[r]}", s._replace(
+                    terminal=True, pub=self._drop(s.pub, (rid, r, st)),
+                    legs=tuple(l for l in s.legs if l[0] != rid),
+                    late=tuple(sorted(s.late + losers)),
+                    tracked=self.waits), ()))
+        for rid, r in s.late:
+            if s.reps[r] == "alive":
+                acts.append((f"{_HREPS[r]} publishes late cancelled",
+                             s._replace(late=self._drop(s.late, (rid, r)),
+                                        pub=self._add(s.pub,
+                                                      (rid, r,
+                                                       "cancelled"))), ()))
+        if (s.tracked and s.terminal and not s.legs and not s.late
+                and s.pub):
+            acts.append(("route swept (all legs settled)", s._replace(
+                pub=(), tracked=False), ()))
+        return acts
+
+    def _fail(self, s: _HedgeState, r: int):
+        """dead -> failed (restart budget spent) + frontend failover of
+        the drained legs."""
+        reps = tuple("failed" if i == r else st
+                     for i, st in enumerate(s.reps))
+        ns = s._replace(reps=reps,
+                        late=tuple(l for l in s.late if l[1] != r))
+        dead_legs = [l for l in s.legs if l[1] == r]
+        if not dead_legs or s.terminal:
+            return (f"{_HREPS[r]} fails (no legs to drain)",
+                    ns._replace(legs=tuple(l for l in s.legs
+                                           if l[1] != r)), ())
+        leg = dead_legs[0]
+        legs = self._drop(s.legs, leg)
+        if self.skips_live:
+            others = [q for q in s.route
+                      if q != r and s.reps[q] == "alive"
+                      and ("g0", q) in legs]
+            if others:
+                return (f"{_HREPS[r]} fails; dead leg dropped (live "
+                        "hedge leg survives)", ns._replace(legs=legs), ())
+        targets = [q for q in (0, 1) if q != r and s.reps[q] == "alive"]
+        if not targets:
+            return (f"{_HREPS[r]} fails; no survivor -> evicted",
+                    ns._replace(legs=legs, terminal=True, evicted=True,
+                                late=(), tracked=False), ())
+        tgt = targets[0]
+        viols: Tuple[Violation, ...] = ()
+        if ("g0", tgt) in legs:
+            viols = (Violation(
+                "APX302", "failover-double-decode",
+                f"failover resubmitted g0 onto replica {_HREPS[tgt]} "
+                "which already holds its live hedge leg: one rid "
+                "decoding concurrently twice on one engine",
+                anchor="failover_skips_live_hedge"),)
+        return (f"{_HREPS[r]} fails; failover -> {_HREPS[tgt]}",
+                ns._replace(legs=self._add(legs, ("g0", tgt)),
+                            route=s.route + (tgt,)), viols)
+
+    def check(self, s: _HedgeState):
+        seen = set()
+        for leg in s.legs:
+            if leg in seen:
+                return (Violation(
+                    "APX302", "dup-leg",
+                    f"request g0 holds two identical live legs on "
+                    f"replica {_HREPS[leg[1]]}: one rid decodes twice "
+                    "on one engine", anchor="hedge_excludes_routed"),)
+            seen.add(leg)
+        return ()
+
+    def quiescence(self, s: _HedgeState):
+        viols = []
+        if s.subd and not s.terminal:
+            viols.append(Violation(
+                "APX305", "request-stranded",
+                "request g0 stranded at quiescence: no terminal result "
+                "and no enabled recovery action"))
+        if s.pub:
+            viols.append(Violation(
+                "APX305", "late-result-stranded",
+                "a hedge loser's late result for g0 is stranded: the "
+                "route entry was deleted when the winner was collected "
+                "while a leg was still pending, so the sweep can never "
+                "reclaim it", anchor="route_waits_for_pending_legs"))
+        return tuple(viols)
+
+    def required_events(self) -> Set[str]:
+        req = {"submit g0 -> A", "first token streams",
+               "A fails; failover -> B"}
+        if self.excl_routed:
+            req |= {"hedge -> B", "route swept (all legs settled)"}
+        return req
+
+
+# ---------------------------------------------------------------------------
+# disagg: the handoff window + HandoffError re-route ladder (PR 16)
+# ---------------------------------------------------------------------------
+
+_DISAGG_MAX_ATTEMPTS = 1         # model bound, not the shipped default
+
+
+class _DisaggState(NamedTuple):
+    phase: str      # unsub|prefill|window|decode|done|evicted|cancelled
+    attempts: int
+    faults: int
+    palive: bool
+    parked: bool                 # page sits in the handoff window
+    corrupt: bool
+    in_decode: bool              # decode pool's store holds the page
+    dec_corrupt: bool
+    acked: bool                  # cancel acknowledged
+
+
+class DisaggHandoffModel:
+    name = "disagg"
+
+    def __init__(self, facts: Dict[str, bool], config: str,
+                 faults: int, sticky: bool):
+        self.config = config
+        self.bounded = facts["reroute_bounded"]
+        self.pending_live = facts["pending_checks_live"]
+        self.cancel_purges = facts["cancel_purges_window"]
+        self.verifies = facts["verify_before_install"]
+        self.faults = faults
+        self.sticky = sticky     # the corruption fault re-fires forever
+
+    def initial(self):
+        return _DisaggState("unsub", 0, self.faults, True, False, False,
+                            False, False, False)
+
+    def _reroute(self, s: _DisaggState, cause: str):
+        """One rung of the ladder; returns (label, state, viols)."""
+        n = s.attempts + 1
+        ns = s._replace(attempts=n, parked=False, corrupt=False)
+        if self.bounded and n > _DISAGG_MAX_ATTEMPTS:
+            return (f"{cause}; reroute limit -> evicted "
+                    f"(handoff failed after {n} attempts)",
+                    ns._replace(phase="evicted"), ())
+        if not self.bounded and n > _DISAGG_MAX_ATTEMPTS + 2:
+            return (f"{cause}; reroute #{n}",
+                    ns._replace(phase="evicted"), (Violation(
+                        "APX307", "reroute-unbounded",
+                        "the handoff re-route ladder never terminates: a "
+                        "persistently failing handoff re-routes forever "
+                        "(no max_handoff_attempts eviction rung)",
+                        anchor="reroute_bounded"),))
+        if s.in_decode:
+            return (f"{cause}; reroute: radix hit — decode store already "
+                    "holds the page (prefill skipped)",
+                    ns._replace(phase="decode"), ())
+        if s.palive:
+            return (f"{cause}; reroute: re-prefill on the prefill pool",
+                    ns._replace(phase="prefill"), ())
+        return (f"{cause}; reroute: decode-pool full re-prefill",
+                ns._replace(phase="decode"), ())
+
+    def actions(self, s: _DisaggState):
+        acts: List = []
+        if s.phase == "unsub":
+            acts.append(("submit r0", s._replace(phase="prefill"), ()))
+        if s.phase == "prefill" and s.palive:
+            acts.append(("prefill completes; page extracted to the "
+                         "handoff window",
+                         s._replace(phase="window", parked=True,
+                                    corrupt=False), ()))
+            if s.faults > 0:
+                acts.append(self._reroute(
+                    s._replace(palive=False, faults=s.faults - 1),
+                    "prefill replica killed in the handoff window"))
+        if s.parked and not s.corrupt and (s.faults > 0 or self.sticky):
+            acts.append(("page corrupted on the wire", s._replace(
+                corrupt=True,
+                faults=s.faults if self.sticky else s.faults - 1), ()))
+        if s.phase in ("prefill", "window") and not s.acked:
+            ns = s._replace(phase="cancelled", acked=True)
+            if self.cancel_purges:
+                ns = ns._replace(parked=False)
+            acts.append(("cancel r0 (acknowledged)", ns, ()))
+        if s.parked and (s.phase == "window"
+                         or (s.phase == "cancelled"
+                             and not self.pending_live)):
+            resurrect = s.phase == "cancelled"
+            viols: List[Violation] = []
+            if resurrect:
+                viols.append(Violation(
+                    "APX304", "cancel-window-resurrect",
+                    "cancelled request resurrected from the handoff "
+                    "window: its parked page was delivered and the "
+                    "request re-admitted to the decode pool after the "
+                    "cancel was acknowledged",
+                    anchor="cancel_purges_window"))
+            if s.corrupt and self.verifies:
+                acts.append(self._reroute(
+                    s._replace(parked=False),
+                    "arrival verify fails (integrity)"))
+            elif s.corrupt:
+                viols.append(Violation(
+                    "APX307", "install-noverify",
+                    "a page corrupted in the handoff window was "
+                    "installed without the arrival re-digest: the decode "
+                    "pool serves silently corrupt KV (token parity "
+                    "broken, failure untyped)",
+                    anchor="verify_before_install"))
+                acts.append(("corrupt page installed (no arrival verify)",
+                             s._replace(parked=False, phase="decode",
+                                        in_decode=True, dec_corrupt=True),
+                             tuple(viols)))
+            else:
+                acts.append(("page delivered; decode submitted",
+                             s._replace(parked=False, phase="decode",
+                                        in_decode=True), tuple(viols)))
+        if s.phase == "decode":
+            acts.append(("decode completes r0 (done)",
+                         s._replace(phase="done"), ()))
+            if s.faults > 0:
+                acts.append(self._reroute(
+                    s._replace(faults=s.faults - 1),
+                    "decode leg lost"))
+        if not s.palive and s.phase in ("prefill", "window"):
+            acts.append(("prefill replica restarted",
+                         s._replace(palive=True), ()))
+        return acts
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s: _DisaggState):
+        if s.phase not in ("done", "evicted", "cancelled"):
+            return (Violation(
+                "APX305", "stranded",
+                f"request r0 stranded at quiescence in phase "
+                f"'{s.phase}': no terminal result and no enabled "
+                "recovery action"),)
+        return ()
+
+    def required_events(self) -> Set[str]:
+        req = {"submit r0",
+               "prefill completes; page extracted to the handoff window",
+               "page delivered; decode submitted",
+               "decode completes r0 (done)",
+               "cancel r0 (acknowledged)"}
+        if self.config == "transient":
+            req |= {
+                "prefill replica killed in the handoff window; reroute: "
+                "decode-pool full re-prefill",
+                "arrival verify fails (integrity); reroute: re-prefill "
+                "on the prefill pool",
+                "decode leg lost; reroute: radix hit — decode store "
+                "already holds the page (prefill skipped)"}
+        if self.config == "sticky" and self.bounded:
+            req.add("arrival verify fails (integrity); reroute limit -> "
+                    "evicted (handoff failed after 2 attempts)")
+        return req
+
+
+# ---------------------------------------------------------------------------
+# autopilot: evidence-freeze and the pool-ratio donor guard
+# ---------------------------------------------------------------------------
+
+_CLEAR_SUSTAIN = 2
+
+
+class _EvState(NamedTuple):
+    mode: str
+    clear_ticks: int
+
+
+class AutopilotEvidenceModel:
+    """An overloaded fleet whose metrics window goes dark: the ladder
+    must freeze, not relax on absence of evidence."""
+
+    name = "autopilot"
+    config = "evidence"
+
+    def __init__(self, facts: Dict[str, bool]):
+        self.freezes = facts["evidence_freeze"]
+
+    def initial(self):
+        return _EvState("shedding", 0)
+
+    def actions(self, s: _EvState):
+        acts: List = []
+        if s.mode == "shedding":
+            if self.freezes:
+                acts.append(("tick (metrics blackout; counters frozen)",
+                             s, ()))
+            else:
+                ticks = s.clear_ticks + 1
+                if ticks >= _CLEAR_SUSTAIN:
+                    acts.append((
+                        "tick (metrics blackout) -> relax to normal",
+                        s._replace(mode="normal", clear_ticks=0),
+                        (Violation(
+                            "APX307", "blind-relax",
+                            "the mode ladder relaxed during a metrics "
+                            "blackout: clear-sustain accrued on "
+                            "evidence-free ticks and de-escalated a "
+                            "fleet that is still overloaded (decide() "
+                            "lacks the evidence freeze)",
+                            anchor="evidence_freeze"),)))
+                else:
+                    acts.append(("tick (metrics blackout)",
+                                 s._replace(clear_ticks=ticks), ()))
+            acts.append(("tick (overload evidence; sustain resets)",
+                         s._replace(clear_ticks=0), ()))
+        return acts
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s):
+        return ()
+
+    def required_events(self) -> Set[str]:
+        return set()
+
+
+class _PoolState(NamedTuple):
+    prefill: int
+    decode: int
+
+
+class AutopilotPoolModel:
+    """Sustained prefill pressure: shift_pool must stop at a 1-replica
+    donor, never drain a phase to zero."""
+
+    name = "autopilot"
+    config = "pool"
+
+    def __init__(self, facts: Dict[str, bool]):
+        self.keeps_one = facts["donor_keeps_one"]
+
+    def initial(self):
+        return _PoolState(1, 2)
+
+    def actions(self, s: _PoolState):
+        if self.keeps_one and s.decode <= 1:
+            return [("shift_pool declined (donor at minimum)", s, ())]
+        if s.decode <= 0:
+            return []
+        ns = _PoolState(s.prefill + 1, s.decode - 1)
+        viols: Tuple[Violation, ...] = ()
+        if ns.decode == 0:
+            viols = (Violation(
+                "APX306", "pool-drained",
+                "shift_pool drained the decode pool to zero alive "
+                "replicas: the donor-keeps-one guard is missing from the "
+                "pool-ratio law and the decode phase has no routable "
+                "replica", anchor="donor_keeps_one"),)
+        return [(f"shift_pool to prefill "
+                 f"({ns.prefill}p/{ns.decode}d)", ns, viols)]
+
+    def check(self, s):
+        return ()
+
+    def quiescence(self, s):
+        return ()
+
+    def required_events(self) -> Set[str]:
+        if self.keeps_one:
+            return {"shift_pool to prefill (2p/1d)",
+                    "shift_pool declined (donor at minimum)"}
+        return set()
+
+
+# ---------------------------------------------------------------------------
+# the family runner
+# ---------------------------------------------------------------------------
+
+
+def _models_for(family: str, facts: Dict[str, bool]):
+    if family == "scheduler":
+        return [SchedulerModel(facts)]
+    if family == "replica":
+        return [ReplicaLifecycleModel(facts), ReplicaPoisonModel(facts)]
+    if family == "frontend":
+        return [
+            FrontendAdmissionModel(
+                facts, "displace",
+                reqs=(("s0", "sheddable"), ("g1", "guaranteed"),
+                      ("g2", "guaranteed")),
+                infeasible=frozenset()),
+            FrontendAdmissionModel(
+                facts, "infeasible",
+                reqs=(("s0", "sheddable"), ("g1", "guaranteed")),
+                infeasible=frozenset({"g1"})),
+            FrontendHedgeModel(facts),
+        ]
+    if family == "disagg":
+        return [DisaggHandoffModel(facts, "transient", faults=2,
+                                   sticky=False),
+                DisaggHandoffModel(facts, "sticky", faults=1,
+                                   sticky=True)]
+    if family == "autopilot":
+        return [AutopilotEvidenceModel(facts), AutopilotPoolModel(facts)]
+    raise ValueError(f"unknown protocol family {family!r}")
+
+
+@functools.lru_cache(maxsize=256)
+def run_protocol(family: str,
+                 facts_key: FrozenSet[Tuple[str, bool]]
+                 ) -> Tuple[ProtoFinding, ...]:
+    """Explore every bounded configuration of ``family`` under the
+    extracted ``facts``; memoized so the same parameterization (e.g.
+    every clean file of a family) is explored once per process."""
+    facts = {name: True for name in FAMILY_FACTS[family]}
+    facts.update(dict(facts_key))
+    out: List[ProtoFinding] = []
+    seen_keys: Set[str] = set()
+    for model in _models_for(family, facts):
+        res = explore(model)
+        tag = f"[{family}/{model.config}]"
+        if res.truncated:
+            out.append(ProtoFinding(
+                "APX301", f"budget-{model.config}", "",
+                f"{tag} bounded exploration exceeded the state budget "
+                f"({res.n_states} states): the model configuration no "
+                "longer terminates — shrink the bound or fix the model"))
+            continue
+        for viol, trace in res.violations:
+            if viol.key in seen_keys:
+                continue
+            seen_keys.add(viol.key)
+            out.append(ProtoFinding(
+                viol.code, viol.key, viol.anchor or "",
+                f"{tag} {viol.message}; {render_trace(trace)}"))
+        for ev in sorted(model.required_events() - res.labels):
+            key = f"unreachable-{model.config}-{ev[:40]}"
+            if key in seen_keys:
+                continue
+            seen_keys.add(key)
+            out.append(ProtoFinding(
+                "APX307", key, "",
+                f"{tag} ladder rung '{ev}' is unreachable in the "
+                "bounded exploration: a state the protocol requires has "
+                "no path to it"))
+    return tuple(out)

@@ -265,8 +265,21 @@ class ReplicaSupervisor:
 
     def drain_inflight(self) -> List[Submission]:
         """Remove and return every in-flight submission — the
-        frontend's failover hook once this replica is ``failed``."""
+        frontend's failover hook once this replica is ``failed``.
+
+        An ACKNOWLEDGED cancel pending in the inbox must not be
+        forwarded to the surviving replica: draining its request from
+        ``_inflight`` would resurrect work the caller was told is
+        cancelled (same hazard ``restart`` guards against; found by
+        the APX304 protocol model check)."""
         with self._lock:
+            cancelled = [p for k, p in self._inbox if k == "cancel"]
+            for rid in cancelled:
+                if self._inflight.pop(rid, None) is not None:
+                    self._results[rid] = RequestResult(
+                        req_id=rid, status="cancelled",
+                        tokens=np.zeros((0,), np.int32),
+                        reason="cancelled (pending at failover)")
             subs = sorted(self._inflight.values(), key=lambda s: s.req_id)
             self._inflight.clear()
             self._inbox.clear()

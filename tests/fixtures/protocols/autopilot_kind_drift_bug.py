@@ -1,0 +1,69 @@
+"""Policy/controller version skew (must flag APX308).
+
+The policy emits Action("shift_pool") but the controller _apply()
+only dispatches escalate/deescalate — actuation raises ValueError at
+runtime. Paired with autopilot_golden.py. Parse-only."""
+
+MODES_DOWN = {"degraded": "shedding", "shedding": "normal"}
+
+
+class Action:
+    def __init__(self, kind, params=None):
+        self.kind = kind
+        self.params = params or {}
+
+
+def _has_evidence(window, signal):
+    return signal in window
+
+
+def decide(state, window):
+    if not _has_evidence(window, "fresh"):
+        return []
+    acts = []
+    acts.extend(_escalation(state, window))
+    acts.extend(_relaxation(state, window))
+    return acts
+
+
+def _escalation(state, window):
+    if window.get("overload"):
+        return [Action("escalate", {"to": "shedding"})]
+    if window.get("prefill_pressure"):
+        return [Action("shift_pool", {"n": 1})]
+    return []
+
+
+def _relaxation(state, window):
+    if window.get("clear"):
+        return [Action("deescalate",
+                       {"to": MODES_DOWN.get(state.mode, "normal")})]
+    return []
+
+
+def _pool_ratio(state):
+    if state.decode <= 1:
+        return 0.0
+    return state.prefill / state.decode
+
+
+class AutopilotController:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self.mode = "normal"
+
+    def tick(self, state, window):
+        for act in decide(state, window):
+            self._apply(act)
+
+    def _apply(self, act):
+        if act.kind == "escalate":
+            self.mode = act.params["to"]
+        elif act.kind == "deescalate":
+            self.mode = act.params["to"]
+        else:
+            raise ValueError(act.kind)
+        self.metrics.transition("autopilot", action=act.kind)
+
+    def _shift(self, n):
+        return n

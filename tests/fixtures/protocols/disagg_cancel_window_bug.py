@@ -1,0 +1,59 @@
+"""PRE-fix PR 16 cancel path (must flag APX304).
+
+Both window guards are gone — cancel() purges neither the parked
+handoff window nor the live set, and the window drain never checks
+_live — so an acknowledged cancel's parked page is delivered and the
+request re-admitted to the decode pool. Paired with disagg_golden.py.
+Parse-only."""
+
+
+class DisaggFrontend:
+    def __init__(self, cfg, metrics):
+        self.cfg = cfg
+        self.metrics = metrics
+        self._pending = []
+        self._deferred = []
+        self._live = set()
+        self._attempts = {}
+
+    def _start_handoff(self, rid, page):
+        self.metrics.transition("handoff", req_id=rid)
+        self._pending.append((rid, page))
+
+    def _reroute(self, rid, cause):
+        self._attempts[rid] = self._attempts.get(rid, 0) + 1
+        if self._attempts[rid] > self.cfg.max_handoff_attempts:
+            self.metrics.transition("handoff_failure", req_id=rid,
+                                    failure=cause)
+            return self._evict(rid)
+        self.metrics.transition("handoff_reroute", req_id=rid,
+                                cause=cause)
+        return self._resubmit(rid)
+
+    def _process_pending(self):
+        for rid, page in list(self._pending):
+            self._install(rid, page)
+
+    def _retry_deferred(self):
+        for rid in list(self._deferred):
+            self._resubmit(rid)
+
+    def cancel(self, rid):
+        self._cancelled.add(rid)
+
+    def _check_parity(self, rid, got, want):
+        if got != want:
+            self.metrics.transition("handoff_parity_mismatch",
+                                    req_id=rid)
+
+    def _shift_pool(self, n):
+        self.metrics.transition("pool_shift", n=n)
+
+    def _install(self, rid, page):
+        return rid
+
+    def _resubmit(self, rid):
+        return rid
+
+    def _evict(self, rid):
+        return rid

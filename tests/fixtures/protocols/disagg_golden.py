@@ -1,0 +1,63 @@
+"""Golden disagg-frontend fixture: the post-PR-16 handoff shape.
+
+Carries every handoff guard: the max_handoff_attempts eviction rung on
+the re-route ladder, the _live membership checks on both window-drain
+paths, and the cancel-side window purge. Banks handoff/
+handoff_failure/handoff_reroute/handoff_parity_mismatch/pool_shift.
+Parse-only."""
+
+
+class DisaggFrontend:
+    def __init__(self, cfg, metrics):
+        self.cfg = cfg
+        self.metrics = metrics
+        self._pending = []
+        self._deferred = []
+        self._live = set()
+        self._attempts = {}
+
+    def _start_handoff(self, rid, page):
+        self.metrics.transition("handoff", req_id=rid)
+        self._pending.append((rid, page))
+
+    def _reroute(self, rid, cause):
+        self._attempts[rid] = self._attempts.get(rid, 0) + 1
+        if self._attempts[rid] > self.cfg.max_handoff_attempts:
+            self.metrics.transition("handoff_failure", req_id=rid,
+                                    failure=cause)
+            return self._evict(rid)
+        self.metrics.transition("handoff_reroute", req_id=rid,
+                                cause=cause)
+        return self._resubmit(rid)
+
+    def _process_pending(self):
+        for rid, page in list(self._pending):
+            if rid not in self._live:
+                continue
+            self._install(rid, page)
+
+    def _retry_deferred(self):
+        for rid in list(self._deferred):
+            if rid in self._live:
+                self._resubmit(rid)
+
+    def cancel(self, rid):
+        self._pending = [(r, p) for r, p in self._pending if r != rid]
+        self._live.discard(rid)
+
+    def _check_parity(self, rid, got, want):
+        if got != want:
+            self.metrics.transition("handoff_parity_mismatch",
+                                    req_id=rid)
+
+    def _shift_pool(self, n):
+        self.metrics.transition("pool_shift", n=n)
+
+    def _install(self, rid, page):
+        return rid
+
+    def _resubmit(self, rid):
+        return rid
+
+    def _evict(self, rid):
+        return rid

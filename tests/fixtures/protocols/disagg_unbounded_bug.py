@@ -1,0 +1,58 @@
+"""PRE-fix PR 16 re-route ladder (must flag APX307).
+
+_reroute() has no max_handoff_attempts eviction rung: a persistently
+failing handoff re-routes forever instead of surfacing a typed
+eviction. Paired with disagg_golden.py. Parse-only."""
+
+
+class DisaggFrontend:
+    def __init__(self, cfg, metrics):
+        self.cfg = cfg
+        self.metrics = metrics
+        self._pending = []
+        self._deferred = []
+        self._live = set()
+        self._attempts = {}
+
+    def _start_handoff(self, rid, page):
+        self.metrics.transition("handoff", req_id=rid)
+        self._pending.append((rid, page))
+
+    def _reroute(self, rid, cause):
+        self.metrics.transition("handoff_reroute", req_id=rid,
+                                cause=cause)
+        self.metrics.transition("handoff_failure", req_id=rid,
+                                failure=cause)
+        return self._resubmit(rid)
+
+    def _process_pending(self):
+        for rid, page in list(self._pending):
+            if rid not in self._live:
+                continue
+            self._install(rid, page)
+
+    def _retry_deferred(self):
+        for rid in list(self._deferred):
+            if rid in self._live:
+                self._resubmit(rid)
+
+    def cancel(self, rid):
+        self._pending = [(r, p) for r, p in self._pending if r != rid]
+        self._live.discard(rid)
+
+    def _check_parity(self, rid, got, want):
+        if got != want:
+            self.metrics.transition("handoff_parity_mismatch",
+                                    req_id=rid)
+
+    def _shift_pool(self, n):
+        self.metrics.transition("pool_shift", n=n)
+
+    def _install(self, rid, page):
+        return rid
+
+    def _resubmit(self, rid):
+        return rid
+
+    def _evict(self, rid):
+        return rid

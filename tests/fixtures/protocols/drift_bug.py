@@ -1,0 +1,14 @@
+"""Model-drift fixture (must flag APX301).
+
+A class that still matches the replica-family detection signature
+(restart + drain_inflight) but lost the cancel/_iterate methods the
+protocol model needs: the checker must refuse to silently skip it.
+Parse-only."""
+
+
+class ReplicaSupervisor:
+    def restart(self):
+        return True
+
+    def drain_inflight(self):
+        return []

@@ -1,0 +1,74 @@
+"""PRE-fix PR 7 round-2 admission order (must flag APX306).
+
+submit() displaces a sheddable victim FIRST and only then discovers
+the admission is infeasible: the victim is gone and the freed slot
+admits nothing. Paired with frontend_golden.py. Parse-only."""
+
+
+class ServingFrontend:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self._route = {}
+        self._shed_rids = set()
+        self._subs = {}
+        self._results = {}
+        self._ttft = set()
+        self._legs = None
+
+    def submit(self, req):
+        rep = self._displace_sheddable(req)
+        if rep is None:
+            rep = self._pick_replica(req)
+        if rep is None:
+            return None
+        self._route[req.req_id] = rep
+        return rep
+
+    def _pick_replica(self, req):
+        for rep in self._alive():
+            if rep.load() < rep.capacity:
+                return rep
+        return None
+
+    def _displace_sheddable(self, req):
+        for rid, rep in list(self._route.items()):
+            if rid in self._shed_rids:
+                continue
+            if rep.qos(rid) == "sheddable":
+                self._shed_rids.add(rid)
+                self.metrics.transition("shed", req_id=rid)
+                return rep
+        return None
+
+    def _collect(self, rid):
+        while self._legs.pending(rid):
+            self._legs.wait(rid)
+        return self._results.pop(rid)
+
+    def _failover(self, rep):
+        self.metrics.transition("failover", replica=rep.replica_id)
+        orphans = [rid for rid, r in self._route.items() if r is rep]
+        for rid in orphans:
+            self._resubmit(rid)
+
+    def _hedge_blown_budgets(self, routed):
+        for rid in list(self._subs):
+            if self.first_token_seen(rid):
+                continue
+            for rep in self._alive():
+                if rep.replica_id not in routed:
+                    self.metrics.transition("hedge", req_id=rid)
+                    self._route[rid] = rep
+                    break
+
+    def first_token_seen(self, rid):
+        return rid in self._ttft
+
+    def set_mode(self, mode):
+        self.metrics.transition("mode", mode=mode)
+
+    def _alive(self):
+        return []
+
+    def _resubmit(self, rid):
+        return rid

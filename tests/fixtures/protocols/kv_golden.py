@@ -1,0 +1,29 @@
+"""Golden kv_transfer fixture: verify-before-install (post-PR-16).
+
+install_page() re-digests the page on arrival BEFORE it reaches the
+decode pool's store — a wire corruption is a typed HandoffError, never
+silently-served KV. Paired with kv_noverify_bug.py. Parse-only."""
+
+
+class HandoffError(Exception):
+    def __init__(self, reason):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _digest(page):
+    return sum(page)
+
+
+def extract_page(store, rid):
+    return store.get_prefix(rid)
+
+
+def verify_page(manifest, page):
+    if manifest.sha != _digest(page):
+        raise HandoffError("integrity")
+
+
+def install_page(store, manifest, page):
+    verify_page(manifest, page)
+    store.put_prefix(manifest.rid, page)

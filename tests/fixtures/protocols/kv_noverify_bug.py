@@ -1,0 +1,28 @@
+"""PRE-fix PR 16 install path (must flag APX307).
+
+install_page() trusts the page as extracted: a corruption in the
+handoff window is installed into the decode pool's store and served
+as silently corrupt KV. Paired with kv_golden.py. Parse-only."""
+
+
+class HandoffError(Exception):
+    def __init__(self, reason):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _digest(page):
+    return sum(page)
+
+
+def extract_page(store, rid):
+    return store.get_prefix(rid)
+
+
+def verify_page(manifest, page):
+    if manifest.sha != _digest(page):
+        raise HandoffError("integrity")
+
+
+def install_page(store, manifest, page):
+    store.put_prefix(manifest.rid, page)

@@ -1,0 +1,62 @@
+"""Unfenced publish shape (must flag APX302).
+
+_iterate() lacks the generation fence, so a thread abandoned by a
+kill keeps running and publishes a second terminal result after the
+supervisor restarted. Paired with replica_golden.py. Parse-only."""
+
+
+class ReplicaSupervisor:
+    def __init__(self, cfg, metrics):
+        self.cfg = cfg
+        self.metrics = metrics
+        self.replica_id = 0
+        self.state = "alive"
+        self.generation = 0
+        self.restarts = 0
+        self._inbox = []
+        self._inflight = {}
+        self._results = {}
+        self._kill_counts = {}
+
+    def cancel(self, rid):
+        self._inbox.append(("cancel", rid))
+
+    def mark_dead(self):
+        self.state = "dead"
+        self.metrics.transition("replica_dead", replica=self.replica_id)
+
+    def restart(self):
+        self.restarts += 1
+        if self.restarts > self.cfg.max_restarts:
+            self.state = "failed"
+            self.metrics.transition("replica_failed",
+                                    replica=self.replica_id)
+            return False
+        cancelled = [p for k, p in self._inbox if k == "cancel"]
+        for rid in cancelled:
+            self._inflight.pop(rid, None)
+        for sub in list(self._inflight.values()):
+            kills = self._kill_counts.get(sub.req_id, 0)
+            if kills > self.cfg.poison_threshold:
+                self._inflight.pop(sub.req_id, None)
+        self._inbox.clear()
+        self.generation += 1
+        self.state = "alive"
+        self.metrics.transition("replica_restart",
+                                replica=self.replica_id)
+        return True
+
+    def drain_inflight(self):
+        cancelled = [p for k, p in self._inbox if k == "cancel"]
+        for rid in cancelled:
+            self._inflight.pop(rid, None)
+        subs = sorted(self._inflight.values(), key=lambda s: s.req_id)
+        self._inflight.clear()
+        self._inbox.clear()
+        return subs
+
+    def _iterate(self, gen):
+        return self._step(gen)
+
+    def _step(self, gen):
+        return gen

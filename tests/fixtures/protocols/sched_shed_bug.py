@@ -1,0 +1,39 @@
+"""Golden scheduler fixture: the PRE-fix PR 7 round-1 shed ladder (must flag APX303).
+
+The `<` skips only strictly-stronger entries, so an EQUAL-class
+victim slips through the gate. Paired
+with sched_golden.py. Parse-only."""
+
+
+class QosScheduler:
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self._queue = []
+
+    def _pick_shed_victim_locked(self, incoming_rank):
+        victim = None
+        for r in self._queue:
+            if r.rank < incoming_rank:
+                continue
+            if victim is None or (r.rank, r.arrival) > (
+                    victim.rank, victim.arrival):
+                victim = r
+        return victim
+
+    def submit(self, req):
+        if len(self._queue) < self.capacity:
+            self._queue.append(req)
+            return True
+        victim = self._pick_shed_victim_locked(req.rank)
+        if victim is None:
+            return False
+        self._queue.remove(victim)
+        self._queue.append(req)
+        return True
+
+    def pop(self):
+        if not self._queue:
+            return None
+        best = min(self._queue, key=lambda r: (r.rank, r.arrival))
+        self._queue.remove(best)
+        return best

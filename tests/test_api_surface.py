@@ -147,6 +147,8 @@ SURFACE = {
         "RULES", "RULE_SLUGS"],
     "apex1_tpu.lint.kernels": [
         "check_kernels", "KERNEL_RULES", "KernelRule"],
+    "apex1_tpu.lint.protocols": [
+        "check_protocols", "PROTOCOL_RULES", "ProtocolRule"],
     "apex1_tpu.vmem_model": [
         "CHECKS", "budget_bytes", "flash_check", "row_check",
         "linear_xent_check", "cm_check", "agf_check", "int8_check",

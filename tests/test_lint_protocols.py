@@ -1,0 +1,486 @@
+"""graftlint APX3xx suite — the serving-protocol model checker.
+
+The acceptance spine (ISSUE 17): every shipped PR 7/PR 16 review-fix
+race, re-introduced into the committed fixture corpus under
+tests/fixtures/protocols/, MUST be flagged with its rule id AND a
+state-trace counterexample naming the interleaving; the golden
+(post-fix) variants and the live serving/autopilot tree MUST pass
+clean. The fact-flip matrix pins every single-guard regression to the
+rule it produces, and the two-tier lint cache (whole-run memo +
+per-file parse memo) is pinned by behavioral tests.
+
+Fixtures are PARSE-ONLY: they run in memory through
+``lint_sources(protocols=True)`` — the very same extractors that check
+the live tree — and are never imported.
+"""
+
+import json
+import os
+import pickle
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from apex1_tpu.lint import lint_files, lint_paths, lint_sources
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "protocols")
+
+_TRACE = re.compile(r"counterexample \(\d+ steps\): .+ -> ")
+
+
+def fixture(name):
+    with open(os.path.join(FIXDIR, name), encoding="utf-8") as fh:
+        return fh.read()
+
+
+def run_fixture(name, **kw):
+    return lint_sources({f"fix/{name}": (f"fix.{name[:-3]}",
+                                         fixture(name))},
+                        protocols=True, **kw)
+
+
+def run_lint(src, path="fix/mod.py", modname="fix.mod"):
+    return lint_sources({path: (modname, textwrap.dedent(src))},
+                        protocols=True)
+
+
+def codes(res, *, suppressed=False):
+    pool = res.suppressed() if suppressed else res.unsuppressed()
+    return {f.rule for f in pool}
+
+
+GOLDEN = ["sched_golden.py", "replica_golden.py", "frontend_golden.py",
+          "disagg_golden.py", "kv_golden.py", "autopilot_golden.py"]
+
+#: (fixture, must-flag rule, message fragment, trace expected?)
+MUST_FLAG = [
+    ("sched_shed_bug.py", "APX303", "not strictly weaker", True),
+    ("replica_restart_resurrect_bug.py", "APX304",
+     "restart() resubmitted r0 while its cancel was pending", True),
+    ("replica_drain_resurrect_bug.py", "APX304",
+     "drain_inflight() forwarded r0 with its cancel still pending",
+     True),
+    ("replica_unfenced_bug.py", "APX302",
+     "two terminal results published for r0", True),
+    ("frontend_displace_first_bug.py", "APX306",
+     "feasibility must be checked before displacement", True),
+    ("frontend_hedge_streaming_bug.py", "APX306",
+     "already streaming", True),
+    ("frontend_hedge_routed_bug.py", "APX302",
+     "hedge fired onto replica B", True),
+    ("frontend_failover_bug.py", "APX302",
+     "failover resubmitted g0", True),
+    ("frontend_route_strand_bug.py", "APX305",
+     "late result for g0 is stranded", True),
+    ("frontend_unbanked_bug.py", "APX308",
+     "'mode' is never banked", False),
+    ("disagg_cancel_window_bug.py", "APX304",
+     "resurrected from the handoff window", True),
+    ("disagg_unbounded_bug.py", "APX307",
+     "re-route ladder never terminates", True),
+    ("kv_noverify_bug.py", "APX307",
+     "installed without the arrival re-digest", True),
+    ("autopilot_blind_relax_bug.py", "APX307",
+     "relaxed during a metrics blackout", True),
+    ("autopilot_kind_drift_bug.py", "APX308",
+     "Action kind 'shift_pool'", False),
+    ("autopilot_ladder_bug.py", "APX307",
+     "no MODES_DOWN edge", False),
+    ("drift_bug.py", "APX301", "required method", False),
+]
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("name", GOLDEN)
+    def test_golden_fixtures_lint_clean(self, name):
+        res = run_fixture(name)
+        assert res.ok, [f.render() for f in res.unsuppressed()]
+
+    @pytest.mark.parametrize("name,rule,frag,traced", MUST_FLAG,
+                             ids=[m[0] for m in MUST_FLAG])
+    def test_must_flag_with_counterexample(self, name, rule, frag,
+                                           traced):
+        res = run_fixture(name)
+        hits = [f for f in res.unsuppressed()
+                if f.rule == rule and frag in f.message]
+        assert hits, (rule, frag,
+                      [f.render() for f in res.unsuppressed()])
+        if traced:
+            assert _TRACE.search(hits[0].message), hits[0].message
+        # every finding is anchored to a real line of the fixture
+        n_lines = fixture(name).count("\n") + 1
+        for f in res.unsuppressed():
+            assert 1 <= f.line <= n_lines, f.render()
+
+    def test_fixture_corpus_is_exhaustive(self):
+        """Every .py file in the corpus is either golden or must-flag —
+        a fixture added without a pin here is a test hole."""
+        on_disk = {f for f in os.listdir(FIXDIR) if f.endswith(".py")}
+        pinned = set(GOLDEN) | {m[0] for m in MUST_FLAG}
+        assert on_disk == pinned, on_disk ^ pinned
+
+    def test_without_protocols_flag_fixtures_pass(self):
+        src = fixture("replica_drain_resurrect_bug.py")
+        res = lint_sources({"fix/m.py": ("fix.m", src)})
+        assert not {c for c in codes(res) if c.startswith("APX3")}
+
+    def test_suppression_grammar_covers_apx3xx(self):
+        src = fixture("sched_shed_bug.py").replace(
+            "if r.rank < incoming_rank:",
+            "if r.rank < incoming_rank:  "
+            "# graftlint: allow(APX303) -- fixture: pre-fix shape kept "
+            "on purpose")
+        res = lint_sources({"fix/m.py": ("fix.m", src)}, protocols=True)
+        assert res.ok, [f.render() for f in res.unsuppressed()]
+        assert "APX303" in codes(res, suppressed=True)
+
+
+class TestFactFlipMatrix:
+    """models.py unit surface: all-true facts explore clean; every
+    single-guard flip produces exactly the rule the ladder documents."""
+
+    FLIPS = [
+        ("scheduler", "shed_strictly_weaker", {"APX303"}),
+        ("replica", "restart_honors_pending_cancels", {"APX304"}),
+        ("replica", "drain_honors_pending_cancels", {"APX304"}),
+        ("replica", "generation_fenced", {"APX302"}),
+        ("replica", "restart_quarantines_poison", {"APX307"}),
+        ("frontend", "feasibility_before_displacement", {"APX306"}),
+        ("frontend", "displace_skips_already_shed", {"APX306"}),
+        ("frontend", "route_waits_for_pending_legs",
+         {"APX305", "APX307"}),
+        ("frontend", "hedge_requires_no_first_token", {"APX306"}),
+        ("frontend", "hedge_excludes_routed", {"APX302"}),
+        ("frontend", "failover_skips_live_hedge", {"APX302"}),
+        ("disagg", "reroute_bounded", {"APX307"}),
+        ("disagg", "verify_before_install", {"APX307"}),
+        ("autopilot", "evidence_freeze", {"APX307"}),
+        ("autopilot", "donor_keeps_one", {"APX306"}),
+    ]
+
+    def test_all_true_explores_clean(self):
+        from apex1_tpu.lint.protocols.models import (FAMILY_FACTS,
+                                                     run_protocol)
+        for family in FAMILY_FACTS:
+            assert run_protocol(family, frozenset()) == ()
+
+    @pytest.mark.parametrize("family,fact,expected", FLIPS,
+                             ids=[f"{f[0]}-{f[1]}" for f in FLIPS])
+    def test_single_flip_produces_documented_rule(self, family, fact,
+                                                  expected):
+        from apex1_tpu.lint.protocols.models import run_protocol
+        out = run_protocol(family, frozenset([(fact, False)]))
+        assert {p.code for p in out} == expected, \
+            [(p.code, p.key) for p in out]
+
+    def test_window_guards_are_defense_in_depth(self):
+        """Neither window guard alone resurrects a cancel — the purge
+        and the _live check each cover the other — but dropping BOTH
+        reaches the APX304 resurrection. Pins why
+        disagg_cancel_window_bug.py removes the pair."""
+        from apex1_tpu.lint.protocols.models import run_protocol
+        for fact in ("pending_checks_live", "cancel_purges_window"):
+            assert run_protocol("disagg",
+                                frozenset([(fact, False)])) == ()
+        out = run_protocol("disagg",
+                           frozenset([("pending_checks_live", False),
+                                      ("cancel_purges_window", False)]))
+        assert "APX304" in {p.code for p in out}
+
+    def test_explorer_truncation_is_loud(self):
+        """A model that never quiesces blows the state budget and is
+        reported, never silently dropped."""
+        from apex1_tpu.lint.protocols.explore import explore
+
+        class Runaway:
+            name, config = "runaway", "loop"
+
+            def initial(self):
+                return 0
+
+            def actions(self, s):
+                return [(f"tick {s}", s + 1, ())]
+
+            def check(self, s):
+                return ()
+
+            def quiescence(self, s):
+                return ()
+
+        res = explore(Runaway(), max_states=500)
+        assert res.truncated
+        assert res.n_states >= 500
+
+
+class TestRepoSelfCheck:
+    def test_live_tree_protocols_clean(self):
+        res = lint_paths(["apex1_tpu", "tools", "examples"], root=REPO,
+                         protocols=True)
+        apx3 = [f for f in res.unsuppressed()
+                if f.rule.startswith("APX3")]
+        assert not apx3, [f.render() for f in apx3]
+        assert res.n_files > 160
+
+    def test_live_families_all_extracted(self):
+        """The extractors must keep matching the real classes — a
+        rename that breaks detection would silently skip the family."""
+        from apex1_tpu.lint import collect_files, module_name_for
+        from apex1_tpu.lint.core import parse_module
+        from apex1_tpu.lint.protocols.extract import extract_all
+        fams = set()
+        for f in collect_files(["apex1_tpu"], root=REPO):
+            rel = os.path.relpath(f, REPO)
+            mod = parse_module(rel, open(f, encoding="utf-8").read(),
+                               module_name_for(f, REPO))
+            for ex in extract_all(mod):
+                fams.add((ex.family, ex.name))
+                assert not ex.missing, (ex.family, ex.name, ex.missing)
+                for fact, val in ex.facts.items():
+                    assert val is True, (ex.family, ex.name, fact)
+        assert ("scheduler", "Scheduler") in fams
+        assert ("replica", "ReplicaSupervisor") in fams
+        assert ("frontend", "ServingFrontend") in fams
+        assert ("disagg", "DisaggFrontend") in fams
+        assert ("kv", "<module>") in fams
+        assert ("policy", "<module>") in fams
+        assert ("controller", "Autopilot") in fams
+
+    def test_protocol_rules_registered(self):
+        from apex1_tpu.lint.core import RULE_SLUGS
+        from apex1_tpu.lint.protocols import PROTOCOL_RULES
+        assert [r.code for r in PROTOCOL_RULES] == [
+            "APX301", "APX302", "APX303", "APX304", "APX305",
+            "APX306", "APX307", "APX308"]
+        for r in PROTOCOL_RULES:
+            assert RULE_SLUGS[r.code] == r.slug
+
+    def test_baseline_banked_with_protocol_family(self):
+        path = os.path.join(REPO, "perf_results", "lint_baseline.json")
+        doc = json.load(open(path))
+        assert doc["ok"] is True
+        assert doc["counts"]["unsuppressed"] == 0
+        assert "APX304" in doc["rules"], \
+            "re-bank with `python tools/lint.py --kernels --protocols" \
+            " --json`"
+
+
+class TestLintCache:
+    """The two-tier .graftlint_cache: whole-run memo + parse memo."""
+
+    def _write(self, d, name, src):
+        p = d / name
+        p.write_text(src)
+        return str(p)
+
+    def test_memo_hit_skips_parsing_and_keeps_findings(
+            self, tmp_path, monkeypatch):
+        f = self._write(tmp_path, "bug.py",
+                        fixture("sched_shed_bug.py"))
+        cache = str(tmp_path / "cache")
+        first = lint_files([f], root=str(tmp_path), protocols=True,
+                           cache=cache)
+        assert "APX303" in codes(first)
+        import apex1_tpu.lint as lintmod
+
+        def boom(*a, **kw):
+            raise AssertionError("memo miss: parse_module was called")
+
+        monkeypatch.setattr(lintmod, "parse_module", boom)
+        second = lint_files([f], root=str(tmp_path), protocols=True,
+                            cache=cache)
+        assert codes(second) == codes(first)
+        assert [x.render() for x in second.findings] == \
+            [x.render() for x in first.findings]
+
+    def test_changed_file_invalidates_run_memo(self, tmp_path):
+        f = self._write(tmp_path, "bug.py",
+                        fixture("sched_shed_bug.py"))
+        cache = str(tmp_path / "cache")
+        assert "APX303" in codes(
+            lint_files([f], root=str(tmp_path), protocols=True,
+                       cache=cache))
+        self._write(tmp_path, "bug.py", fixture("sched_golden.py"))
+        res = lint_files([f], root=str(tmp_path), protocols=True,
+                         cache=cache)
+        assert res.ok, [x.render() for x in res.unsuppressed()]
+
+    def test_parse_tier_reparses_only_the_changed_file(
+            self, tmp_path, monkeypatch):
+        fa = self._write(tmp_path, "a.py", fixture("sched_golden.py"))
+        fb = self._write(tmp_path, "b.py",
+                         fixture("replica_golden.py"))
+        cache = str(tmp_path / "cache")
+        lint_files([fa, fb], root=str(tmp_path), protocols=True,
+                   cache=cache)
+        import apex1_tpu.lint as lintmod
+        real = lintmod.parse_module
+        parsed = []
+
+        def spy(path, text, modname=""):
+            parsed.append(path)
+            return real(path, text, modname)
+
+        monkeypatch.setattr(lintmod, "parse_module", spy)
+        self._write(tmp_path, "b.py",
+                    fixture("replica_golden.py") + "\n# touched\n")
+        res = lint_files([fa, fb], root=str(tmp_path), protocols=True,
+                         cache=cache)
+        assert res.ok
+        assert parsed == ["b.py"], parsed
+
+    def test_run_memo_keyed_by_flags(self, tmp_path):
+        f = self._write(tmp_path, "bug.py",
+                        fixture("sched_shed_bug.py"))
+        cache = str(tmp_path / "cache")
+        plain = lint_files([f], root=str(tmp_path), cache=cache)
+        assert plain.ok
+        with_protocols = lint_files([f], root=str(tmp_path),
+                                    protocols=True, cache=cache)
+        assert "APX303" in codes(with_protocols)
+
+    def test_corrupt_cache_fails_open(self, tmp_path):
+        f = self._write(tmp_path, "bug.py",
+                        fixture("sched_shed_bug.py"))
+        cache = tmp_path / "cache"
+        for payload in (b"", b"not a pickle",
+                        pickle.dumps({"version": -1, "runs": {},
+                                      "entries_blob": None}),
+                        pickle.dumps(["wrong", "shape"])):
+            cache.write_bytes(payload)
+            res = lint_files([str(f)], root=str(tmp_path),
+                             protocols=True, cache=str(cache))
+            assert "APX303" in codes(res)
+
+    def test_suppression_state_resets_on_parse_cache_hit(
+            self, tmp_path):
+        """A cache-hit module must start the run pristine: its
+        suppression `used` bits are per-run state."""
+        src = fixture("sched_shed_bug.py").replace(
+            "if r.rank < incoming_rank:",
+            "if r.rank < incoming_rank:  "
+            "# graftlint: allow(APX303) -- fixture: kept on purpose")
+        fa = self._write(tmp_path, "a.py", src)
+        cache = str(tmp_path / "cache")
+        first = lint_files([fa], root=str(tmp_path), protocols=True,
+                           cache=cache)
+        assert first.ok and not first.unused
+        # invalidate only the RUN memo (flag flip) so the parse-tier
+        # entry is reused for a fresh apply_suppressions pass
+        second = lint_files([fa], root=str(tmp_path), protocols=True,
+                            kernels=True, cache=cache)
+        assert second.ok, [x.render() for x in second.unsuppressed()]
+        assert not second.unused
+        assert "APX303" in codes(second, suppressed=True)
+
+
+class TestChangedMergeBase:
+    def _git(self, cwd, *args):
+        return subprocess.run(["git", *args], cwd=cwd,
+                              capture_output=True, text=True,
+                              check=True)
+
+    def _load_cli(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lint_cli_under_test",
+            os.path.join(REPO, "tools", "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_changed_diffs_against_merge_base(self, tmp_path,
+                                              monkeypatch):
+        """The pre-commit scope must include commits already on the
+        branch — the old vs-HEAD diff silently skipped them."""
+        repo = tmp_path / "r"
+        (repo / "apex1_tpu").mkdir(parents=True)
+        self._git(tmp_path, "init", "-b", "main", "r")
+        self._git(repo, "config", "user.email", "t@example.com")
+        self._git(repo, "config", "user.name", "t")
+        (repo / "apex1_tpu" / "base.py").write_text("BASE = 1\n")
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-m", "base")
+        self._git(repo, "checkout", "-b", "feature")
+        (repo / "apex1_tpu" / "committed.py").write_text("X = 1\n")
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-m", "feature change")
+        (repo / "apex1_tpu" / "untracked.py").write_text("Y = 1\n")
+        cli = self._load_cli()
+        monkeypatch.setattr(cli, "REPO", str(repo))
+        base = cli.merge_base()
+        head = self._git(repo, "rev-parse",
+                         "main").stdout.strip()
+        assert base == head
+        assert cli.changed_files() == ["apex1_tpu/committed.py",
+                                       "apex1_tpu/untracked.py"]
+
+    def test_merge_base_falls_back_to_head(self, tmp_path,
+                                           monkeypatch):
+        """Detached/remoteless repos with no base ref keep the old
+        vs-HEAD behavior rather than erroring."""
+        repo = tmp_path / "r"
+        (repo / "apex1_tpu").mkdir(parents=True)
+        self._git(tmp_path, "init", "-b", "work", "r")
+        self._git(repo, "config", "user.email", "t@example.com")
+        self._git(repo, "config", "user.name", "t")
+        (repo / "apex1_tpu" / "base.py").write_text("BASE = 1\n")
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-m", "base")
+        cli = self._load_cli()
+        monkeypatch.setattr(cli, "REPO", str(repo))
+        assert cli.merge_base() == "HEAD"
+
+
+class TestCliProtocols:
+    def _run(self, *args, env_extra=None):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               **(env_extra or {})}
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+             *args],
+            capture_output=True, text=True, cwd=REPO, env=env)
+
+    def test_protocols_flag_finds_fixture_race(self, tmp_path):
+        d = tmp_path / "apex1_tpu"
+        d.mkdir()
+        (d / "bug.py").write_text(
+            fixture("replica_drain_resurrect_bug.py"))
+        p = self._run("--protocols", "--no-cache", str(d))
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "APX304" in p.stdout
+        assert "counterexample" in p.stdout
+
+    def test_protocols_flag_clean_on_golden(self, tmp_path):
+        d = tmp_path / "apex1_tpu"
+        d.mkdir()
+        (d / "ok.py").write_text(fixture("replica_golden.py"))
+        p = self._run("--protocols", "--no-cache", str(d))
+        assert p.returncode == 0, p.stdout + p.stderr
+
+    def test_list_rules_includes_family(self):
+        p = self._run("--list-rules")
+        assert p.returncode == 0
+        for code in ("APX301", "APX304", "APX308"):
+            assert code in p.stdout
+
+    def test_cli_protocols_path_is_jax_free(self, tmp_path):
+        """The check_all step's cold-start contract: the --protocols
+        CLI never imports jax. Poison jax on the path — the model
+        checker must still run and still find the fixture race."""
+        poison = tmp_path / "site"
+        poison.mkdir()
+        (poison / "jax.py").write_text(
+            "raise ImportError('poisoned: the lint CLI must stay "
+            "jax-free')\n")
+        d = tmp_path / "apex1_tpu"
+        d.mkdir()
+        (d / "bug.py").write_text(fixture("sched_shed_bug.py"))
+        p = self._run("--protocols", "--no-cache", str(d),
+                      env_extra={"PYTHONPATH": str(poison)})
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "poisoned" not in p.stderr
+        assert "APX303" in p.stdout

@@ -396,6 +396,63 @@ class TestReviewRegressions:
         res = sup.poll(dead)
         assert res is not None and res.status == "cancelled", res
 
+    def test_cancel_pending_at_failover_is_not_resurrected(self, toy,
+                                                           rng):
+        """The drain-side sibling of the restart regression, found by
+        the APX304 protocol model check (`apex1_tpu.lint.protocols`):
+        an acknowledged cancel in the inbox when the replica fails
+        must not be forwarded to the survivor by `drain_inflight` —
+        the caller was already told the work is cancelled."""
+        from apex1_tpu.serving import ReplicaSupervisor
+        make_engine = _make_engine_factory(toy)
+        sup = ReplicaSupervisor(make_engine, 0,
+                                config=ReplicaConfig(watchdog_s=60.0))
+        keep = sup.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=6)
+        dead = sup.submit(rng.integers(0, VOCAB, (4,)),
+                          max_new_tokens=20)
+        sup.pump(2)
+        sup.cancel(dead)                   # acknowledged: in the inbox
+        sup._mark_dead(RuntimeError("chaos"))
+        subs = sup.drain_inflight()
+        assert [s.req_id for s in subs] == [keep]
+        res = sup.poll(dead)
+        assert res is not None and res.status == "cancelled", res
+        assert "failover" in res.reason
+
+    def test_failover_never_resurrects_a_cancelled_request(self, toy,
+                                                           rng):
+        """End to end: cancel acknowledged on a replica that then
+        fails its restart budget — the failover reroute must exclude
+        the cancelled id (a "done" result for it would be resurrected
+        work) while every survivor still comes out token-identical."""
+        make_engine = _make_engine_factory(toy)
+        front = ServingFrontend(
+            make_engine,
+            FrontendConfig(n_replicas=2, capacity_per_replica=8,
+                           hedge_after_s=None,
+                           replica=ReplicaConfig(watchdog_s=60.0,
+                                                 max_restarts=0)))
+        rids = _submit_mix(front, rng, 6, new=12)
+        front.pump(2)                      # all admitted + decoding
+        rep = front.replicas[0]
+        victim = sorted(rep._inflight)[0]
+        assert front.cancel(victim)        # acked, sits in the inbox
+        rep._mark_dead(RuntimeError("chaos"))
+        front.run_until_drained(timeout_s=60.0)
+        assert front.replica_states() == ["failed", "alive"]
+        res = front.poll(victim)
+        assert res is not None and res.status == "cancelled", res
+        fo = [t for t in front.metrics.transitions
+              if t["event"] == "failover"]
+        assert len(fo) == 1 and victim not in fo[0]["rerouted"]
+        others = [r for r in rids if r != victim]
+        want = _reference(make_engine, front, others)
+        for rid in others:
+            r = front.poll(rid)
+            assert r is not None and r.status == "done", (rid, r)
+            np.testing.assert_array_equal(r.tokens, want[rid])
+
     def test_infeasible_guaranteed_does_not_displace_sheddable(
             self, toy, rng):
         """Feasibility is checked BEFORE displacement: a guaranteed

@@ -20,6 +20,8 @@ echo "== graftlint kernels (APX1xx + APX2xx: JAX hazards, Pallas semaphore/DMA p
 # --kernels is a strict superset of the plain run (all APX1xx rules +
 # the kernel analyzer), so ONE step gates both families
 python tools/lint.py --kernels
+echo "== graftlint protocols (APX3xx: bounded exhaustive model check of the scheduler/replica/frontend/disagg/autopilot protocols, every interleaving of every bounded config; jax-free, <15s budget; docs/lint.md) =="
+python tools/lint.py --protocols
 echo "== tuning tables (parse + per-capability VMEM-budget validity) =="
 python tools/tune_kernels.py --validate
 echo "== drift gate (calibrated_ratio bands + re-fit drift over the banked perf_results corpus; jax-free, fail-closed) =="
