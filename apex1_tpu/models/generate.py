@@ -27,6 +27,13 @@ import numpy as np
 
 from apex1_tpu.ops import NEG_INF
 from apex1_tpu.ops.attention import flash_attention
+# the decode-attention composite and the sampling pipeline are owned by
+# ops.paged_decode so the paged serving path and this dense reference
+# path share ONE implementation (token parity is structural, not tested
+# into existence); re-exported here as the documented public surface
+from apex1_tpu.ops.paged_decode import (PagedCache,  # noqa: F401
+                                        _temperature_top_k, cache_attend,
+                                        paged_update_attend, sample_token)
 
 
 def init_cache(num_layers: int, batch: int, num_kv_heads: int,
@@ -83,6 +90,18 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
     """
     B, Hq, S, D = q.shape
     Hkv = k_new.shape[1]
+    if isinstance(cache, PagedCache):
+        # paged serving tier: K/V live in a shared page pool addressed
+        # through the entry's block table; bias/segment_ids/valid_start
+        # have no paged consumers (serving prompts are right-padded)
+        if (bias is not None or segment_ids is not None
+                or valid_start is not None):
+            raise ValueError(
+                "PagedCache attention does not support bias/"
+                "segment_ids/valid_start")
+        return paged_update_attend(q, k_new, v_new, cache, cache_index,
+                                   sm_scale=sm_scale,
+                                   chunk_decode=chunk_decode)
     idx = jnp.asarray(cache_index, jnp.int32)
     k_all = jax.lax.dynamic_update_slice(
         cache["k"], k_new.astype(cache["k"].dtype), (0, 0, idx, 0))
@@ -106,33 +125,9 @@ def cached_attention(q, k_new, v_new, cache, cache_index, *,
                                sm_scale=sm_scale, bias=bias,
                                segment_ids=segment_ids)
         return attn, new_entry
-    scale = (D ** -0.5) if sm_scale is None else sm_scale
-    # GQA without materializing a repeated cache: group the q heads onto
-    # the kv-head axis and contract against the cache as-is (a repeated
-    # (B, Hq, S_max, D) copy would multiply the decode loop's memory
-    # traffic by the group factor)
-    group = Hq // Hkv
-    qg = q.reshape(B, Hkv, group, S, D)
-    scores = jnp.einsum("bhgsd,bhkd->bhgsk", qg, k_all,
-                        preferred_element_type=jnp.float32) * scale
-    if bias is None:
-        scores_b = scores
-    else:
-        scores_b = scores + bias.astype(jnp.float32).reshape(
-            bias.shape[0], Hkv, group, S, -1)
-    S_max = k_all.shape[2]
-    pos = jnp.arange(S_max)
-    # per-query horizon: query j sees cache slots <= idx + j (S == 1
-    # decode reduces to pos <= idx)
-    keep = (pos[None, None, None, None, :]
-            <= idx + jnp.arange(S)[None, None, None, :, None])
-    if valid_start is not None:
-        keep = keep & (pos[None, None, None, None, :]
-                       >= valid_start.reshape(B, 1, 1, 1, 1))
-    scores_b = jnp.where(keep, scores_b, NEG_INF)
-    probs = jax.nn.softmax(scores_b, axis=-1).astype(q.dtype)
-    attn = jnp.einsum("bhgsk,bhkd->bhgsd", probs, v_all)
-    return attn.reshape(B, Hq, S, D), new_entry
+    attn = cache_attend(q, k_all, v_all, idx, sm_scale=sm_scale,
+                        bias=bias, valid_start=valid_start)
+    return attn, new_entry
 
 
 def last_real_logits(logits, lengths):
@@ -144,43 +139,6 @@ def last_real_logits(logits, lengths):
     serves every prompt length without re-jitting per call."""
     idx = (jnp.asarray(lengths, jnp.int32) - 1).reshape(-1, 1, 1)
     return jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-
-
-def sample_token(logits, rng, *, temperature: float = 0.0,
-                 top_k: Optional[int] = None,
-                 vocab_size: Optional[int] = None):
-    """One sampling step from (B, V) logits. ``temperature == 0`` =
-    greedy argmax; otherwise softmax sampling, optionally truncated to the
-    ``top_k`` highest-probability tokens. ``vocab_size`` masks padded
-    vocab tail (GPT-2's padded_vocab)."""
-    logits = logits.astype(jnp.float32)
-    if vocab_size is not None and vocab_size < logits.shape[-1]:
-        mask = jnp.arange(logits.shape[-1]) < vocab_size
-        logits = jnp.where(mask, logits, NEG_INF)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = _temperature_top_k(logits, temperature, top_k, vocab_size)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
-
-
-def _temperature_top_k(logits, temperature, top_k, vocab_size):
-    """Shared temperature + top-k masking over (..., V) fp32 logits
-    (the padded-vocab tail must already be NEG_INF-masked)."""
-    logits = logits / temperature
-    if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        # clamp to the VALID vocab: a larger top_k would (a) raise an
-        # opaque trace-time IndexError past the full width and (b) pick
-        # a NEG_INF masked-tail entry as the kth threshold, silently
-        # disabling truncation (ADVICE r3)
-        eff_v = logits.shape[-1]
-        if vocab_size is not None and vocab_size < eff_v:
-            eff_v = vocab_size
-        k = min(int(top_k), eff_v)
-        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
-        logits = jnp.where(logits >= kth, logits, NEG_INF)
-    return logits
 
 
 def generate(apply_fn: Callable, params, prompt_tokens, *,

@@ -42,7 +42,8 @@ from apex1_tpu.serving.engine import (Engine, EngineConfig,  # noqa: F401
 from apex1_tpu.serving.frontend import (DegradeProfile,  # noqa: F401
                                         FrontendConfig,
                                         ServingFrontend)
-from apex1_tpu.serving.kv_pool import (KVPool, PrefixPage,  # noqa: F401
+from apex1_tpu.serving.kv_pool import (KVPool, PagedKVPool,  # noqa: F401
+                                       PagedPrefix, PrefixPage,
                                        RadixIndex)
 from apex1_tpu.serving.metrics import (RequestRecord,  # noqa: F401
                                        ServingMetrics)

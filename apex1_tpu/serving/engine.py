@@ -110,8 +110,11 @@ import numpy as np
 
 from apex1_tpu.models.generate import (counter_sample, last_real_logits,
                                        sample_token)
+from apex1_tpu.ops._common import use_pallas
+from apex1_tpu.ops.paged_decode import (PagedCache, fused_sample,
+                                        gather_pages, scatter_pages)
 from apex1_tpu.resilience.retry import _mix32
-from apex1_tpu.serving.kv_pool import KVPool
+from apex1_tpu.serving.kv_pool import KVPool, PagedKVPool
 from apex1_tpu.serving.metrics import ServingMetrics
 from apex1_tpu.serving.scheduler import Backpressure, Request, Scheduler
 from apex1_tpu.serving.spec import ngram_propose
@@ -157,6 +160,16 @@ class EngineConfig:
     # for the same HBM; perf_model.kv_cache_bytes is the sizing model).
     # The Engine(cache_dtype=) kwarg still overrides (degraded-mode
     # restarts use it); None = the decoder's compute dtype.
+    paged: bool = False          # route decode/verify through the paged
+    # KV pool (`ops.paged_decode`): block-table page addressing, prefix
+    # pages shared by REFERENCE (no copy-on-admit), the Pallas ragged
+    # kernel + fused sampling epilogue on TPU. False keeps the dense
+    # XLA-composed path — the parity reference (the paged CPU proxy is
+    # pinned token-identical to it in tier-1).
+    page_size: Optional[int] = None  # KV positions per page. None
+    # resolves tuning-table winner > ceil8(prefill_chunk) heuristic;
+    # the Pallas kernel path requires a multiple of 8 (sublane tiling)
+    # and `check_paged_geometry` fails loudly otherwise.
 
     def __post_init__(self):
         if self.prefill_chunk < 1:
@@ -170,6 +183,9 @@ class EngineConfig:
             raise ValueError(f"max_ngram must be >= 1, got {self.max_ngram}")
         if self.max_prefix_pages < 1:
             raise ValueError("max_prefix_pages must be >= 1")
+        if self.page_size is not None and self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}")
 
 
 @dataclasses.dataclass
@@ -240,9 +256,25 @@ class Engine:
         if cache_dtype is None:
             cache_dtype = cfg.cache_dtype    # kwarg (degraded-mode
         #                                      restarts) beats config
-        self.kv = KVPool(make_cache, cfg.max_slots, cfg.max_len + slack,
-                         dtype=cache_dtype,
-                         max_pages=cfg.max_prefix_pages)
+        self._paged = bool(cfg.paged)
+        if self._paged:
+            self.kv = PagedKVPool(
+                make_cache, cfg.max_slots, cfg.max_len + slack,
+                page_size=self._resolve_page_size(make_cache,
+                                                  cache_dtype),
+                dtype=cache_dtype, max_pages=cfg.max_prefix_pages)
+            # device mirror of the host block tables, patched at
+            # admission/retire boundaries only (like the control
+            # vectors below) — the steady-state decode chain feeds it
+            # back without host traffic. Freed rows reset to the trash
+            # page so an inactive lane's masked-garbage scatter can
+            # never land on a page a NEW request now owns.
+            self._d_bt = jnp.zeros(
+                (cfg.max_slots, self.kv.pages_per_lane), jnp.int32)
+        else:
+            self.kv = KVPool(make_cache, cfg.max_slots,
+                             cfg.max_len + slack, dtype=cache_dtype,
+                             max_pages=cfg.max_prefix_pages)
         self.scheduler = Scheduler(max_queue=cfg.max_queue,
                                    policy=cfg.policy)
         self.metrics = ServingMetrics(metrics_logger)
@@ -289,9 +321,37 @@ class Engine:
         self._probe_cache_ver = -1
         self._build_executables()
 
+    def _resolve_page_size(self, make_cache, cache_dtype) -> int:
+        """Page-size precedence: explicit config > tuning-table winner
+        (keyed on the decoder's padded head dim at the S=1 decode row
+        class) > chunk-width heuristic (sublane-aligned, and one
+        prefill chunk never spans more than two pages)."""
+        cfg = self.cfg
+        if cfg.page_size is not None:
+            return int(cfg.page_size)
+        from apex1_tpu import tuning
+        kw = {} if cache_dtype is None else {"dtype": cache_dtype}
+        probe = jax.tree_util.tree_leaves(make_cache(1, 1, **kw))[0]
+        tuned = tuning.lookup(
+            "paged_decode",
+            {"Dp": tuning.padded_lanes(probe.shape[-1]), "Rq": 8},
+            probe.dtype)
+        if tuned is not None:
+            return int(tuned["page_p"])
+        return max(8, -(-cfg.prefill_chunk // 8) * 8)
+
+    def _sync_bt(self, slot: int) -> None:
+        """Push one slot's host block-table row to the device mirror —
+        called wherever the host row changes (alloc, prefix acquire,
+        free), never on the step path."""
+        self._d_bt = self._d_bt.at[slot].set(
+            jnp.asarray(self.kv.block_tables[slot], jnp.int32))
+
     # ---- the two executables -------------------------------------------
 
     def _build_executables(self):
+        if self._paged:
+            return self._build_paged_executables()
         cfg = self.cfg
         apply_fn = self._apply_fn
         C = cfg.prefill_chunk
@@ -373,6 +433,184 @@ class Engine:
         # donate the pool so XLA updates the cache in place; CPU lacks
         # input/output aliasing for some buffers — skip there to avoid
         # per-call warnings (semantics identical, one extra copy)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        if self._spec:
+            self._verify = jax.jit(verify, donate_argnums=donate)
+        else:
+            self._decode = jax.jit(decode, donate_argnums=donate)
+
+    def _build_paged_executables(self):
+        """The paged-mode executables. Two shapes of the same contract:
+
+        - **off-TPU (the parity gold)**: gather each slot's dense lane
+          from its pages, run the UNCHANGED reference bodies (the same
+          vmap-of-batch-1 rows, the same in-row sampling ops as the
+          dense executables), scatter only the written window back.
+          Every position the reference attends or writes is
+          bit-identical to the dense pool's lane — garbage beyond a
+          row's horizon is masked to an exact zero either way — so
+          token streams match the dense engine BITWISE, at any
+          temperature, by construction (pinned in
+          ``tests/test_paged_decode.py``).
+        - **TPU / forced-pallas**: thread :class:`PagedCache` entries
+          through ONE batch-N forward — the model's attention routes to
+          the `ops.paged_decode.paged_attend` kernel (block-table page
+          streaming, fused int8 dequant, per-row ragged horizons) and
+          sampling collapses into the `fused_sample` epilogue kernel,
+          so one token id per slot is all that crosses back per step.
+          The path is selected at BUILD time (``use_pallas()``), so a
+          forced-impl test must construct the engine inside
+          ``ops.force_impl("pallas")``.
+        """
+        cfg = self.cfg
+        apply_fn = self._apply_fn
+        C = cfg.prefill_chunk
+        K = cfg.num_draft
+        L = self.kv.lane_len
+        sample_kw = dict(temperature=cfg.temperature, top_k=cfg.top_k,
+                         vocab_size=cfg.vocab_size)
+        tree_map = jax.tree_util.tree_map
+        kernel_path = use_pallas()
+
+        def window(lane, start, width):
+            # the (N, Hkv, width, D) block the model just wrote at each
+            # row's index — the only slice scatter-back needs
+            pos = (start[:, None]
+                   + jnp.arange(width, dtype=jnp.int32))[:, None, :,
+                                                         None]
+            return jnp.take_along_axis(lane, pos, axis=2)
+
+        def paged_cache(pages, bt):
+            return {layer: PagedCache(entry["k"], entry["v"], bt, L)
+                    for layer, entry in pages.items()}
+
+        def unpack_cache(cache):
+            return {layer: {"k": pc.k_pages, "v": pc.v_pages}
+                    for layer, pc in cache.items()}
+
+        def prefill(params, pages, bt, slot, tokens, idx, n_real, seed):
+            self.trace_counts["prefill"] += 1   # the compile-count hook
+            bt_row = jax.lax.dynamic_slice_in_dim(bt, slot, 1, 0)
+            positions = (jnp.asarray(idx, jnp.int32)
+                         + jnp.arange(C, dtype=jnp.int32))[None]
+            if kernel_path:
+                cache = paged_cache(pages, bt_row)
+                logits, cache = apply_fn(params, tokens, cache, idx,
+                                         positions=positions,
+                                         chunk_decode=True)
+                pages = unpack_cache(cache)
+            else:
+                lane = tree_map(lambda p: gather_pages(p, bt_row, L),
+                                pages)
+                logits, lane = apply_fn(params, tokens, lane, idx,
+                                        positions=positions,
+                                        chunk_decode=True)
+                idx_v = jnp.asarray(idx, jnp.int32)[None]
+                pages = tree_map(
+                    lambda pg, ln: scatter_pages(
+                        pg, bt_row, window(ln, idx_v, C), idx_v),
+                    pages, lane)
+            # there is no install step: a prefix hit ARRIVES as shared
+            # page ids in the block table (reference, not copy), and a
+            # fresh slot's recycled-page garbage sits beyond the
+            # attention horizon — exactly like the dense pool's masked
+            # slack
+            lg = last_real_logits(logits, n_real[None])
+            tok = fused_sample(lg, jnp.asarray(seed, jnp.int32)[None],
+                               jnp.zeros((1,), jnp.int32),
+                               **sample_kw)[0]
+            return tok, pages
+
+        def decode(params, pages, bt, toks, idxs, active, seeds, pos):
+            self.trace_counts["decode"] += 1    # the compile-count hook
+            if kernel_path:
+                cache = paged_cache(pages, bt)
+                logits, cache = apply_fn(params, toks[:, None], cache,
+                                         idxs, positions=idxs[:, None])
+                pages = unpack_cache(cache)
+                nxt = fused_sample(logits[:, -1], seeds, pos,
+                                   **sample_kw)
+            else:
+                lanes = tree_map(lambda p: gather_pages(p, bt, L),
+                                 pages)
+
+                def row(tok, lane, idx, seed, p):
+                    lane = tree_map(lambda x: x[None], lane)
+                    logits, lane = apply_fn(params, tok.reshape(1, 1),
+                                            lane, idx)
+                    key = jax.random.fold_in(jax.random.key(seed), p)
+                    nxt = sample_token(logits[:, -1], key,
+                                       **sample_kw)[0]
+                    return nxt, tree_map(lambda x: x[0], lane)
+
+                nxt, lanes = jax.vmap(row)(toks, lanes, idxs, seeds,
+                                           pos)
+                # inactive rows (block-table = trash page) scatter
+                # their masked garbage into page 0 — harmless, never
+                # attended, never owned
+                pages = tree_map(
+                    lambda pg, ln: scatter_pages(
+                        pg, bt, window(ln, idxs, 1), idxs),
+                    pages, lanes)
+            nxt = jnp.where(active, nxt, cfg.pad_id)
+            adv = active.astype(jnp.int32)
+            return nxt, idxs + adv, pos + adv, pages
+
+        def verify(params, pages, bt, toks, idxs, active, seeds, pos,
+                   drafts):
+            self.trace_counts["verify"] += 1    # the compile-count hook
+            if kernel_path:
+                cache = paged_cache(pages, bt)
+                chunks = jnp.concatenate([toks[:, None], drafts], 1)
+                positions = (idxs[:, None]
+                             + jnp.arange(K + 1, dtype=jnp.int32)[None])
+                logits, cache = apply_fn(params, chunks, cache, idxs,
+                                         positions=positions,
+                                         chunk_decode=True)
+                pages = unpack_cache(cache)
+                posm = (pos[:, None]
+                        + jnp.arange(K + 1, dtype=jnp.int32)[None])
+                seedm = jnp.broadcast_to(seeds[:, None], posm.shape)
+                V = logits.shape[-1]
+                tgt = fused_sample(
+                    logits.reshape(-1, V), seedm.reshape(-1),
+                    posm.reshape(-1),
+                    **sample_kw).reshape(-1, K + 1)
+                acc = jnp.sum(jnp.cumprod(
+                    (tgt[:, :K] == drafts).astype(jnp.int32), axis=1),
+                    axis=1)
+            else:
+                lanes = tree_map(lambda p: gather_pages(p, bt, L),
+                                 pages)
+
+                def row(tok, lane, idx, seed, p, dr):
+                    lane = tree_map(lambda x: x[None], lane)
+                    chunk = jnp.concatenate([tok[None], dr])  # (K+1,)
+                    logits, lane = apply_fn(params, chunk[None], lane,
+                                            idx, chunk_decode=True)
+                    tgt = counter_sample(
+                        logits[0], seed,
+                        p + jnp.arange(K + 1, dtype=jnp.int32),
+                        **sample_kw)
+                    a = jnp.sum(jnp.cumprod(
+                        (tgt[:K] == dr).astype(jnp.int32)))
+                    return tgt, a, tree_map(lambda x: x[0], lane)
+
+                tgt, acc, lanes = jax.vmap(row)(toks, lanes, idxs,
+                                                seeds, pos, drafts)
+                pages = tree_map(
+                    lambda pg, ln: scatter_pages(
+                        pg, bt, window(ln, idxs, K + 1), idxs),
+                    pages, lanes)
+            acc = jnp.where(active, acc, 0)
+            adv = jnp.where(active, acc + 1, 0)
+            nxt = jnp.where(
+                active,
+                jnp.take_along_axis(tgt, acc[:, None], 1)[:, 0],
+                cfg.pad_id)
+            return tgt, acc, nxt, idxs + adv, pos + adv, pages
+
         donate = () if jax.default_backend() == "cpu" else (1,)
         self._prefill = jax.jit(prefill, donate_argnums=donate)
         if self._spec:
@@ -472,9 +710,16 @@ class Engine:
 
     def _decode_step(self):
         with annotate("serving/decode_step"):
-            nxt, idxs, pos, self.kv.cache = self._decode(
-                self.params, self.kv.cache, self._d_toks, self._d_idxs,
-                self._d_active, self._d_seeds, self._d_pos)
+            if self._paged:
+                nxt, idxs, pos, self.kv.pages = self._decode(
+                    self.params, self.kv.pages, self._d_bt,
+                    self._d_toks, self._d_idxs, self._d_active,
+                    self._d_seeds, self._d_pos)
+            else:
+                nxt, idxs, pos, self.kv.cache = self._decode(
+                    self.params, self.kv.cache, self._d_toks,
+                    self._d_idxs, self._d_active, self._d_seeds,
+                    self._d_pos)
         self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
         if self._defer:
             self._tok_log[self._step_no] = nxt     # fetched at retire
@@ -514,10 +759,16 @@ class Engine:
                     self._draft_propose(st.history, K),
                     np.int32).reshape(K)
         with annotate("serving/verify_step"):
-            tgt, acc, nxt, idxs, pos, self.kv.cache = self._verify(
-                self.params, self.kv.cache, self._d_toks, self._d_idxs,
-                self._d_active, self._d_seeds, self._d_pos,
-                jnp.asarray(drafts))
+            if self._paged:
+                tgt, acc, nxt, idxs, pos, self.kv.pages = self._verify(
+                    self.params, self.kv.pages, self._d_bt,
+                    self._d_toks, self._d_idxs, self._d_active,
+                    self._d_seeds, self._d_pos, jnp.asarray(drafts))
+            else:
+                tgt, acc, nxt, idxs, pos, self.kv.cache = self._verify(
+                    self.params, self.kv.cache, self._d_toks,
+                    self._d_idxs, self._d_active, self._d_seeds,
+                    self._d_pos, jnp.asarray(drafts))
         self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
         tgt_np = np.asarray(tgt)
         acc_np = np.asarray(acc)
@@ -628,6 +879,10 @@ class Engine:
             return
         slot = self.kv.alloc()
         assert slot is not None
+        if self._paged:
+            # the freshly-owned page row must be on device before any
+            # prefill chunk gathers/scatters through it
+            self._sync_bt(slot)
         prefix = tuple(req.prefix) if req.prefix else ()
         full = self._full_prompt(req)
         key = page = None
@@ -658,7 +913,14 @@ class Engine:
             with annotate("serving/prefill"):
                 if hit:
                     self.kv.acquire_prefix(key, slot)
-                    install_lane, idx0 = page.lane, page.length
+                    if self._paged:
+                        # the acquire REWIRED the slot's block table
+                        # onto the shared pages — no lane copy exists
+                        # to install, the pages themselves are the hit
+                        self._sync_bt(slot)
+                        install_lane, idx0 = None, page.length
+                    else:
+                        install_lane, idx0 = page.lane, page.length
                     if (prefix and idx0 < len(prefix)
                             and not self.kv.has_prefix(prefix)):
                         # partial hit below the caller's stated share
@@ -702,6 +964,8 @@ class Engine:
             # stay (their snapshots completed), and the request's
             # verdict belongs to the caller's supervision (re-raise)
             self.kv.free(slot)
+            if self._paged:
+                self._sync_bt(slot)     # row back to the trash page
             with self._admit_lock:
                 self._mid_admit = None
                 self._cancel_mid.discard(req.req_id)
@@ -761,7 +1025,15 @@ class Engine:
         """Snapshot ``slot``'s lane (which holds ``length`` completed
         positions) as a refcounted prefix page — put + acquire as one
         step, so no exception window can leave a registered page
-        without its owner's ref."""
+        without its owner's ref. Paged mode registers by REFERENCE: the
+        registry pins the slot's own pages (no device copy at all —
+        copy-on-register is gone along with copy-on-admit); the stored
+        length floors to a page multiple, so sub-page tails simply stay
+        private and sharers re-prefill them."""
+        if self._paged:
+            if self.kv.register_prefix(slot, pkey, length) is not None:
+                self.kv.acquire_prefix(pkey, slot)
+            return
         lane = jax.tree_util.tree_map(lambda x: x[slot:slot + 1],
                                       self.kv.cache)
         self.kv.put_prefix(pkey, lane, length)
@@ -783,6 +1055,14 @@ class Engine:
             seg = tokens[c * C:(c + 1) * C]
             buf = np.zeros((1, C), np.int32)
             buf[0, :seg.size] = seg
+            if self._paged:
+                # no install operand: prefix hits arrive as shared page
+                # ids already synced into the device block table
+                tok, self.kv.pages = self._prefill(
+                    self.params, self.kv.pages, self._d_bt,
+                    np.int32(slot), buf, np.int32(idx0 + c * C),
+                    np.int32(seg.size), np.int32(seed))
+                continue
             install = np.bool_(c == 0 and install_lane is not None)
             lane_arg = (install_lane if install
                         else self.kv.zeros_lane)
@@ -829,6 +1109,12 @@ class Engine:
             self._d_active = self._d_active.at[slot_idx].set(False)
             self._n_active -= 1
         self.kv.free(slot_idx)
+        if self._paged:
+            # the freed row now names the trash page — REQUIRED, not
+            # hygiene: the retired lane keeps scattering its masked
+            # garbage every step, and its old pages may be reallocated
+            # (or live on as shared prefix pages) immediately
+            self._sync_bt(slot_idx)
         spec = ({"n_drafted": slot.drafted, "n_accepted": slot.accepted}
                 if self._spec else {})
         self._finish(slot.req.req_id, status, reason, produced, **spec)

@@ -313,3 +313,274 @@ class KVPool:
         return {repr(k): {"length": p.length, "refcount": p.refcount,
                           "hits": p.hits, "last_hit": p.last_hit}
                 for k, p in self._prefixes.items()}
+
+
+@dataclasses.dataclass
+class PagedPrefix:
+    """One shared prefix in the PAGED store: not a K/V snapshot but a
+    tuple of page ids into the pool — sharers attend the SAME pages the
+    donor wrote (reference sharing; the dense store's copy-on-admit
+    install is gone). ``length`` is page-aligned by construction."""
+
+    page_ids: Tuple[int, ...]
+    length: int
+    refcount: int = 0            # live slots currently built on it
+    hits: int = 0
+    last_hit: int = 0
+
+
+class PagedKVPool:
+    """Page-granular slot allocator + radix-matched prefix store.
+
+    The device pytree is ``{"layer{i}": {"k","v": (num_pages, Hkv,
+    page_size, D)}}`` — one POOL of pages shared by every slot, wired
+    through per-slot block tables (host numpy here; the engine patches
+    a device mirror at admission/retire boundaries only, so the decode
+    dispatch path stays host-free). Page 0 is the TRASH page: freed
+    slots' block-table rows point at it, inactive decode rows scatter
+    their garbage there, and nothing ever attends it.
+
+    Differences from the dense `KVPool`, by design:
+
+    - ``alloc`` hands out a slot AND populates its block-table row with
+      freshly owned pages for the full lane (sizing in ``__init__``
+      guarantees this never fails — no per-step page faults, the
+      steady-state decode loop stays dispatch-only).
+    - prefix pages are SHARED by id, not installed by value:
+      ``acquire_prefix`` swaps the shared ids into the slot's row
+      (releasing the owned pages they displace) — admission pays zero
+      K/V copies for a hit, and ``register_prefix`` simply pins the
+      registrant's own pages (zero copies there too).
+    - every page carries a refcount = block-table rows + registry
+      entries holding it; a shared page is freed only when BOTH the
+      last sharing slot retires and the registry entry is evicted
+      (`test_paged_decode::TestPagedPool`).
+
+    The prefix-entry API (match/has/get/acquire/release/evict/stats,
+    ``store_version``) mirrors the dense pool so the engine's admission
+    logic is pool-agnostic.
+    """
+
+    #: paged mode has no install step (sharing is by page id, recycled
+    #: garbage sits past the horizon mask) — the engine's pool-agnostic
+    #: admission passes this through and the paged prefill ignores it
+    zeros_lane = None
+
+    def __init__(self, make_cache, max_slots: int, lane_len: int,
+                 page_size: int, dtype=None,
+                 max_pages: Optional[int] = None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.pages_per_lane = -(-int(lane_len) // self.page_size)
+        self.lane_len = self.pages_per_lane * self.page_size
+        self.max_pages = None if max_pages is None else int(max_pages)
+        entries_cap = (self.max_slots if self.max_pages is None
+                       else self.max_pages)
+        # worst case: every slot owns a full lane AND every registry
+        # entry pins a full lane of retired-donor pages (+1 trash) —
+        # sized so page allocation can NEVER fail mid-admission
+        self.num_pages = 1 + (self.max_slots + entries_cap
+                              ) * self.pages_per_lane
+        kw = {} if dtype is None else {"dtype": dtype}
+        self.pages = make_cache(self.num_pages, self.page_size, **kw)
+        self.block_tables = [[0] * self.pages_per_lane
+                             for _ in range(self.max_slots)]
+        self._page_refs = [0] * self.num_pages
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._free: List[int] = list(range(self.max_slots))
+        self._slot_prefix: Dict[int, List[tuple]] = {}
+        self._prefixes: Dict[tuple, PagedPrefix] = {}
+        self._radix = RadixIndex()
+        self._tick = 0
+        self._version = 0
+
+    # ---- pages ----------------------------------------------------------
+
+    def _take_page(self) -> int:
+        if not self._free_pages:
+            raise RuntimeError(
+                "paged KV pool out of pages — sizing invariant broken")
+        pid = self._free_pages.pop(0)
+        self._page_refs[pid] = 1
+        return pid
+
+    def _ref_page(self, pid: int) -> None:
+        self._page_refs[pid] += 1
+
+    def _unref_page(self, pid: int) -> None:
+        if pid == 0:
+            return
+        self._page_refs[pid] -= 1
+        if self._page_refs[pid] < 0:
+            raise ValueError(f"page {pid} refcount below zero")
+        if self._page_refs[pid] == 0:
+            self._free_pages.append(pid)
+            self._free_pages.sort()
+
+    def page_refcount(self, pid: int) -> int:
+        return self._page_refs[pid]
+
+    @property
+    def n_free_pages(self) -> int:
+        return len(self._free_pages)
+
+    # ---- slots ----------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.max_slots
+
+    def alloc(self) -> Optional[int]:
+        """Lowest free slot, its block-table row populated with a full
+        lane of freshly owned pages."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self.block_tables[slot] = [self._take_page()
+                                   for _ in range(self.pages_per_lane)]
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(f"slot {slot} out of range")
+        for key in self._slot_prefix.pop(slot, []):
+            self.release_prefix(key)
+        for pid in self.block_tables[slot]:
+            self._unref_page(pid)
+        self.block_tables[slot] = [0] * self.pages_per_lane
+        self._free.append(slot)
+        self._free.sort()
+
+    @property
+    def store_version(self) -> int:
+        return self._version
+
+    def lane_bytes(self) -> int:
+        """HBM bytes of one slot's worth of pages (`pool_bytes` /
+        physical pages × pages-per-lane)."""
+        total = sum(x.nbytes for x in
+                    jax.tree_util.tree_leaves(self.pages))
+        return total // self.num_pages * self.pages_per_lane
+
+    def pool_bytes(self) -> int:
+        return sum(x.nbytes for x in
+                   jax.tree_util.tree_leaves(self.pages))
+
+    # ---- prefix pages ---------------------------------------------------
+
+    def has_prefix(self, key: tuple) -> bool:
+        return tuple(key) in self._prefixes
+
+    def get_prefix(self, key: tuple) -> Optional[PagedPrefix]:
+        return self._prefixes.get(tuple(key))
+
+    def match(self, tokens, max_len: int
+              ) -> Tuple[Optional[tuple], Optional[PagedPrefix]]:
+        key = self._radix.match(tokens, int(max_len))
+        if key is None:
+            return None, None
+        return key, self._prefixes[key]
+
+    def register_prefix(self, slot: int, key: tuple,
+                        length: int) -> Optional[PagedPrefix]:
+        """Pin ``slot``'s first pages as a shared prefix — the paged
+        analog of the dense pool's ``put_prefix``, with NO copy: the
+        registry entry takes a reference on the registrant's own pages
+        (they outlive the slot). ``length`` floors to a page multiple
+        (sub-page tails hold registrant-specific tokens sharers must
+        re-compute); returns None when nothing page-aligned remains."""
+        key = tuple(key)
+        if key in self._prefixes:
+            raise ValueError(f"prefix {key!r} already registered")
+        n = int(length) // self.page_size
+        if n == 0:
+            return None
+        ids = tuple(self.block_tables[slot][:n])
+        for pid in ids:
+            self._ref_page(pid)
+        page = PagedPrefix(page_ids=ids, length=n * self.page_size,
+                           last_hit=self._tick)
+        self._prefixes[key] = page
+        self._radix.insert(key)
+        self._version += 1
+        self.evict_lru(exclude=key)
+        return page
+
+    def acquire_prefix(self, key: tuple, slot: int) -> PagedPrefix:
+        """Build ``slot`` on a shared prefix: swap the entry's page ids
+        into the slot's block-table row (releasing the owned pages they
+        displace) and take the usual entry refcount. For the slot that
+        just registered its OWN pages this is a pure bookkeeping no-op
+        (the ids already match) — one code path for donor and sharers."""
+        key = tuple(key)
+        page = self._prefixes[key]
+        row = self.block_tables[slot]
+        for i, pid in enumerate(page.page_ids):
+            if row[i] != pid:
+                self._unref_page(row[i])
+                row[i] = pid
+                self._ref_page(pid)
+        page.refcount += 1
+        page.hits += 1
+        self._tick += 1
+        page.last_hit = self._tick
+        self._slot_prefix.setdefault(slot, []).append(key)
+        return page
+
+    def release_prefix(self, key: tuple) -> None:
+        page = self._prefixes[tuple(key)]
+        if page.refcount <= 0:
+            raise ValueError(f"prefix {key!r} released below zero")
+        page.refcount -= 1
+
+    def evict_prefix(self, key: tuple, force: bool = False) -> bool:
+        """Drop a registry entry and its page references; the pages
+        themselves are freed only if no slot still shares them (the
+        refcount test's central property). Same live-entry refusal
+        semantics as the dense pool."""
+        key = tuple(key)
+        page = self._prefixes.get(key)
+        if page is None:
+            return False
+        if page.refcount > 0:
+            if force:
+                raise RuntimeError(
+                    f"prefix {key!r} has {page.refcount} live slot(s) — "
+                    f"refusing to free a live page")
+            return False
+        del self._prefixes[key]
+        self._radix.remove(key)
+        for pid in page.page_ids:
+            self._unref_page(pid)
+        self._version += 1
+        return True
+
+    def evict_lru(self, exclude: Optional[tuple] = None) -> int:
+        if self.max_pages is None:
+            return 0
+        evicted = 0
+        while len(self._prefixes) > self.max_pages:
+            dead = [(p.last_hit, k) for k, p in self._prefixes.items()
+                    if p.refcount == 0 and k != exclude]
+            if not dead:
+                break
+            _, dead_key = min(dead)
+            self.evict_prefix(dead_key)
+            evicted += 1
+        return evicted
+
+    def prefix_stats(self) -> dict:
+        return {repr(k): {"length": p.length, "refcount": p.refcount,
+                          "hits": p.hits, "last_hit": p.last_hit,
+                          "pages": list(p.page_ids)}
+                for k, p in self._prefixes.items()}

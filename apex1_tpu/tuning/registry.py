@@ -82,4 +82,14 @@ SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
                16, CHECKS["fused_ag_flash"]),
     KernelSpec("int8_matmul", ("block_n", "block_k"), ("N", "K"), 128,
                CHECKS["int8_matmul"]),
+    # paged decode: the tunable is the PAGE size (the K/V block the
+    # grid streams per step); keyed on padded head dim and the padded
+    # query-row count (GQA group x chunk width). Rq=8 is the S=1
+    # decode-step class every serving engine hits.
+    KernelSpec("paged_decode", ("page_p",), ("Dp", "Rq"), 8,
+               CHECKS["paged_decode"]),
+    # fused sampling epilogue: whole-row kernel today (block_v = padded
+    # vocab); the spec pins its VMEM frame into the shared gate.
+    KernelSpec("fused_sample", ("block_v",), ("Vp",), 128,
+               CHECKS["fused_sample"]),
 )}

@@ -116,6 +116,38 @@ def agf_check(blocks, dims, es, budget):
     return est <= budget, est
 
 
+def paged_decode_check(blocks, dims, es, budget):
+    """Paged ragged decode attention (`ops.paged_decode.paged_attend`):
+    one (page, Dp) K page block + one V page block per grid step
+    (double-buffered, CACHE dtype ``es`` — int8 pages are a quarter of
+    the f32 frame, which is the capacity-tier point), the (Rq, Dp)
+    query and output blocks, fp32 (acc, m, l) flash scratch, and the
+    live fp32 (Rq, page) score + exp tiles."""
+    p = blocks["page_p"]
+    dp, rq = dims["Dp"], dims["Rq"]
+    est = (DB * es * 2 * p * dp                    # k, v page blocks
+           + DB * 4 * rq * dp                      # q block (fp32 path)
+           + DB * 4 * rq * dp                      # o block
+           + 4 * (rq * dp + 2 * rq * LANES)        # acc, m, l scratch
+           + 2 * 4 * rq * p)                       # s and e tiles
+    return est <= budget, est
+
+
+def fused_sample_check(blocks, dims, _es, budget):
+    """Fused sampling epilogue (`ops.paged_decode.fused_sample`): one
+    (8, block_v) fp32 logits block (a sublane-aligned tile of rows,
+    double-buffered) + the (8, LANES) key/token lanes, plus the live
+    fp32/uint32 temporaries of the in-kernel threefry->gumbel pipeline
+    (~6 block-width vectors: counter pair, two threefry lanes, bits,
+    gumbel+logits)."""
+    rows = 8                                       # sublane row tile
+    bv = blocks["block_v"]
+    est = (DB * 4 * rows * bv                      # logits block
+           + 2 * DB * 4 * rows * LANES             # keys in, tokens out
+           + 6 * 4 * rows * bv)                    # pipeline temporaries
+    return est <= budget, est
+
+
 def int8_check(blocks, dims, _es, budget):
     """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
     ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
@@ -169,6 +201,8 @@ CHECKS: dict[str, object] = {
     "fused_collective_matmul": cm_check,
     "fused_ag_flash": agf_check,
     "int8_matmul": int8_check,
+    "paged_decode": paged_decode_check,
+    "fused_sample": fused_sample_check,
 }
 
 

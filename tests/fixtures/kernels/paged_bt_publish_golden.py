@@ -1,0 +1,72 @@
+"""Block-table publish protocol — GOLDEN fixture (must lint clean).
+
+A structural model of the paged-decode control plane's device-side
+block-table publish loop: each grid step stages one block-table row in
+a VMEM staging slot and DMAs it to the pool's device-visible mirror,
+double-buffered across two slots so the next row can be staged while
+the previous publish drains.  The property under test is slot-reuse
+ordering: the write that re-stages a slot is program-ordered AFTER the
+semaphore wait that retires the publish still reading that slot (a
+local async copy delivers +2 on its semaphore — send and recv halves —
+so the reuse wait consumes 2).
+
+The paired ``paged_bt_publish_torn_bt_bug.py`` fixture moves that
+write above the wait: the in-flight DMA can then read a half-updated
+block-table row — the torn block-table read APX202 exists to catch.
+This file is the clean half of the pair; graftlint's APX2xx checker
+(``lint_sources(..., kernels=True)``) must report NO findings on it.
+
+Fixture only — never imported by the library; exercised from
+``tests/test_lint_kernels.py::TestPagedBtPublishFixtures``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(bt_ref, o_ref, bt_stage, bt_shadow, pub_sem):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    slot = jax.lax.rem(t, 2)
+    nxt = jax.lax.rem(t + 1, 2)
+
+    def publish(s):
+        return pltpu.make_async_copy(
+            bt_stage.at[s], bt_shadow.at[s], pub_sem.at[s])
+
+    # License slot reuse: the publish started two steps ago from this
+    # slot must have fully retired before the row is rewritten.
+    @pl.when(t >= 2)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+    bt_stage[slot] = bt_ref[...]
+    publish(slot).start()
+
+    o_ref[...] = bt_ref[...]
+
+    # Drain: the last two publishes are still in flight at exit.
+    @pl.when(t == T - 1)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+        @pl.when(T > 1)
+        def _():
+            pltpu.semaphore_wait(pub_sem.at[nxt], 2)
+
+
+def publish_block_tables(bt, n_steps):
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, 128), jnp.int32),
+            pltpu.VMEM((2, 8, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(bt)

@@ -1,0 +1,67 @@
+"""Block-table publish protocol — TORN-READ BUG fixture (must flag).
+
+Identical to ``paged_bt_publish_golden.py`` except for one moved
+statement: the slot-reuse write lands BEFORE the semaphore wait that
+retires the previous publish from the same slot.  The DMA started two
+grid steps ago can still be reading ``bt_stage[slot]`` when the new
+row is written over it — the device-visible block-table mirror then
+receives a half-old/half-new row, and the decode kernel walking it
+attends to pages the row never legitimately named.  This is the torn
+block-table read; graftlint MUST flag it as APX202 (dma-race) at the
+write line (first reproduces at ring size n=3: the t=0 publish still
+in flight when t=2 re-stages slot 0).
+
+Fixture only — never imported by the library; exercised from
+``tests/test_lint_kernels.py::TestPagedBtPublishFixtures``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(bt_ref, o_ref, bt_stage, bt_shadow, pub_sem):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    slot = jax.lax.rem(t, 2)
+    nxt = jax.lax.rem(t + 1, 2)
+
+    def publish(s):
+        return pltpu.make_async_copy(
+            bt_stage.at[s], bt_shadow.at[s], pub_sem.at[s])
+
+    bt_stage[slot] = bt_ref[...]   # BUG: torn block-table read — the
+    #                                publish from two steps ago may
+    #                                still be reading this slot
+
+    @pl.when(t >= 2)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+    publish(slot).start()
+
+    o_ref[...] = bt_ref[...]
+
+    @pl.when(t == T - 1)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+        @pl.when(T > 1)
+        def _():
+            pltpu.semaphore_wait(pub_sem.at[nxt], 2)
+
+
+def publish_block_tables(bt, n_steps):
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, 128), jnp.int32),
+            pltpu.VMEM((2, 8, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(bt)

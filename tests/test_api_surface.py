@@ -98,9 +98,13 @@ SURFACE = {
         "write_token_file", "flatten", "unflatten", "RequestFeeder"],
     "apex1_tpu.serving": [
         "Engine", "EngineConfig", "RequestResult", "Scheduler",
-        "Request", "Backpressure", "KVPool", "PrefixPage",
-        "RadixIndex", "ngram_propose",
+        "Request", "Backpressure", "KVPool", "PagedKVPool",
+        "PagedPrefix", "PrefixPage", "RadixIndex", "ngram_propose",
         "ServingMetrics", "RequestRecord"],
+    "apex1_tpu.ops.paged_decode": [
+        "PagedCache", "cache_attend", "check_paged_geometry",
+        "fused_sample", "gather_pages", "paged_attend",
+        "paged_update_attend", "sample_token", "scatter_pages"],
     "apex1_tpu.models.generate": [
         "generate", "speculative_generate", "beam_search", "t5_generate",
         "init_cache", "cached_attention", "sample_token",
@@ -152,7 +156,8 @@ SURFACE = {
     "apex1_tpu.vmem_model": [
         "CHECKS", "budget_bytes", "flash_check", "row_check",
         "linear_xent_check", "cm_check", "agf_check", "int8_check",
-        "rdma_check", "rdma_slot_bytes", "static_frame_bytes"],
+        "rdma_check", "rdma_slot_bytes", "static_frame_bytes",
+        "paged_decode_check", "fused_sample_check"],
     "apex1_tpu.perf_model": [
         "roofline", "kernel_cases", "flash_flops_bytes",
         "linear_xent_flops", "ring_attention_comms",

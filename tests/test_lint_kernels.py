@@ -774,6 +774,23 @@ def _orig_int8(blocks, dims, _es, budget):
     return est <= budget, est
 
 
+# frozen as-landed copies of the PR 18 paged-decode formulas (no
+# pre-refactor history — these pin the registry's gating against silent
+# drift the same way; edit only with a conscious re-gating)
+def _orig_paged(blocks, dims, es, budget):
+    p = blocks["page_p"]
+    dp, rq = dims["Dp"], dims["Rq"]
+    est = (_D * es * 2 * p * dp + _D * 4 * rq * dp + _D * 4 * rq * dp
+           + 4 * (rq * dp + 2 * rq * _L) + 2 * 4 * rq * p)
+    return est <= budget, est
+
+
+def _orig_fused_sample(blocks, dims, _es, budget):
+    bv = blocks["block_v"]
+    est = (_D * 4 * 8 * bv + 2 * _D * 4 * 8 * _L + 6 * 4 * 8 * bv)
+    return est <= budget, est
+
+
 class TestVmemModelShared:
     _GRID = {
         "flash_attention": (_orig_flash,
@@ -819,6 +836,15 @@ class TestVmemModelShared:
                          for n in (128, 256, 512)
                          for k in (128, 512, 1024)],
                         [{"N": 4096, "K": 4096}]),
+        "paged_decode": (_orig_paged,
+                         [{"page_p": p} for p in (8, 16, 64, 256, 2048)],
+                         [{"Dp": d, "Rq": r}
+                          for d in (128, 256)
+                          for r in (8, 48, 512)]),
+        "fused_sample": (_orig_fused_sample,
+                         [{"block_v": v}
+                          for v in (128, 1024, 25216, 50432, 1 << 20)],
+                         [{"Vp": 50432}]),
     }
 
     def test_registry_gating_bit_identical(self):
@@ -985,3 +1011,60 @@ class TestCliKernels:
         assert p.returncode == 1, p.stdout + p.stderr
         assert "poisoned" not in p.stderr
         assert "APX202" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# paged-decode block-table publish: the file-based golden/bug pair
+# ---------------------------------------------------------------------------
+
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "kernels")
+
+
+def _load_fixture(name):
+    with open(os.path.join(FIXDIR, name)) as fh:
+        return fh.read()
+
+
+class TestPagedBtPublishFixtures:
+    """ISSUE 18's protocol pair: the double-buffered block-table
+    publish loop behind the paged KV pool, as on-disk fixtures under
+    tests/fixtures/kernels/ (the golden and bug halves diff as ONE
+    moved statement). Ring sizes are capped at 3-4: local-DMA devices
+    never interact, so n=5/6 multiply per-device delivery timings into
+    the state cap without adding schedules (the torn read first
+    reproduces at n=3)."""
+
+    def test_golden_publish_clean(self, monkeypatch):
+        import apex1_tpu.lint.kernels as K
+        monkeypatch.setattr(K, "RING_SIZES", (1, 2, 3, 4))
+        src = _load_fixture("paged_bt_publish_golden.py")
+        res = run_lint(src)
+        assert not apx2(res), [f.render() for f in res.unsuppressed()]
+
+    def test_torn_block_table_read_flagged(self, monkeypatch):
+        import apex1_tpu.lint.kernels as K
+        monkeypatch.setattr(K, "RING_SIZES", (1, 2, 3))
+        src = _load_fixture("paged_bt_publish_torn_bt_bug.py")
+        res = run_lint(src)
+        assert apx2(res) == {"APX202"}, \
+            [f.render() for f in res.unsuppressed()]
+        wline = line_of(src, "BUG: torn block-table read")
+        torn = [f for f in res.unsuppressed() if f.rule == "APX202"]
+        assert len(torn) == 1, [f.render() for f in torn]
+        assert torn[0].line == wline
+        assert "still reading it" in torn[0].message
+
+    def test_pair_differs_by_one_moved_statement(self):
+        """The pair's contract: identical protocols modulo the write
+        placement — so the flagged defect IS the moved line, not an
+        unrelated drift between the files."""
+        def code_lines(name):
+            body = _load_fixture(name).split('"""', 2)[2]
+            lines = [ln.split("#")[0].rstrip()
+                     for ln in body.splitlines()]
+            return [ln for ln in lines if ln.strip()]
+
+        g = code_lines("paged_bt_publish_golden.py")
+        b = code_lines("paged_bt_publish_torn_bt_bug.py")
+        assert sorted(g) == sorted(b)
+        assert g != b

@@ -234,6 +234,62 @@ def main():
               dtypes=[jnp.bfloat16, jnp.int8, jnp.float32],
               in_specs=(P("dp"), P(), P()))
 
+        # paged ragged decode attention + fused sampling epilogue
+        # (ISSUE 18): the serving engine's paged decode step at real
+        # engine shapes, BOTH cache tiers (int8 dequant fused in-kernel
+        # and bf16). `check_paged_geometry` runs at trace time against
+        # the registry-shared vmem model, so an unregistered/unfittable
+        # page geometry fails THIS gate loudly — the kernel path never
+        # silently falls back to the composite.
+        from apex1_tpu.ops.paged_decode import (check_paged_geometry,
+                                                fused_sample,
+                                                paged_attend)
+
+        # llama-head decode rows (Hq32/Hkv8 GQA, D=128) over a
+        # 2048-token lane at page 16 -> T=128 pages per block-table row;
+        # the page pool is pool-wide state (replicated), rows shard dp
+        N_s, Hq_s, Hkv_s, D_s, P_s = 8, 32, 8, 128, 16
+        T_s = 2048 // P_s
+        n_pg = 1 + N_s * T_s
+        pa = lambda q, kp, vp, bt, ln: paged_attend(q, kp, vp, bt, ln)
+        pv = lambda q, kp, vp, bt, ln: paged_attend(
+            q, kp, vp, bt, ln, total_len=T_s * P_s)
+        for tier, cdt in (("int8", jnp.int8), ("bf16", jnp.bfloat16)):
+            check(f"paged_attend decode {tier} "
+                  f"(8,Hq32/Hkv8,D128,page16,T128)", pa,
+                  [(N_s, Hq_s, 1, D_s), (n_pg, Hkv_s, P_s, D_s),
+                   (n_pg, Hkv_s, P_s, D_s), (N_s, T_s), (N_s,)],
+                  dtypes=[jnp.bfloat16, cdt, cdt, jnp.int32, jnp.int32],
+                  in_specs=(P("dp"), P(), P(), P("dp"), P("dp")))
+            # the speculative verify row class: S = K+1 = 5 queries per
+            # slot through the same pages
+            check(f"paged_attend verify {tier} (8,Hq32/Hkv8,S5)", pv,
+                  [(N_s, Hq_s, 5, D_s), (n_pg, Hkv_s, P_s, D_s),
+                   (n_pg, Hkv_s, P_s, D_s), (N_s, T_s), (N_s,)],
+                  dtypes=[jnp.bfloat16, cdt, cdt, jnp.int32, jnp.int32],
+                  in_specs=(P("dp"), P(), P(), P("dp"), P("dp")))
+        for tag, kw in (("greedy", dict(temperature=0.0)),
+                        ("T0.7", dict(temperature=0.7))):
+            check(f"fused_sample epilogue {tag} (8,50432)",
+                  lambda lg, s, p, kw=kw: fused_sample(
+                      lg, s, p, vocab_size=50257, **kw),
+                  [(N_s, 50432), (N_s,), (N_s,)],
+                  dtypes=[jnp.float32, jnp.int32, jnp.int32],
+                  in_specs=(P("dp"), P("dp"), P("dp")))
+        # the loud-failure half of the contract: a sublane-misaligned
+        # page and an over-budget page must RAISE at trace time, never
+        # fall back
+        for bad in (12, 1 << 20):
+            try:
+                check_paged_geometry(bad, D_s, Hq_s // Hkv_s, 1)
+            except ValueError as e:
+                print(f"  OK   paged geometry page={bad:>7} raises: "
+                      f"{str(e)[:60]}", flush=True)
+            else:
+                ok = False
+                print(f"  FAIL paged geometry gate: page={bad} must "
+                      f"raise ValueError", flush=True)
+
     if args.steps:
         print(f"== full bench train steps (single device, exactly what "
               f"bench.py runs), {args.topology} ==", flush=True)

@@ -39,6 +39,22 @@ rep of every row. An analytic int8-KV capacity row
 same pool HBM buys at int8 vs bf16 (correctness of the dtype flip is
 tier-1's dtype-flip parity drills, not this bench).
 
+The PAGED A/B sweep (ISSUE 18, on unless ``--skip-paged``) re-measures
+the peak load through the paged KV pool (`EngineConfig(paged=True)`:
+block-table page addressing, no copy-on-admit) adjacent to a fresh
+dense run, with token parity vs the solo-generate oracle asserted on
+every rep of BOTH engines — the A/B prices pool bookkeeping, never
+correctness. It also banks a per-phase attribution of the paged decode
+step — attention (gather + attend at the live block table), dequant
+(the int8 lane cast the TPU kernel fuses away), sample (the fused
+epilogue at the step's logits shape), host (engine step wall minus the
+decode executable) — measured as standalone jitted phases at the
+engine's EXACT mid-decode shapes, emitted onto the obs spine and
+parsed back off the banked events (the trace-parser path, like the
+disagg breakdown). CPU-proxy caveat: these rows price the COMPOSITE
+ops; what the proxy cannot measure (kernel fusion wins, HBM page
+streaming) is spelled out in docs/paged_decode.md.
+
 ``--out FILE`` banks the accumulating record via
 ``manifest.atomic_write_json`` after EVERY sweep point (kill-safe,
 like bench.py --out): an interrupted sweep keeps each completed point.
@@ -100,6 +116,11 @@ def main():
     ap.add_argument("--num-draft", type=int, default=4,
                     help="drafts per verify for the speculative axis "
                          "of the multiplier sweep")
+    ap.add_argument("--skip-paged", action="store_true",
+                    help="skip the paged-pool A/B + per-phase "
+                         "attribution at the peak load")
+    ap.add_argument("--phase-reps", type=int, default=5,
+                    help="timing reps per attribution phase")
     ap.add_argument("--replicas", type=int, nargs="*", default=[],
                     help="multi-replica sweep points (ServingFrontend; "
                          "empty = skip the replica axis)")
@@ -392,6 +413,178 @@ def main():
                     bf16_budget, args.layers, args.heads, head_dim,
                     pool_len, 1),
             },
+        }
+        _bank(args.out, record)
+
+    # ---- paged A/B + per-phase attribution (ISSUE 18): the peak load
+    # through the paged KV pool, measured ADJACENT to a fresh dense run
+    # (drift cancels in the ratio), token parity vs the solo-generate
+    # oracle on every rep of both engines. The attribution measures the
+    # paged decode step's phases as standalone jitted callables at the
+    # engine's EXACT mid-decode shapes, emits each rep onto the obs
+    # spine, and reconstructs the breakdown from the banked events —
+    # proving the trace carries the attribution, not just this process.
+    if not args.skip_paged:
+        import tempfile
+
+        from apex1_tpu.obs import spine as obs_spine
+        from apex1_tpu.ops.paged_decode import (cache_attend,
+                                                fused_sample,
+                                                gather_pages)
+
+        load = max(args.loads)
+        n_req = args.requests_per_slot * load
+
+        def ab_engine(paged):
+            eng = Engine(apply_fn, make_cache, params,
+                         EngineConfig(max_slots=load, max_len=max_len,
+                                      prefill_chunk=args.chunk,
+                                      vocab_size=cfg.vocab_size,
+                                      max_queue=n_req, paged=paged))
+            wid = eng.submit(prompts[0], max_new_tokens=2)
+            eng.run(max_steps=8)
+            assert eng.results[wid].status == "done"
+            best = float("inf")
+            for _ in range(3):
+                eng.metrics = ServingMetrics()
+                eng.results.clear()
+                t0 = time.perf_counter()
+                ids = []
+                k = 0
+                while k < n_req or eng.scheduler.depth or eng.n_active:
+                    if k < n_req:
+                        ids.append(eng.submit(prompts[k],
+                                              max_new_tokens=args.new))
+                        k += 1
+                        for _ in range(args.stagger - 1):
+                            eng.step()
+                    eng.step()
+                rep = time.perf_counter() - t0
+                for i, rid in enumerate(ids):  # paged must be invisible
+                    np.testing.assert_array_equal(
+                        eng.results[rid].tokens, serial_out[i])
+                best = min(best, rep)
+            assert eng.trace_counts == {"prefill": 1, "decode": 1}, \
+                eng.trace_counts
+            return eng, n_req * args.new / best
+
+        _, dense_tps = ab_engine(False)
+        eng, paged_tps = ab_engine(True)
+
+        # park the paged engine mid-decode so the live block table,
+        # page store, and control vectors give the attribution its
+        # real shapes (all rows admitted, none near retirement)
+        for p in prompts[:load]:
+            eng.submit(p, max_new_tokens=args.new)
+        while eng.scheduler.depth:
+            eng.step()
+        for _ in range(2):
+            eng.step()
+
+        L = eng.kv.lane_len
+        bt = eng._d_bt
+        entry = next(iter(eng.kv.pages.values()))
+        kp, vp = entry["k"], entry["v"]
+        D = kp.shape[-1]
+        prng = np.random.default_rng(7)
+        q = jnp.asarray(prng.standard_normal(
+            (load, args.heads, 1, D)), jnp.float32)
+        lg = jnp.asarray(prng.standard_normal(
+            (load, cfg.vocab_size)), jnp.float32)
+
+        def attn_fn(kp, vp, bt, idxs, q):
+            # one layer of the step's attention math: block-table
+            # gather + masked attend at each row's live depth
+            k_all = gather_pages(kp, bt, L).astype(jnp.float32)
+            v_all = gather_pages(vp, bt, L).astype(jnp.float32)
+            return cache_attend(q, k_all, v_all, idxs)
+
+        # the dequant pass the TPU kernel fuses away: int8 lanes (one
+        # layer's K, as gathered for one step) cast up to f32
+        lanes8 = jax.jit(lambda p, b: gather_pages(p, b, L).astype(
+            jnp.int8))(kp, bt)
+        sample_kw = dict(temperature=0.7, vocab_size=cfg.vocab_size)
+        phases = {
+            "attention": (jax.jit(attn_fn),
+                          (kp, vp, bt, eng._d_idxs, q)),
+            "dequant": (jax.jit(lambda x: x.astype(jnp.float32)),
+                        (lanes8,)),
+            "sample": (jax.jit(functools.partial(fused_sample,
+                                                 **sample_kw)),
+                       (lg, eng._d_seeds, eng._d_pos)),
+        }
+
+        def dev_step():
+            out = eng._decode(eng.params, eng.kv.pages, eng._d_bt,
+                              eng._d_toks, eng._d_idxs, eng._d_active,
+                              eng._d_seeds, eng._d_pos)
+            jax.block_until_ready(out)   # state untouched: outputs
+            #                              dropped, no donation on cpu
+
+        obs_tmp = tempfile.mkdtemp(prefix="bench_paged_obs_")
+        run = obs_spine.ObsRun(dir=obs_tmp, component="bench_paged")
+        obs_spine.set_default_run(run)
+        try:
+            for name, (fn, fargs) in phases.items():
+                jax.block_until_ready(fn(*fargs))    # compile off-clock
+                for r in range(args.phase_reps):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(fn(*fargs))
+                    obs_spine.emit(
+                        "event", "bench.paged_phase", phase=name,
+                        rep=r, ms=(time.perf_counter() - t0) * 1e3)
+            # host = full engine step minus the decode executable —
+            # slot bookkeeping, token fetch, metrics, retire scan
+            dev_step()                               # executable warm
+            for r in range(args.phase_reps):
+                t0 = time.perf_counter()
+                dev_step()
+                dev_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                assert eng.step() == load            # rows stay active
+                step_ms = (time.perf_counter() - t0) * 1e3
+                obs_spine.emit("event", "bench.paged_phase",
+                               phase="host", rep=r,
+                               ms=max(0.0, step_ms - dev_ms))
+        finally:
+            run.close()
+            obs_spine.set_default_run(None)
+
+        # the trace-parser path: the breakdown is rebuilt from the
+        # banked events, not from in-process floats
+        samples = {}
+        for e in obs_spine.read_events(run.path):
+            if e.get("name") == "bench.paged_phase":
+                samples.setdefault(e["phase"], []).append(
+                    float(e["ms"]))
+        assert set(samples) == {"attention", "dequant", "sample",
+                                "host"}, sorted(samples)
+        per_phase = {
+            name: {"n": len(v),
+                   "p50_ms": round(float(np.percentile(v, 50)), 4),
+                   "min_ms": round(float(min(v)), 4)}
+            for name, v in sorted(samples.items())}
+        record["paged_sweep"] = {
+            "load": load,
+            "page_size": eng.kv.page_size,
+            "pages_per_lane": eng.kv.pages_per_lane,
+            "tokens_per_sec_dense": round(dense_tps, 1),
+            "tokens_per_sec_paged": round(paged_tps, 1),
+            # pool bookkeeping priced at equal load; parity asserted
+            # above, so any gap here is block-table plumbing, never
+            # tokens. CPU-proxy caveat: composite-op timings — the
+            # fusion/page-streaming wins are TPU-only
+            # (docs/paged_decode.md)
+            "paged_vs_dense": round(paged_tps / dense_tps, 3),
+            "per_phase": per_phase,
+            "phase_shapes": {
+                "slots": load, "lane_len": L,
+                "page_size": eng.kv.page_size,
+                "head_dim": D, "heads": args.heads,
+                "vocab": cfg.vocab_size, "layers_note":
+                    "attention/dequant rows are PER LAYER "
+                    f"(x{args.layers} per step); dequant is one "
+                    "layer's K lanes (x2 for K+V)"},
         }
         _bank(args.out, record)
 

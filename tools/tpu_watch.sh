@@ -277,6 +277,19 @@ run bench_spec_serving 1800 python tools/bench_serving.py --loads 8 \
 run bench_disagg    1800 python tools/bench_serving.py --loads 4 \
                          --prefix-len 0 --disagg \
                          --out perf_results/bench_disagg.json
+# ISSUE 18 paged decode ON SILICON: sweep the page-size / block_v
+# tables first (the committed tables carry CPU tiny-mode picks; the
+# hardware winners feed Engine._resolve_page_size for the bench that
+# follows), then the dense-vs-paged A/B at peak load — the first
+# honest timing of the fused kernel path (in-kernel int8 dequant +
+# sampling epilogue: the CPU proxy prices composite ops only,
+# docs/paged_decode.md), with per-phase attribution parsed back off
+# the obs spine and per-rep token parity vs the dense engine.
+run tune_paged      1800 python tools/tune_kernels.py --kernel paged_decode
+run tune_fsample     900 python tools/tune_kernels.py --kernel fused_sample
+run bench_paged_decode 1800 python tools/bench_serving.py --loads 8 \
+                         --prefix-len 24 --num-draft 4 \
+                         --out perf_results/bench_paged_decode.json
 # elastic shrink-resume A/B (ISSUE 14) BEHIND the banked-bench
 # backlog: the n -> n/2 mid-run shrink through the planner re-plan +
 # manifest-verified reshard vs the from-checkpoint control, on the

@@ -414,8 +414,95 @@ def case_int8(tiny):
                 nbytes=float(N * K + N * 4 + T * K * 2 + T * N * 2))
 
 
+def case_paged_decode(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.paged_decode import paged_attend
+    from apex1_tpu.tuning import padded_lanes
+
+    # the serving engine's decode row class (GQA group 4, one query per
+    # slot). page_p is a POOL LAYOUT parameter, not a kernel static
+    # arg: each candidate re-pages the SAME dense lanes at its page
+    # size, so the sweep times the real layout the engine would
+    # allocate — the winner feeds Engine._resolve_page_size through
+    # the table. Both cache tiers sweep (int8's fused dequant changes
+    # the page-streaming balance, so its winner may differ from bf16).
+    N, Hq, Hkv, D, L = ((4, 8, 2, 64, 128) if tiny
+                        else (8, 32, 8, 128, 2048))
+    cands = [8, 16] if tiny else [8, 16, 32, 64, 128]
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(N, Hq, 1, D)), jnp.bfloat16)
+    lanes_k = rng.normal(size=(N, Hkv, L, D))
+    lanes_v = rng.normal(size=(N, Hkv, L, D))
+    lengths = jnp.asarray(rng.integers(L // 2, L, size=N), jnp.int32)
+
+    def tier(dtype_name, cast):
+        def make(blocks):
+            P = blocks["page_p"]
+            T = L // P
+            bt = np.arange(1, 1 + N * T, dtype=np.int32).reshape(N, T)
+            kp = np.zeros((1 + N * T, Hkv, P, D), np.float32)
+            vp = np.zeros_like(kp)
+            for r in range(N):
+                for t in range(T):
+                    kp[bt[r, t]] = lanes_k[r, :, t * P:(t + 1) * P]
+                    vp[bt[r, t]] = lanes_v[r, :, t * P:(t + 1) * P]
+            kpj, vpj, btj = cast(kp), cast(vp), jnp.asarray(bt)
+
+            def f(q):
+                return paged_attend(q, kpj, vpj, btj, lengths)
+            return f, (q,)
+
+        es = 1 if dtype_name == "int8" else 2
+        return Case("paged_decode", {"Dp": padded_lanes(D), "Rq": 8},
+                    dtype_name, [dict(page_p=p) for p in cands],
+                    make, grad=False,
+                    flops=float(4 * N * Hq * L * D),
+                    nbytes=float(2 * N * Hkv * L * D * es
+                                 + 2 * N * Hq * D * 2))
+
+    return [tier("bfloat16", lambda a: jnp.asarray(a, jnp.bfloat16)),
+            tier("int8", lambda a: jnp.asarray(np.clip(
+                a * 30.0, -127, 127).astype(np.int8)))]
+
+
+def case_fused_sample(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.paged_decode import fused_sample
+    from apex1_tpu.tuning import padded_lanes
+
+    # the sampling epilogue at the engine's step shape: R slot rows over
+    # a GPT-2-class padded vocab. block_v tiles the vocab axis; every
+    # split is bitwise-identical (exact f32 (max, first-index) fold),
+    # so this sweep is purely a VMEM-residency/grid-overhead trade.
+    R, V = (8, 1024) if tiny else (8, 50432)
+    cands = ([512, 1024] if tiny
+             else [3200, 6400, 12672, 25216, 50432])
+    rng = np.random.default_rng(0)
+    lg = jnp.asarray(rng.standard_normal((R, V)), jnp.float32)
+    seeds = jnp.asarray(rng.integers(0, 2**31 - 1, size=R), jnp.int32)
+    pos = jnp.asarray(rng.integers(0, 64, size=R), jnp.int32)
+
+    def make(blocks):
+        def f(lg):
+            return fused_sample(lg, seeds, pos, temperature=0.7,
+                                vocab_size=V - 175,
+                                block_v=blocks["block_v"])
+        return f, (lg,)
+
+    return Case("fused_sample", {"Vp": padded_lanes(V)}, "float32",
+                [dict(block_v=bv) for bv in cands], make, grad=False,
+                flops=float(30 * R * V),
+                nbytes=float(R * V * 4 + R * 4))
+
+
 CASES = {
     "attention": case_attention,
+    "paged_decode": case_paged_decode,
+    "fused_sample": case_fused_sample,
     "linear_xent": case_linear_xent,
     "softmax": case_softmax,
     "layer_norm": case_layer_norm,
