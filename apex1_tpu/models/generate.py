@@ -699,17 +699,23 @@ def _decoder(model, num_kv_heads: int, head_dim: int):
     cfg = model.cfg
 
     def apply_fn(params, tokens, cache, cache_index, *, positions=None,
-                 segment_ids=None, valid_start=None, chunk_decode=False):
+                 segment_ids=None, valid_start=None, chunk_decode=False,
+                 return_hidden=False):
         B, S = tokens.shape
         if positions is None:
             pos = jnp.asarray(cache_index, jnp.int32) + jnp.arange(S)
             positions = jnp.broadcast_to(pos[None], (B, S))
-        logits, new_cache = model.apply(
+        # return_hidden is forwarded only when asked: models without
+        # the kwarg keep working, and the serving engine's LoRA
+        # epilogue path gets the pre-head hidden states it recomputes
+        # the head matmul from (gpt2 and llama both support it)
+        kw = {"return_hidden": True} if return_hidden else {}
+        out, new_cache = model.apply(
             {"params": params}, tokens, positions=positions,
             cache=cache, cache_index=cache_index,
             segment_ids=segment_ids, valid_start=valid_start,
-            chunk_decode=chunk_decode)
-        return logits, new_cache
+            chunk_decode=chunk_decode, **kw)
+        return out, new_cache
 
     def make_cache(batch: int, max_len: int, dtype=None):
         return init_cache(cfg.num_layers, batch, num_kv_heads, max_len,

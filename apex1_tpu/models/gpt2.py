@@ -204,8 +204,12 @@ class GPT2(nn.Module):
         x = layer_norm(x, gamma, beta)
         if return_hidden:
             # for the fused LM-head+CE path (ops.linear_cross_entropy):
-            # the (B, S, V) logits never hit HBM
-            return x.astype(dtype)
+            # the (B, S, V) logits never hit HBM. With a cache the
+            # contract mirrors the logits return — the serving LoRA
+            # epilogue replays the tied-head matmul itself so per-slot
+            # adapter deltas can fuse in (llama does the same)
+            h = x.astype(dtype)
+            return h if cache is None else (h, new_cache)
         logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),
                             wte.astype(dtype),
                             preferred_element_type=jnp.float32)

@@ -73,6 +73,11 @@ class LlamaConfig:
     # (all-to-all head scatter; the cp axis size must divide the head
     # counts, or the KV count for GQA-repeat)
     cp_impl: str = "ring"
+    # route the dense-MLP glu through ops.fused_dense.fused_glu (one
+    # Pallas pass over x on TPU; off-TPU the composite is token-for-token
+    # the inline expression below, so flipping this is bitwise-neutral
+    # on the CPU proxy — pinned by tests/test_fused_glu.py)
+    fused_mlp: bool = False
     policy: PrecisionPolicy = dataclasses.field(
         default_factory=lambda: get_policy("O0"))
 
@@ -186,7 +191,11 @@ class LlamaBlock(nn.Module):
                         jnp.float32).astype(dtype)
         wd = self.param("w_down", init, (cfg.ffn_size, E),
                         jnp.float32).astype(dtype)
-        y = (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
+        if cfg.fused_mlp:
+            from apex1_tpu.ops.fused_dense import fused_glu
+            y = fused_glu(h, wg, wu, activation="silu") @ wd
+        else:
+            y = (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
         out = x + y.astype(x.dtype)
         return out if new_cache is None else (out, new_cache)
 
@@ -258,7 +267,11 @@ class Llama(nn.Module):
         x = rms_norm(x, g, eps=cfg.norm_eps)
         if return_hidden:
             # for the fused LM-head+CE path (ops.linear_cross_entropy)
-            return x.astype(dtype)
+            # and the serving LoRA epilogue (serving.engine computes the
+            # head matmul itself so per-slot adapter deltas can fuse in);
+            # with a cache the contract mirrors the logits return
+            h = x.astype(dtype)
+            return h if cache is None else (h, new_cache)
         head = self.param("output", nn.initializers.normal(0.02),
                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         logits = jnp.einsum("bsh,vh->bsv", x.astype(dtype),

@@ -15,6 +15,11 @@ from apex1_tpu.ops.softmax import (  # noqa: F401
 from apex1_tpu.ops.xentropy import (  # noqa: F401
     masked_next_token_mean, softmax_cross_entropy_loss)
 from apex1_tpu.ops.linear_xent import linear_cross_entropy  # noqa: F401
+from apex1_tpu.ops.chunked_loss import (  # noqa: F401
+    chunked_dpo_loss, chunked_kl_loss, chunked_logprob,
+    chunked_orpo_loss)
+from apex1_tpu.ops.fused_dense import fused_glu  # noqa: F401
+from apex1_tpu.ops.lora_epilogue import lora_delta  # noqa: F401
 from apex1_tpu.ops.rope import (  # noqa: F401
     apply_rotary_pos_emb, rope_tables)
 from apex1_tpu.ops.attention import flash_attention, fmha  # noqa: F401

@@ -1,0 +1,428 @@
+"""Chunked preference / distillation losses that never materialize logits.
+
+The [B·S, V] logits tensor dominates fine-tuning memory: at Llama-3 vocab
+(128256) one 8k-token batch is 4 GiB of fp32 logits — more than the model
+shard.  Liger Kernel (arXiv 2410.10989) showed the fix: compute losses a
+vocab-CHUNK at a time with online-softmax merging, and recompute each
+chunk's logits inside the VJP instead of saving them.  This module is that
+play on the `linear_xent` machinery:
+
+- ``chunked_logprob`` — per-token log p(target) via per-chunk
+  `shard_stats_packed` calls (the PR 9 packed-stats epilogue: one (T, 4)
+  ``[m, l, tgt, sumx]`` stream per chunk) merged online, with a custom VJP
+  that re-runs `shard_grads` per chunk.  The XLA path streams the same
+  chunks through a `fori_loop` so even the composite never holds a
+  (T, V) buffer — only one (T, chunk_v) tile is live at a time.
+- ``chunked_dpo_loss`` / ``chunked_orpo_loss`` — preference losses
+  composed from ``chunked_logprob`` by ordinary autodiff (the chunk
+  recompute lives in the logprob VJP, so the preference algebra stays
+  readable jnp).
+- ``chunked_kl_loss`` — streaming KL(teacher ‖ student) distillation:
+  a single pass carries both models' online-softmax stats plus the two
+  cross moments ``Σ e^{s_t−m} s_t`` and ``Σ e^{s_t−m} s_s``, so the KL
+  needs no second sweep and no logits tensor for either model.
+
+Chunk geometry is priced by the shared `apex1_tpu.vmem_model`
+(``CHECKS["chunked_loss"]``) and resolved with the documented precedence
+(docs/ops.md): explicit ``chunk_v`` > tuning-table winner > heuristic.
+``check_chunk_geometry`` raises loudly at trace time on misaligned or
+over-budget chunks — same contract as `ops.paged_decode.check_paged_geometry`.
+
+The no-materialization property is ASSERTED, not assumed: tier-1
+(tests/test_chunked_loss.py) compiles grad(chunked_dpo_loss) and checks
+both the optimized HLO (no (T, V)-shaped buffer anywhere) and, where the
+backend reports it, AOT ``memory_analysis()`` peak temp bytes against the
+chunk geometry bound.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex1_tpu.ops._common import NEG_INF, pad_to, use_pallas
+from apex1_tpu.ops.linear_xent import shard_grads, shard_stats_packed
+
+_LANES = 128
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def check_chunk_geometry(chunk_v: int, hidden: int, *, es: int = 4) -> int:
+    """Validate a chunked-loss vocab chunk LOUDLY at trace time.
+
+    Silent fallback on a bad explicit chunk would hide an OOM (or a
+    mis-tuned table) until real-silicon runtime; instead this raises with
+    the priced estimate so the failure names itself.  Mirrors
+    `ops.paged_decode.check_paged_geometry`.
+    """
+    if chunk_v < _LANES or chunk_v % _LANES:
+        raise ValueError(
+            f"chunked_loss: chunk_v={chunk_v} must be a multiple of "
+            f"{_LANES} (vocab tiles are lane-aligned)")
+    from apex1_tpu.vmem_model import CHECKS, budget_bytes
+    hp = _ceil_to(hidden, _LANES)
+    ok, est = CHECKS["chunked_loss"]({"chunk_v": chunk_v}, {"Hp": hp},
+                                     es, budget_bytes())
+    if not ok:
+        raise ValueError(
+            f"chunked_loss: chunk_v={chunk_v} (Hp={hp}) prices at ~{est} B"
+            f" of VMEM > budget {budget_bytes()} B; shrink chunk_v or"
+            f" re-tune (tools/tune_kernels.py)")
+    return chunk_v
+
+
+def _auto_chunk(V: int, H: int, chunk_v, dtype) -> int:
+    """Resolve chunk_v: explicit > tuning table > heuristic (docs/ops.md)."""
+    hp = _ceil_to(H, _LANES)
+    if chunk_v is not None:
+        return check_chunk_geometry(int(chunk_v), H)
+    from apex1_tpu import tuning
+    hit = tuning.lookup("chunked_loss", {"Hp": hp}, dtype)
+    if hit is not None:
+        try:
+            return check_chunk_geometry(int(hit["chunk_v"]), H)
+        except (KeyError, ValueError):
+            pass  # fail-safe: a stale table entry falls back to heuristic
+    return min(_ceil_to(V, _LANES), 8192)
+
+
+def _chunks(V: int, cv: int) -> int:
+    return -(-V // cv)
+
+
+# ---------------------------------------------------------------------------
+# chunked_logprob: per-token log p(target) with per-chunk-recompute VJP
+# ---------------------------------------------------------------------------
+
+
+def _merge_stats(m, l, tgt, mc, lc, tc):
+    """Online-softmax merge of one chunk's (m, l) into the running pair;
+    tgt is exact per chunk (out-of-chunk labels contribute 0) so it sums."""
+    mn = jnp.maximum(m, mc)
+    l = l * jnp.exp(m - mn) + lc * jnp.exp(mc - mn)
+    return mn, l, tgt + tc
+
+
+def _pallas_stats(x2, wp, t2, n_c, cv, k, block_t, block_v):
+    T = x2.shape[0]
+    tcol = t2.reshape(T, 1)  # the kernels tile targets as (bt, 1)
+
+    def body(c, carry):
+        wc = jax.lax.dynamic_slice_in_dim(wp, c * cv, cv, 0)
+        pk = shard_stats_packed(x2, wc, tcol, col_offset=c * cv,
+                                num_classes=k, block_t=block_t,
+                                block_v=block_v)
+        return _merge_stats(*carry, pk[:, 0], pk[:, 1], pk[:, 2])
+
+    init = (jnp.full((T,), NEG_INF, jnp.float32),
+            jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
+    return jax.lax.fori_loop(0, n_c, body, init)
+
+
+def _xla_stats(x2, wp, t2, n_c, cv, k):
+    """Composite gold — SAME streaming structure as the kernel path: a
+    fori_loop whose only live tile is the (T, cv) chunk, so the CPU proxy
+    exhibits (and tier-1 can assert) the no-logits-tensor property."""
+    T = x2.shape[0]
+    xf = x2.astype(jnp.float32)
+    tcol = t2.reshape(T, 1)
+
+    def body(c, carry):
+        wc = jax.lax.dynamic_slice_in_dim(wp, c * cv, cv, 0)
+        s = xf @ wc.astype(jnp.float32).T  # (T, cv): the ONLY logits tile
+        gcol = c * cv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = gcol < k
+        sm = jnp.where(valid, s, NEG_INF)
+        mc = jnp.max(sm, axis=1)
+        m, l, tgt = carry
+        mn = jnp.maximum(m, mc)
+        l = (l * jnp.exp(m - mn)
+             + jnp.sum(jnp.where(valid, jnp.exp(sm - mn[:, None]), 0.0),
+                       axis=1))
+        tgt = tgt + jnp.sum(jnp.where(gcol == tcol, s, 0.0), axis=1)
+        return mn, l, tgt
+
+    init = (jnp.full((T,), NEG_INF, jnp.float32),
+            jnp.zeros((T,), jnp.float32), jnp.zeros((T,), jnp.float32))
+    return jax.lax.fori_loop(0, n_c, body, init)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _logprob(x2, weight, t2, chunk_v, num_classes, block_t, block_v):
+    return _logprob_fwd(x2, weight, t2, chunk_v, num_classes,
+                        block_t, block_v)[0]
+
+
+def _logprob_fwd(x2, weight, t2, chunk_v, num_classes, block_t, block_v):
+    V = weight.shape[0]
+    k = num_classes if num_classes is not None else V
+    wp, _ = pad_to(weight, 0, chunk_v)
+    n_c = _chunks(V, chunk_v)
+    if use_pallas():
+        m, l, tgt = _pallas_stats(x2, wp, t2, n_c, chunk_v, k,
+                                  block_t, block_v)
+    else:
+        m, l, tgt = _xla_stats(x2, wp, t2, n_c, chunk_v, k)
+    lse = m + jnp.log(l)
+    return tgt - lse, (x2, weight, t2, lse)
+
+
+def _logprob_bwd(chunk_v, num_classes, block_t, block_v, res, g):
+    x2, weight, t2, lse = res
+    T = x2.shape[0]
+    V = weight.shape[0]
+    k = num_classes if num_classes is not None else V
+    cv = chunk_v
+    n_c = _chunks(V, cv)
+    wp, _ = pad_to(weight, 0, cv)
+    Vp = wp.shape[0]
+    # loss = lse − tgt (smoothing 0) has logp = −loss, so the chunk
+    # gradient machinery consumes the NEGATED cotangent.
+    dl = (-g).astype(jnp.float32)
+    dx0 = jnp.zeros(x2.shape, jnp.float32)
+    dw0 = jnp.zeros((Vp, x2.shape[1]), jnp.float32)
+
+    if use_pallas():
+        tcol = t2.reshape(T, 1)  # the kernels tile targets as (bt, 1)
+
+        def body(c, carry):
+            dx, dwp = carry
+            wc = jax.lax.dynamic_slice_in_dim(wp, c * cv, cv, 0)
+            dxc, dwc = shard_grads(x2, wc, tcol, lse, dl,
+                                   col_offset=c * cv,
+                                   num_classes=k, block_t=block_t,
+                                   block_v=block_v)
+            dwp = jax.lax.dynamic_update_slice_in_dim(
+                dwp, dwc.astype(jnp.float32), c * cv, 0)
+            return dx + dxc.astype(jnp.float32), dwp
+    else:
+        xf = x2.astype(jnp.float32)
+        tcol = t2.reshape(T, 1)
+
+        def body(c, carry):
+            dx, dwp = carry
+            wc = jax.lax.dynamic_slice_in_dim(wp, c * cv, cv, 0)
+            wcf = wc.astype(jnp.float32)
+            s = xf @ wcf.T  # recompute: the only live (T, cv) tile
+            gcol = c * cv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            valid = gcol < k
+            p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+            onehot = jnp.where(valid & (gcol == tcol), 1.0, 0.0)
+            gt = (p - onehot) * dl[:, None]
+            dwp = jax.lax.dynamic_update_slice_in_dim(
+                dwp, gt.T @ xf, c * cv, 0)
+            return dx + gt @ wcf, dwp
+
+    dx, dwp = jax.lax.fori_loop(0, n_c, body, (dx0, dw0))
+    f0 = np.zeros(t2.shape, dtype=jax.dtypes.float0)
+    return (dx.astype(x2.dtype), dwp[:V].astype(weight.dtype), f0)
+
+
+_logprob.defvjp(_logprob_fwd, _logprob_bwd)
+
+
+def chunked_logprob(x, weight, targets, *, chunk_v=None, num_classes=None,
+                    block_t=None, block_v=None):
+    """Per-token ``log p(target)`` of ``softmax(x @ weightᵀ)`` without a
+    logits tensor — ``x`` (..., H), ``weight`` (V, H), ``targets`` (...,)
+    int.  Returns (...,) fp32.  Differentiable in ``x`` and ``weight``;
+    the VJP recomputes each vocab chunk (never saves logits)."""
+    lead = targets.shape
+    H = x.shape[-1]
+    x2 = x.reshape(-1, H)
+    t2 = targets.reshape(-1).astype(jnp.int32)
+    cv = _auto_chunk(weight.shape[0], H, chunk_v, x.dtype)
+    lp = _logprob(x2, weight, t2, cv, num_classes, block_t, block_v)
+    return lp.reshape(lead)
+
+
+# ---------------------------------------------------------------------------
+# Preference losses (DPO / ORPO) — composed from chunked_logprob
+# ---------------------------------------------------------------------------
+
+
+def _seq_logp(hidden, weight, targets, padding_idx, kw):
+    lp = chunked_logprob(hidden, weight, targets, **kw)
+    if padding_idx is not None:
+        mask = (targets != padding_idx).astype(jnp.float32)
+    else:
+        mask = jnp.ones(targets.shape, jnp.float32)
+    return jnp.sum(lp * mask, axis=-1), jnp.sum(mask, axis=-1)
+
+
+def chunked_dpo_loss(hidden_chosen, hidden_rejected, weight,
+                     targets_chosen, targets_rejected,
+                     ref_chosen_logp, ref_rejected_logp, *,
+                     beta: float = 0.1, padding_idx=None, num_classes=None,
+                     chunk_v=None, block_t=None, block_v=None):
+    """DPO loss (Rafailov et al.) over chunked per-sequence logps.
+
+    ``hidden_*`` (B, S, H) policy hidden states, ``targets_*`` (B, S) int,
+    ``ref_*_logp`` (B,) PRE-COMPUTED reference-policy sequence logps
+    (compute them with ``chunked_logprob`` under ``stop_gradient`` — the
+    reference model needs no VJP).  Returns the scalar mean
+    ``−log σ(β·((π_c − π_r) − (ref_c − ref_r)))``.
+    """
+    kw = dict(num_classes=num_classes, chunk_v=chunk_v,
+              block_t=block_t, block_v=block_v)
+    seq_c, _ = _seq_logp(hidden_chosen, weight, targets_chosen,
+                         padding_idx, kw)
+    seq_r, _ = _seq_logp(hidden_rejected, weight, targets_rejected,
+                         padding_idx, kw)
+    margin = beta * ((seq_c - seq_r)
+                     - (ref_chosen_logp - ref_rejected_logp))
+    return -jnp.mean(jax.nn.log_sigmoid(margin))
+
+
+def _log_odds(avg_logp):
+    """log(p / (1−p)) from an average token logp, clamped away from the
+    p→1 pole (degenerate sequences with probability ~1)."""
+    p = jnp.clip(jnp.exp(avg_logp), None, 1.0 - 1e-6)
+    return avg_logp - jnp.log1p(-p)
+
+
+def chunked_orpo_loss(hidden_chosen, hidden_rejected, weight,
+                      targets_chosen, targets_rejected, *,
+                      lam: float = 0.1, padding_idx=None, num_classes=None,
+                      chunk_v=None, block_t=None, block_v=None):
+    """ORPO (Hong et al.): chosen-NLL plus λ·odds-ratio penalty, both from
+    chunked logps (no reference model, no logits tensor).  Returns the
+    scalar ``mean(NLL_c) + λ·mean(−log σ(log-odds(avg_c) − log-odds(avg_r)))``.
+    """
+    kw = dict(num_classes=num_classes, chunk_v=chunk_v,
+              block_t=block_t, block_v=block_v)
+    seq_c, len_c = _seq_logp(hidden_chosen, weight, targets_chosen,
+                             padding_idx, kw)
+    seq_r, len_r = _seq_logp(hidden_rejected, weight, targets_rejected,
+                             padding_idx, kw)
+    len_c = jnp.maximum(len_c, 1.0)
+    len_r = jnp.maximum(len_r, 1.0)
+    nll = -seq_c / len_c
+    ratio = _log_odds(seq_c / len_c) - _log_odds(seq_r / len_r)
+    return jnp.mean(nll) + lam * jnp.mean(-jax.nn.log_sigmoid(ratio))
+
+
+# ---------------------------------------------------------------------------
+# Streaming KL distillation
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _kl(xs2, ws, xt2, wt, chunk_v, num_classes, temperature):
+    return _kl_fwd(xs2, ws, xt2, wt, chunk_v, num_classes, temperature)[0]
+
+
+def _kl_fwd(xs2, ws, xt2, wt, cv, num_classes, temp):
+    T = xs2.shape[0]
+    V = ws.shape[0]
+    k = num_classes if num_classes is not None else V
+    n_c = _chunks(V, cv)
+    wsp, _ = pad_to(ws, 0, cv)
+    wtp, _ = pad_to(wt, 0, cv)
+    xsf = xs2.astype(jnp.float32) / temp
+    xtf = xt2.astype(jnp.float32) / temp
+
+    def body(c, carry):
+        m_s, l_s, m_t, l_t, u_tt, u_ts = carry
+        wsc = jax.lax.dynamic_slice_in_dim(wsp, c * cv, cv, 0)
+        wtc = jax.lax.dynamic_slice_in_dim(wtp, c * cv, cv, 0)
+        ss = xsf @ wsc.astype(jnp.float32).T  # (T, cv)
+        st = xtf @ wtc.astype(jnp.float32).T
+        gcol = c * cv + jax.lax.broadcasted_iota(jnp.int32, ss.shape, 1)
+        valid = gcol < k
+        ssm = jnp.where(valid, ss, NEG_INF)
+        stm = jnp.where(valid, st, NEG_INF)
+        mn_s = jnp.maximum(m_s, jnp.max(ssm, axis=1))
+        l_s = (l_s * jnp.exp(m_s - mn_s)
+               + jnp.sum(jnp.where(valid, jnp.exp(ssm - mn_s[:, None]), 0.0),
+                         axis=1))
+        mn_t = jnp.maximum(m_t, jnp.max(stm, axis=1))
+        corr = jnp.exp(m_t - mn_t)
+        e_t = jnp.where(valid, jnp.exp(stm - mn_t[:, None]), 0.0)
+        l_t = l_t * corr + jnp.sum(e_t, axis=1)
+        # cross moments under the TEACHER measure, exp-corrected like l_t
+        u_tt = u_tt * corr + jnp.sum(e_t * jnp.where(valid, st, 0.0), axis=1)
+        u_ts = u_ts * corr + jnp.sum(e_t * jnp.where(valid, ss, 0.0), axis=1)
+        return mn_s, l_s, mn_t, l_t, u_tt, u_ts
+
+    neg = jnp.full((T,), NEG_INF, jnp.float32)
+    zero = jnp.zeros((T,), jnp.float32)
+    m_s, l_s, m_t, l_t, u_tt, u_ts = jax.lax.fori_loop(
+        0, n_c, body, (neg, zero, neg, zero, zero, zero))
+    lse_s = m_s + jnp.log(l_s)
+    lse_t = m_t + jnp.log(l_t)
+    # KL = Σ_v p_t (s_t − s_s) − lse_t + lse_s with Σ p_t s_• = u_t• / l_t
+    kl = (u_tt - u_ts) / l_t - lse_t + lse_s
+    return kl, (xs2, ws, xt2, wt, lse_s, lse_t)
+
+
+def _kl_bwd(cv, num_classes, temp, res, g):
+    xs2, ws, xt2, wt, lse_s, lse_t = res
+    T = xs2.shape[0]
+    V = ws.shape[0]
+    k = num_classes if num_classes is not None else V
+    n_c = _chunks(V, cv)
+    wsp, _ = pad_to(ws, 0, cv)
+    wtp, _ = pad_to(wt, 0, cv)
+    Vp = wsp.shape[0]
+    xsf = xs2.astype(jnp.float32) / temp
+    xtf = xt2.astype(jnp.float32) / temp
+    xs_raw = xs2.astype(jnp.float32)
+    gl = (g.astype(jnp.float32) / temp)[:, None]
+
+    def body(c, carry):
+        dx, dwp = carry
+        wsc = jax.lax.dynamic_slice_in_dim(wsp, c * cv, cv, 0)
+        wtc = jax.lax.dynamic_slice_in_dim(wtp, c * cv, cv, 0)
+        wscf = wsc.astype(jnp.float32)
+        ss = xsf @ wscf.T  # recompute (T, cv) — never saved
+        st = xtf @ wtc.astype(jnp.float32).T
+        gcol = c * cv + jax.lax.broadcasted_iota(jnp.int32, ss.shape, 1)
+        valid = gcol < k
+        ps = jnp.where(valid, jnp.exp(ss - lse_s[:, None]), 0.0)
+        pt = jnp.where(valid, jnp.exp(st - lse_t[:, None]), 0.0)
+        gt = (ps - pt) * gl  # dKL/ds_s = p_s − p_t, scaled by g / T
+        dwp = jax.lax.dynamic_update_slice_in_dim(
+            dwp, gt.T @ xs_raw, c * cv, 0)
+        return dx + gt @ wscf, dwp
+
+    dx0 = jnp.zeros(xs2.shape, jnp.float32)
+    dw0 = jnp.zeros((Vp, xs2.shape[1]), jnp.float32)
+    dx, dwp = jax.lax.fori_loop(0, n_c, body, (dx0, dw0))
+    # teacher is stop-grad by construction: zero cotangents
+    return (dx.astype(xs2.dtype), dwp[:V].astype(ws.dtype),
+            jnp.zeros_like(xt2), jnp.zeros_like(wt))
+
+
+_kl.defvjp(_kl_fwd, _kl_bwd)
+
+
+def chunked_kl_loss(student_hidden, student_weight, teacher_hidden,
+                    teacher_weight, *, temperature: float = 1.0,
+                    num_classes=None, chunk_v=None):
+    """Per-token ``KL(teacher ‖ student)`` over temperature-scaled heads,
+    streamed a vocab chunk at a time (neither model's logits tensor ever
+    exists).  ``*_hidden`` (..., H), ``*_weight`` (V, H); returns (...,)
+    fp32.  Teacher inputs are stop-grad (zero cotangents); the student VJP
+    recomputes both chunks per step.  Both dispatch paths run the same
+    streamed jnp chunks — the chunking (not a bespoke kernel) is the win,
+    and XLA's MXU matmuls inside the loop are already optimal."""
+    lead = student_hidden.shape[:-1]
+    H = student_hidden.shape[-1]
+    if teacher_weight.shape[0] != student_weight.shape[0]:
+        raise ValueError(
+            f"chunked_kl_loss: student V={student_weight.shape[0]} != "
+            f"teacher V={teacher_weight.shape[0]} (distill over one vocab)")
+    xs2 = student_hidden.reshape(-1, H)
+    xt2 = teacher_hidden.reshape(-1, teacher_hidden.shape[-1])
+    cv = _auto_chunk(student_weight.shape[0], H, chunk_v,
+                     student_hidden.dtype)
+    kl = _kl(xs2, student_weight, xt2, teacher_weight, cv, num_classes,
+             float(temperature))
+    return kl.reshape(lead)

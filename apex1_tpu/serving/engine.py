@@ -170,6 +170,13 @@ class EngineConfig:
     # resolves tuning-table winner > ceil8(prefill_chunk) heuristic;
     # the Pallas kernel path requires a multiple of 8 (sublane tiling)
     # and `check_paged_geometry` fails loudly otherwise.
+    lora_rank: int = 0           # >0: multi-tenant LoRA adapter pages —
+    # each slot carries a rank-length adapter block-table row and the
+    # decode executables add the `ops.lora_epilogue` delta to the head
+    # logits. tenant= on submit names the adapter (serving.lora);
+    # requires Engine(lora_head=) — the model's (V, H) LM-head param.
+    lora_max_adapters: int = 4   # adapter-page pool sizing (pages =
+    #                              1 + max_adapters * rank)
 
     def __post_init__(self):
         if self.prefill_chunk < 1:
@@ -186,6 +193,13 @@ class EngineConfig:
         if self.page_size is not None and self.page_size < 1:
             raise ValueError(
                 f"page_size must be >= 1, got {self.page_size}")
+        if self.lora_rank < 0:
+            raise ValueError(
+                f"lora_rank must be >= 0, got {self.lora_rank}")
+        if self.lora_rank > 0 and self.lora_max_adapters < 1:
+            raise ValueError(
+                f"lora_max_adapters must be >= 1, "
+                f"got {self.lora_max_adapters}")
 
 
 @dataclasses.dataclass
@@ -236,11 +250,31 @@ class Engine:
                  config: Optional[EngineConfig] = None, *,
                  metrics_logger: Optional[MetricsLogger] = None,
                  cache_dtype=None,
-                 draft_propose: Optional[Callable] = None):
+                 draft_propose: Optional[Callable] = None,
+                 lora_head=None):
         self.cfg = cfg = config or EngineConfig()
         self.params = params
         self._apply_fn = apply_fn
         self._spec = cfg.num_draft > 0
+        # multi-tenant LoRA (cfg.lora_rank > 0): the adapter-page store
+        # rides beside the KV pool, and the executables recompute the
+        # head matmul from the decoder's HIDDEN states (apply_fn must
+        # accept return_hidden=True — llama_decoder does) so the paged
+        # adapter delta fuses into the logits epilogue. lora_head is
+        # the model's OWN (V, H) LM-head param (e.g. params["output"]);
+        # the executable applies the model's exact einsum to it, so a
+        # LoRA-off slot's logits are the model's logits verbatim.
+        self._lora = self._lora_head = None
+        if cfg.lora_rank > 0:
+            if lora_head is None:
+                raise ValueError(
+                    "lora_rank > 0 requires lora_head= (the model's "
+                    "(vocab, hidden) LM-head weight)")
+            from apex1_tpu.serving.lora import LoraAdapterStore
+            V, H = lora_head.shape
+            self._lora = LoraAdapterStore(H, V, cfg.lora_rank,
+                                          cfg.lora_max_adapters)
+            self._lora_head = lora_head
         # the pool carries slack positions past the usable max_len: the
         # FINAL prefill chunk is right-padded to the full chunk width,
         # so its write can extend up to prefill_chunk-1 past the last
@@ -296,6 +330,15 @@ class Engine:
         self._d_active = jnp.zeros((cfg.max_slots,), bool)
         self._d_seeds = jnp.zeros((cfg.max_slots,), jnp.int32)
         self._d_pos = jnp.zeros((cfg.max_slots,), jnp.int32)
+        if self._lora is not None:
+            # per-slot adapter block-table row + on-flag, patched at the
+            # same join/leave boundaries as the control vectors. All-
+            # zero rows name the zero page (exact 0.0 delta), so the
+            # flag only guards the `logits + delta` add against -0.0
+            # drift on adapterless rows — one executable either way.
+            self._d_lora_bt = jnp.zeros(
+                (cfg.max_slots, cfg.lora_rank), jnp.int32)
+            self._d_lora_on = jnp.zeros((cfg.max_slots,), bool)
         self._n_active = 0
         # eos_id=None: retirement is length-based, so step tokens are
         # only READ at retirement — the log keeps each step's (N,)
@@ -356,11 +399,40 @@ class Engine:
         apply_fn = self._apply_fn
         C = cfg.prefill_chunk
         K = cfg.num_draft
+        lora = self._lora is not None
+        head = self._lora_head
         sample_kw = dict(temperature=cfg.temperature, top_k=cfg.top_k,
                          vocab_size=cfg.vocab_size)
 
+        # LoRA epilogue leg (static — baked at build time like the
+        # paged kernel_path): the forward returns HIDDEN states, the
+        # body replays the model's exact head einsum, and the paged
+        # adapter delta lands before sampling. `jnp.where(on, ...)`
+        # rather than an unconditional add: the zero page makes an off
+        # row's delta exactly 0.0, but `x + 0.0` can still flip -0.0
+        # logits, and off rows must be BITWISE the base model's.
+        def head_logits(h):
+            return jnp.einsum("bsh,vh->bsv", h, head.astype(h.dtype),
+                              preferred_element_type=jnp.float32)
+
+        def forward(params, tokens, lane, idx, **kw):
+            if not lora:
+                return apply_fn(params, tokens, lane, idx, **kw)
+            h, lane = apply_fn(params, tokens, lane, idx,
+                               return_hidden=True, **kw)
+            return head_logits(h), h, lane
+
+        def lora_row(logits, h, a_pg, b_pg, lrow, on):
+            from apex1_tpu.ops.lora_epilogue import _lora_delta_ref
+            bt = jnp.broadcast_to(lrow[None, :],
+                                  (h.shape[0], lrow.shape[0]))
+            delta = _lora_delta_ref(h, a_pg, b_pg, bt)
+            return jnp.where(on, logits + delta.astype(logits.dtype),
+                             logits)
+
         def prefill(params, pool, slot, init_lane, install, tokens, idx,
-                    n_real, seed):
+                    n_real, seed, a_pg=None, b_pg=None, lbt=None,
+                    lon=None):
             self.trace_counts["prefill"] += 1   # the compile-count hook
             lane = jax.tree_util.tree_map(
                 lambda x: jax.lax.dynamic_slice_in_dim(x, slot, 1, 0),
@@ -370,58 +442,98 @@ class Engine:
                 init_lane)
             positions = (jnp.asarray(idx, jnp.int32)
                          + jnp.arange(C, dtype=jnp.int32))[None]
-            logits, lane = apply_fn(params, tokens, lane, idx,
-                                    positions=positions,
-                                    chunk_decode=True)
+            if lora:
+                logits, h, lane = forward(params, tokens, lane, idx,
+                                          positions=positions,
+                                          chunk_decode=True)
+            else:
+                logits, lane = apply_fn(params, tokens, lane, idx,
+                                        positions=positions,
+                                        chunk_decode=True)
             pool = jax.tree_util.tree_map(
                 lambda p, l: jax.lax.dynamic_update_slice_in_dim(
                     p, l.astype(p.dtype), slot, 0), pool, lane)
+            lg = last_real_logits(logits, n_real[None])
+            if lora:
+                # the slot's adapter row, gathered at the same traced
+                # index discipline as everything else in this body
+                lrow = jax.lax.dynamic_slice_in_dim(lbt, slot, 1, 0)[0]
+                on = jax.lax.dynamic_slice_in_dim(lon, slot, 1, 0)[0]
+                lg = lora_row(lg, last_real_logits(h, n_real[None]),
+                              a_pg, b_pg, lrow, on)
             # output token 0's counter-based key (re-seeding per draw
             # is the counter-PRNG contract — see ops.stochastic)
             key = jax.random.fold_in(jax.random.key(seed), 0)
-            tok = sample_token(last_real_logits(logits, n_real[None]),
-                               key, **sample_kw)[0]
+            tok = sample_token(lg, key, **sample_kw)[0]
             return tok, pool
 
-        def decode(params, pool, toks, idxs, active, seeds, pos):
+        def decode(params, pool, toks, idxs, active, seeds, pos,
+                   a_pg=None, b_pg=None, lbt=None, lon=None):
             self.trace_counts["decode"] += 1    # the compile-count hook
 
-            def row(tok, lane, idx, seed, p):
+            def row(tok, lane, idx, seed, p, lrow, on):
                 lane = jax.tree_util.tree_map(lambda x: x[None], lane)
-                logits, lane = apply_fn(params, tok.reshape(1, 1), lane,
-                                        idx)
+                if lora:
+                    logits, h, lane = forward(params, tok.reshape(1, 1),
+                                              lane, idx)
+                    lg = lora_row(logits[:, -1], h[:, -1], a_pg, b_pg,
+                                  lrow, on)
+                else:
+                    logits, lane = apply_fn(params, tok.reshape(1, 1),
+                                            lane, idx)
+                    lg = logits[:, -1]
                 key = jax.random.fold_in(jax.random.key(seed), p)
-                nxt = sample_token(logits[:, -1], key, **sample_kw)[0]
+                nxt = sample_token(lg, key, **sample_kw)[0]
                 return nxt, jax.tree_util.tree_map(lambda x: x[0], lane)
 
-            nxt, pool = jax.vmap(row)(toks, pool, idxs, seeds, pos)
+            if lora:
+                nxt, pool = jax.vmap(
+                    row, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+                        toks, pool, idxs, seeds, pos, lbt, lon)
+            else:
+                nxt, pool = jax.vmap(
+                    row, in_axes=(0, 0, 0, 0, 0, None, None))(
+                        toks, pool, idxs, seeds, pos, None, None)
             nxt = jnp.where(active, nxt, cfg.pad_id)
             adv = active.astype(jnp.int32)
             return nxt, idxs + adv, pos + adv, pool
 
         def verify(params, pool, toks, idxs, active, seeds, pos,
-                   drafts):
+                   drafts, a_pg=None, b_pg=None, lbt=None, lon=None):
             self.trace_counts["verify"] += 1    # the compile-count hook
 
-            def row(tok, lane, idx, seed, p, dr):
+            def row(tok, lane, idx, seed, p, dr, lrow, on):
                 lane = jax.tree_util.tree_map(lambda x: x[None], lane)
                 chunk = jnp.concatenate([tok[None], dr])      # (K+1,)
-                logits, lane = apply_fn(params, chunk[None], lane, idx,
-                                        chunk_decode=True)
+                if lora:
+                    logits, h, lane = forward(params, chunk[None], lane,
+                                              idx, chunk_decode=True)
+                    lg = lora_row(logits[0], h[0], a_pg, b_pg, lrow, on)
+                else:
+                    logits, lane = apply_fn(params, chunk[None], lane,
+                                            idx, chunk_decode=True)
+                    lg = logits[0]
                 # the target's CANONICAL stream at positions p..p+K —
                 # exact-match acceptance means emitted tokens are these
                 # samples verbatim, so speculation cannot perturb the
                 # (params, prompt, seed) purity resubmission rides
                 tgt = counter_sample(
-                    logits[0], seed, p + jnp.arange(K + 1, dtype=jnp.int32),
+                    lg, seed, p + jnp.arange(K + 1, dtype=jnp.int32),
                     **sample_kw)
                 a = jnp.sum(jnp.cumprod(
                     (tgt[:K] == dr).astype(jnp.int32)))
                 return tgt, a, jax.tree_util.tree_map(
                     lambda x: x[0], lane)
 
-            tgt, acc, pool = jax.vmap(row)(toks, pool, idxs, seeds, pos,
-                                           drafts)
+            if lora:
+                tgt, acc, pool = jax.vmap(
+                    row, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))(
+                        toks, pool, idxs, seeds, pos, drafts, lbt, lon)
+            else:
+                tgt, acc, pool = jax.vmap(
+                    row, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                        toks, pool, idxs, seeds, pos, drafts, None,
+                        None)
             acc = jnp.where(active, acc, 0)
             adv = jnp.where(active, acc + 1, 0)
             nxt = jnp.where(
@@ -468,10 +580,42 @@ class Engine:
         C = cfg.prefill_chunk
         K = cfg.num_draft
         L = self.kv.lane_len
+        lora = self._lora is not None
+        head = self._lora_head
         sample_kw = dict(temperature=cfg.temperature, top_k=cfg.top_k,
                          vocab_size=cfg.vocab_size)
         tree_map = jax.tree_util.tree_map
         kernel_path = use_pallas()
+
+        def head_logits(h):
+            return jnp.einsum("bsh,vh->bsv", h, head.astype(h.dtype),
+                              preferred_element_type=jnp.float32)
+
+        def forward(params, tokens, cache, idx, **kw):
+            if not lora:
+                return apply_fn(params, tokens, cache, idx, **kw)
+            h, cache = apply_fn(params, tokens, cache, idx,
+                                return_hidden=True, **kw)
+            return head_logits(h), h, cache
+
+        def lora_batch(logits, h, a_pg, b_pg, lbt, lon):
+            # (N, V) logits + (N, H) hidden rows -> epilogue delta via
+            # the scalar-prefetched page-gather kernel (composite gold
+            # off-TPU); rows are independent, so mixed-tenant batches
+            # stay bitwise equal to solo runs
+            from apex1_tpu.ops.lora_epilogue import lora_delta
+            delta = lora_delta(h, a_pg, b_pg, lbt)
+            return jnp.where(lon[:, None],
+                             logits + delta.astype(logits.dtype),
+                             logits)
+
+        def lora_rowwise(logits, h, a_pg, b_pg, lrow, on):
+            from apex1_tpu.ops.lora_epilogue import _lora_delta_ref
+            bt = jnp.broadcast_to(lrow[None, :],
+                                  (h.shape[0], lrow.shape[0]))
+            delta = _lora_delta_ref(h, a_pg, b_pg, bt)
+            return jnp.where(on, logits + delta.astype(logits.dtype),
+                             logits)
 
         def window(lane, start, width):
             # the (N, Hkv, width, D) block the model just wrote at each
@@ -489,23 +633,35 @@ class Engine:
             return {layer: {"k": pc.k_pages, "v": pc.v_pages}
                     for layer, pc in cache.items()}
 
-        def prefill(params, pages, bt, slot, tokens, idx, n_real, seed):
+        def prefill(params, pages, bt, slot, tokens, idx, n_real, seed,
+                    a_pg=None, b_pg=None, lbt=None, lon=None):
             self.trace_counts["prefill"] += 1   # the compile-count hook
             bt_row = jax.lax.dynamic_slice_in_dim(bt, slot, 1, 0)
             positions = (jnp.asarray(idx, jnp.int32)
                          + jnp.arange(C, dtype=jnp.int32))[None]
+            h = None
             if kernel_path:
                 cache = paged_cache(pages, bt_row)
-                logits, cache = apply_fn(params, tokens, cache, idx,
-                                         positions=positions,
-                                         chunk_decode=True)
+                if lora:
+                    logits, h, cache = forward(params, tokens, cache,
+                                               idx, positions=positions,
+                                               chunk_decode=True)
+                else:
+                    logits, cache = apply_fn(params, tokens, cache, idx,
+                                             positions=positions,
+                                             chunk_decode=True)
                 pages = unpack_cache(cache)
             else:
                 lane = tree_map(lambda p: gather_pages(p, bt_row, L),
                                 pages)
-                logits, lane = apply_fn(params, tokens, lane, idx,
-                                        positions=positions,
-                                        chunk_decode=True)
+                if lora:
+                    logits, h, lane = forward(params, tokens, lane, idx,
+                                              positions=positions,
+                                              chunk_decode=True)
+                else:
+                    logits, lane = apply_fn(params, tokens, lane, idx,
+                                            positions=positions,
+                                            chunk_decode=True)
                 idx_v = jnp.asarray(idx, jnp.int32)[None]
                 pages = tree_map(
                     lambda pg, ln: scatter_pages(
@@ -517,35 +673,63 @@ class Engine:
             # attention horizon — exactly like the dense pool's masked
             # slack
             lg = last_real_logits(logits, n_real[None])
+            if lora:
+                lrow = jax.lax.dynamic_slice_in_dim(lbt, slot, 1, 0)[0]
+                on = jax.lax.dynamic_slice_in_dim(lon, slot, 1, 0)[0]
+                lg = lora_rowwise(lg, last_real_logits(h, n_real[None]),
+                                  a_pg, b_pg, lrow, on)
             tok = fused_sample(lg, jnp.asarray(seed, jnp.int32)[None],
                                jnp.zeros((1,), jnp.int32),
                                **sample_kw)[0]
             return tok, pages
 
-        def decode(params, pages, bt, toks, idxs, active, seeds, pos):
+        def decode(params, pages, bt, toks, idxs, active, seeds, pos,
+                   a_pg=None, b_pg=None, lbt=None, lon=None):
             self.trace_counts["decode"] += 1    # the compile-count hook
             if kernel_path:
                 cache = paged_cache(pages, bt)
-                logits, cache = apply_fn(params, toks[:, None], cache,
-                                         idxs, positions=idxs[:, None])
+                if lora:
+                    logits, h, cache = forward(params, toks[:, None],
+                                               cache, idxs,
+                                               positions=idxs[:, None])
+                    lg = lora_batch(logits[:, -1], h[:, -1], a_pg,
+                                    b_pg, lbt, lon)
+                else:
+                    logits, cache = apply_fn(params, toks[:, None],
+                                             cache, idxs,
+                                             positions=idxs[:, None])
+                    lg = logits[:, -1]
                 pages = unpack_cache(cache)
-                nxt = fused_sample(logits[:, -1], seeds, pos,
-                                   **sample_kw)
+                nxt = fused_sample(lg, seeds, pos, **sample_kw)
             else:
                 lanes = tree_map(lambda p: gather_pages(p, bt, L),
                                  pages)
 
-                def row(tok, lane, idx, seed, p):
+                def row(tok, lane, idx, seed, p, lrow, on):
                     lane = tree_map(lambda x: x[None], lane)
-                    logits, lane = apply_fn(params, tok.reshape(1, 1),
-                                            lane, idx)
+                    if lora:
+                        logits, h, lane = forward(params,
+                                                  tok.reshape(1, 1),
+                                                  lane, idx)
+                        lg = lora_rowwise(logits[:, -1], h[:, -1],
+                                          a_pg, b_pg, lrow, on)
+                    else:
+                        logits, lane = apply_fn(params,
+                                                tok.reshape(1, 1),
+                                                lane, idx)
+                        lg = logits[:, -1]
                     key = jax.random.fold_in(jax.random.key(seed), p)
-                    nxt = sample_token(logits[:, -1], key,
-                                       **sample_kw)[0]
+                    nxt = sample_token(lg, key, **sample_kw)[0]
                     return nxt, tree_map(lambda x: x[0], lane)
 
-                nxt, lanes = jax.vmap(row)(toks, lanes, idxs, seeds,
-                                           pos)
+                if lora:
+                    nxt, lanes = jax.vmap(
+                        row, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+                            toks, lanes, idxs, seeds, pos, lbt, lon)
+                else:
+                    nxt, lanes = jax.vmap(
+                        row, in_axes=(0, 0, 0, 0, 0, None, None))(
+                            toks, lanes, idxs, seeds, pos, None, None)
                 # inactive rows (block-table = trash page) scatter
                 # their masked garbage into page 0 — harmless, never
                 # attended, never owned
@@ -558,16 +742,32 @@ class Engine:
             return nxt, idxs + adv, pos + adv, pages
 
         def verify(params, pages, bt, toks, idxs, active, seeds, pos,
-                   drafts):
+                   drafts, a_pg=None, b_pg=None, lbt=None, lon=None):
             self.trace_counts["verify"] += 1    # the compile-count hook
             if kernel_path:
                 cache = paged_cache(pages, bt)
                 chunks = jnp.concatenate([toks[:, None], drafts], 1)
                 positions = (idxs[:, None]
                              + jnp.arange(K + 1, dtype=jnp.int32)[None])
-                logits, cache = apply_fn(params, chunks, cache, idxs,
-                                         positions=positions,
-                                         chunk_decode=True)
+                if lora:
+                    logits, h, cache = forward(params, chunks, cache,
+                                               idxs,
+                                               positions=positions,
+                                               chunk_decode=True)
+                    # flatten the (N, K+1) verify rows into the batch
+                    # axis the paged delta kernel streams — each row
+                    # repeats its slot's adapter block-table entry
+                    Hd = h.shape[-1]
+                    btr = jnp.repeat(lbt, K + 1, axis=0)
+                    onr = jnp.repeat(lon, K + 1, axis=0)
+                    logits = lora_batch(
+                        logits.reshape(-1, logits.shape[-1]),
+                        h.reshape(-1, Hd), a_pg, b_pg, btr, onr
+                    ).reshape(logits.shape)
+                else:
+                    logits, cache = apply_fn(params, chunks, cache,
+                                             idxs, positions=positions,
+                                             chunk_decode=True)
                 pages = unpack_cache(cache)
                 posm = (pos[:, None]
                         + jnp.arange(K + 1, dtype=jnp.int32)[None])
@@ -584,21 +784,38 @@ class Engine:
                 lanes = tree_map(lambda p: gather_pages(p, bt, L),
                                  pages)
 
-                def row(tok, lane, idx, seed, p, dr):
+                def row(tok, lane, idx, seed, p, dr, lrow, on):
                     lane = tree_map(lambda x: x[None], lane)
                     chunk = jnp.concatenate([tok[None], dr])  # (K+1,)
-                    logits, lane = apply_fn(params, chunk[None], lane,
-                                            idx, chunk_decode=True)
+                    if lora:
+                        logits, h, lane = forward(params, chunk[None],
+                                                  lane, idx,
+                                                  chunk_decode=True)
+                        lg = lora_rowwise(logits[0], h[0], a_pg, b_pg,
+                                          lrow, on)
+                    else:
+                        logits, lane = apply_fn(params, chunk[None],
+                                                lane, idx,
+                                                chunk_decode=True)
+                        lg = logits[0]
                     tgt = counter_sample(
-                        logits[0], seed,
+                        lg, seed,
                         p + jnp.arange(K + 1, dtype=jnp.int32),
                         **sample_kw)
                     a = jnp.sum(jnp.cumprod(
                         (tgt[:K] == dr).astype(jnp.int32)))
                     return tgt, a, tree_map(lambda x: x[0], lane)
 
-                tgt, acc, lanes = jax.vmap(row)(toks, lanes, idxs,
-                                                seeds, pos, drafts)
+                if lora:
+                    tgt, acc, lanes = jax.vmap(
+                        row, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))(
+                            toks, lanes, idxs, seeds, pos, drafts,
+                            lbt, lon)
+                else:
+                    tgt, acc, lanes = jax.vmap(
+                        row, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                            toks, lanes, idxs, seeds, pos, drafts,
+                            None, None)
                 pages = tree_map(
                     lambda pg, ln: scatter_pages(
                         pg, bt, window(ln, idxs, K + 1), idxs),
@@ -617,6 +834,40 @@ class Engine:
             self._verify = jax.jit(verify, donate_argnums=donate)
         else:
             self._decode = jax.jit(decode, donate_argnums=donate)
+
+    # ---- multi-tenant LoRA adapters -------------------------------------
+
+    def register_adapter(self, tenant: str, A, B, *,
+                         scale: float = 1.0):
+        """Install ``tenant``'s LM-head adapter (``A`` (H, r), ``B``
+        (r, V)); subsequent ``submit(tenant=...)`` requests decode
+        through it. Two-phase page publish (`serving.lora`) — safe to
+        call while the engine is serving."""
+        if self._lora is None:
+            raise RuntimeError(
+                "register_adapter requires EngineConfig(lora_rank > 0)")
+        return self._lora.register(tenant, A, B, scale=scale)
+
+    def unregister_adapter(self, tenant: str) -> None:
+        """Retire ``tenant``'s adapter. In-flight requests keep their
+        pinned pages until retirement; new submits with this tenant
+        decode adapterless (zero row)."""
+        if self._lora is None:
+            raise RuntimeError(
+                "unregister_adapter requires "
+                "EngineConfig(lora_rank > 0)")
+        self._lora.unregister(tenant)
+
+    def _lora_release(self, slot: int) -> None:
+        """Unpin a slot's adapter pages and zero its device row (the
+        LoRA analogue of the trash-page reset: the freed lane keeps
+        computing, so its row must stop naming live adapter pages)."""
+        if self._lora is None:
+            return
+        self._lora.release(slot)
+        self._d_lora_bt = self._d_lora_bt.at[slot].set(
+            jnp.zeros((self.cfg.lora_rank,), jnp.int32))
+        self._d_lora_on = self._d_lora_on.at[slot].set(False)
 
     # ---- submission -----------------------------------------------------
 
@@ -708,18 +959,27 @@ class Engine:
                                  self.scheduler.depth)
         return n_active
 
+    def _lora_args(self) -> tuple:
+        """The adapter-page operands appended to every executable call
+        when LoRA is enabled — page pools + per-slot block-table rows,
+        all device-resident (the step path stays host-free)."""
+        if self._lora is None:
+            return ()
+        return (self._lora.a_pages, self._lora.b_pages,
+                self._d_lora_bt, self._d_lora_on)
+
     def _decode_step(self):
         with annotate("serving/decode_step"):
             if self._paged:
                 nxt, idxs, pos, self.kv.pages = self._decode(
                     self.params, self.kv.pages, self._d_bt,
                     self._d_toks, self._d_idxs, self._d_active,
-                    self._d_seeds, self._d_pos)
+                    self._d_seeds, self._d_pos, *self._lora_args())
             else:
                 nxt, idxs, pos, self.kv.cache = self._decode(
                     self.params, self.kv.cache, self._d_toks,
                     self._d_idxs, self._d_active, self._d_seeds,
-                    self._d_pos)
+                    self._d_pos, *self._lora_args())
         self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
         if self._defer:
             self._tok_log[self._step_no] = nxt     # fetched at retire
@@ -763,12 +1023,14 @@ class Engine:
                 tgt, acc, nxt, idxs, pos, self.kv.pages = self._verify(
                     self.params, self.kv.pages, self._d_bt,
                     self._d_toks, self._d_idxs, self._d_active,
-                    self._d_seeds, self._d_pos, jnp.asarray(drafts))
+                    self._d_seeds, self._d_pos, jnp.asarray(drafts),
+                    *self._lora_args())
             else:
                 tgt, acc, nxt, idxs, pos, self.kv.cache = self._verify(
                     self.params, self.kv.cache, self._d_toks,
                     self._d_idxs, self._d_active, self._d_seeds,
-                    self._d_pos, jnp.asarray(drafts))
+                    self._d_pos, jnp.asarray(drafts),
+                    *self._lora_args())
         self._d_toks, self._d_idxs, self._d_pos = nxt, idxs, pos
         tgt_np = np.asarray(tgt)
         acc_np = np.asarray(acc)
@@ -883,6 +1145,17 @@ class Engine:
             # the freshly-owned page row must be on device before any
             # prefill chunk gathers/scatters through it
             self._sync_bt(slot)
+        if self._lora is not None:
+            # pin the tenant's adapter pages and patch the slot's row
+            # BEFORE the prefill chain — token 0 already samples
+            # through the fused epilogue. An unregistered (or None)
+            # tenant gets the zero row: same executable, exact-zero
+            # delta, flag off.
+            lrow, lora_on = self._lora.acquire(req.tenant, slot)
+            self._d_lora_bt = self._d_lora_bt.at[slot].set(
+                jnp.asarray(lrow, jnp.int32))
+            self._d_lora_on = self._d_lora_on.at[slot].set(
+                bool(lora_on))
         prefix = tuple(req.prefix) if req.prefix else ()
         full = self._full_prompt(req)
         key = page = None
@@ -966,6 +1239,7 @@ class Engine:
             self.kv.free(slot)
             if self._paged:
                 self._sync_bt(slot)     # row back to the trash page
+            self._lora_release(slot)
             with self._admit_lock:
                 self._mid_admit = None
                 self._cancel_mid.discard(req.req_id)
@@ -1061,7 +1335,8 @@ class Engine:
                 tok, self.kv.pages = self._prefill(
                     self.params, self.kv.pages, self._d_bt,
                     np.int32(slot), buf, np.int32(idx0 + c * C),
-                    np.int32(seg.size), np.int32(seed))
+                    np.int32(seg.size), np.int32(seed),
+                    *self._lora_args())
                 continue
             install = np.bool_(c == 0 and install_lane is not None)
             lane_arg = (install_lane if install
@@ -1069,7 +1344,8 @@ class Engine:
             tok, self.kv.cache = self._prefill(
                 self.params, self.kv.cache, np.int32(slot), lane_arg,
                 install, buf, np.int32(idx0 + c * C),
-                np.int32(seg.size), np.int32(seed))
+                np.int32(seg.size), np.int32(seed),
+                *self._lora_args())
         return tok
 
     # ---- retirement -----------------------------------------------------
@@ -1115,6 +1391,7 @@ class Engine:
             # garbage every step, and its old pages may be reallocated
             # (or live on as shared prefix pages) immediately
             self._sync_bt(slot_idx)
+        self._lora_release(slot_idx)
         spec = ({"n_drafted": slot.drafted, "n_accepted": slot.accepted}
                 if self._spec else {})
         self._finish(slot.req.req_id, status, reason, produced, **spec)

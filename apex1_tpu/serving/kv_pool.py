@@ -329,6 +329,45 @@ class PagedPrefix:
     last_hit: int = 0
 
 
+class PageAllocator:
+    """Refcounted free-list over a fixed page pool — the page-granular
+    alloc core shared by `PagedKVPool` (K/V pages) and
+    `serving.lora.LoraAdapterStore` (adapter pages).  Page 0 is reserved
+    (the trash/zero page): it is never handed out, and unref of it is a
+    no-op, so all-zero block-table rows are always safe."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self.refs = [0] * self.num_pages
+        self.free_list: List[int] = list(range(1, self.num_pages))
+
+    def take(self) -> int:
+        """Pop the lowest free page with refcount 1."""
+        if not self.free_list:
+            raise RuntimeError(
+                "page pool out of pages — sizing invariant broken")
+        pid = self.free_list.pop(0)
+        self.refs[pid] = 1
+        return pid
+
+    def ref(self, pid: int) -> None:
+        self.refs[pid] += 1
+
+    def unref(self, pid: int) -> None:
+        if pid == 0:
+            return
+        self.refs[pid] -= 1
+        if self.refs[pid] < 0:
+            raise ValueError(f"page {pid} refcount below zero")
+        if self.refs[pid] == 0:
+            self.free_list.append(pid)
+            self.free_list.sort()
+
+    @property
+    def n_free(self) -> int:
+        return len(self.free_list)
+
+
 class PagedKVPool:
     """Page-granular slot allocator + radix-matched prefix store.
 
@@ -389,8 +428,7 @@ class PagedKVPool:
         self.pages = make_cache(self.num_pages, self.page_size, **kw)
         self.block_tables = [[0] * self.pages_per_lane
                              for _ in range(self.max_slots)]
-        self._page_refs = [0] * self.num_pages
-        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._alloc = PageAllocator(self.num_pages)
         self._free: List[int] = list(range(self.max_slots))
         self._slot_prefix: Dict[int, List[tuple]] = {}
         self._prefixes: Dict[tuple, PagedPrefix] = {}
@@ -400,33 +438,33 @@ class PagedKVPool:
 
     # ---- pages ----------------------------------------------------------
 
+    # page alloc delegates to the shared PageAllocator core (also used
+    # by serving.lora.LoraAdapterStore); the legacy private names stay
+    # as views so existing tests/introspection keep working
+
     def _take_page(self) -> int:
-        if not self._free_pages:
-            raise RuntimeError(
-                "paged KV pool out of pages — sizing invariant broken")
-        pid = self._free_pages.pop(0)
-        self._page_refs[pid] = 1
-        return pid
+        return self._alloc.take()
 
     def _ref_page(self, pid: int) -> None:
-        self._page_refs[pid] += 1
+        self._alloc.ref(pid)
 
     def _unref_page(self, pid: int) -> None:
-        if pid == 0:
-            return
-        self._page_refs[pid] -= 1
-        if self._page_refs[pid] < 0:
-            raise ValueError(f"page {pid} refcount below zero")
-        if self._page_refs[pid] == 0:
-            self._free_pages.append(pid)
-            self._free_pages.sort()
+        self._alloc.unref(pid)
 
     def page_refcount(self, pid: int) -> int:
-        return self._page_refs[pid]
+        return self._alloc.refs[pid]
+
+    @property
+    def _page_refs(self) -> List[int]:
+        return self._alloc.refs
+
+    @property
+    def _free_pages(self) -> List[int]:
+        return self._alloc.free_list
 
     @property
     def n_free_pages(self) -> int:
-        return len(self._free_pages)
+        return self._alloc.n_free
 
     # ---- slots ----------------------------------------------------------
 

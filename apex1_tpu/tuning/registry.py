@@ -92,4 +92,18 @@ SPECS: dict[str, KernelSpec] = {spec.name: spec for spec in (
     # vocab); the spec pins its VMEM frame into the shared gate.
     KernelSpec("fused_sample", ("block_v",), ("Vp",), 128,
                CHECKS["fused_sample"]),
+    # chunked preference/distill losses: the tunable is the VOCAB CHUNK
+    # streamed per fori_loop step (the inner Pallas tiles ride the
+    # linear_xent spec above); keyed on padded hidden.
+    KernelSpec("chunked_loss", ("chunk_v",), ("Hp",), 128,
+               CHECKS["chunked_loss"]),
+    # fused SwiGLU/GeGLU MLP: token x ffn tile grid, H untiled (one MXU
+    # dot per operand keeps the reduction order XLA-identical).
+    KernelSpec("fused_swiglu", ("block_t", "block_f"), ("Hp",), 8,
+               CHECKS["fused_swiglu"]),
+    # multi-tenant LoRA decode epilogue: the tunable is the vocab tile
+    # of the gathered B page; rank streams on the grid, so only the
+    # padded hidden/vocab key the entries.
+    KernelSpec("lora_epilogue", ("block_v",), ("Hp", "Vp"), 128,
+               CHECKS["lora_epilogue"]),
 )}

@@ -148,6 +148,56 @@ def fused_sample_check(blocks, dims, _es, budget):
     return est <= budget, est
 
 
+def chunked_loss_check(blocks, dims, es, budget):
+    """Chunked preference/distill losses (`ops.chunked_loss`): the
+    streaming frame is one sublane row-tile of the per-chunk logits —
+    (8, chunk_v) fp32, double-buffered — beside the (8, Hp) hidden rows
+    feeding the chunk matmul and the (8, LANES) packed-stat lanes.
+    The inner Pallas work is priced separately by ``linear_xent_check``
+    (the chunk rides ``shard_stats_packed``); this model bounds the
+    CHUNK choice itself so a mis-tuned chunk_v fails loudly at trace
+    time instead of OOMing the recompute on silicon."""
+    cv = blocks["chunk_v"]
+    hp = dims["Hp"]
+    rows = 8                                       # sublane row tile
+    est = (DB * 4 * rows * cv                      # live chunk logit tile
+           + DB * es * rows * hp                   # hidden rows in
+           + 4 * rows * LANES)                     # packed stat lanes
+    return est <= budget, est
+
+
+def fused_swiglu_check(blocks, dims, es, budget):
+    """Fused SwiGLU/GeGLU MLP (`ops.fused_dense.fused_glu`): x (bt, Hp)
+    block + the two weight (Hp, bf) blocks (double-buffered, input
+    dtype), the (bt, bf) output block, and the two live fp32 (bt, bf)
+    gate/up tiles the elementwise glu consumes before the cast."""
+    bt, bf = blocks["block_t"], blocks["block_f"]
+    hp = dims["Hp"]
+    est = (DB * es * (bt * hp + 2 * hp * bf)       # x, w_gate, w_up in
+           + DB * es * bt * bf                     # out block
+           + 2 * 4 * bt * bf)                      # fp32 g and u tiles
+    return est <= budget, est
+
+
+def lora_epilogue_check(blocks, dims, es, budget):
+    """Multi-tenant LoRA decode epilogue (`ops.lora_epilogue.lora_delta`):
+    per grid step one gathered A page (sublane-padded (8, Hp)) and one
+    B page vocab tile (8, block_v), both double-buffered in page dtype,
+    beside the (8, Hp) hidden row, the (8, block_v) delta output block
+    and its fp32 accumulator scratch. Rank is a GRID axis (pages stream
+    one at a time through the block-table gather), so it never enters
+    the frame — only Hp and block_v do."""
+    bv = blocks["block_v"]
+    hp = dims["Hp"]
+    rows = 8                                       # sublane row tile
+    est = (DB * es * rows * hp                     # A page block
+           + DB * es * rows * bv                   # B page vocab tile
+           + DB * es * rows * hp                   # hidden row in
+           + DB * es * rows * bv                   # delta out block
+           + 4 * rows * bv)                        # fp32 accumulator
+    return est <= budget, est
+
+
 def int8_check(blocks, dims, _es, budget):
     """int8 decode GEMM at the kernel's worst-case row count (T <= 1024,
     ``ops/quantized._aligned_for_kernel``): bf16 x block, int8 w block
@@ -203,6 +253,9 @@ CHECKS: dict[str, object] = {
     "int8_matmul": int8_check,
     "paged_decode": paged_decode_check,
     "fused_sample": fused_sample_check,
+    "chunked_loss": chunked_loss_check,
+    "fused_swiglu": fused_swiglu_check,
+    "lora_epilogue": lora_epilogue_check,
 }
 
 

@@ -1,0 +1,76 @@
+"""Adapter-page publish protocol — GOLDEN fixture (must lint clean).
+
+A structural model of the multi-tenant LoRA control plane's device-side
+adapter-page publish loop (`serving.lora.LoraAdapterStore.register`,
+phase 1): each grid step stages one adapter page payload in a VMEM
+staging slot and DMAs it into the device-visible page pool,
+double-buffered across two slots so the next page can be staged while
+the previous publish drains.  The property under test is slot-reuse
+ordering: the write that re-stages a slot is program-ordered AFTER the
+semaphore wait that retires the publish still reading that slot (a
+local async copy delivers +2 on its semaphore — send and recv halves —
+so the reuse wait consumes 2).
+
+The paired ``lora_page_publish_torn_page_bug.py`` fixture moves that
+write above the wait: the in-flight DMA can then read a half-updated
+page payload — a decode step whose block-table row already names the
+page would gather torn adapter weights, exactly the torn-publish race
+the store's write-payloads-then-publish-row discipline exists to keep
+off the host path.  This file is the clean half of the pair;
+graftlint's APX2xx checker (``lint_sources(..., kernels=True)``) must
+report NO findings on it.
+
+Fixture only — never imported by the library; exercised from
+``tests/test_lint_kernels.py::TestLoraPagePublishFixtures``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(page_ref, o_ref, pg_stage, pg_pool, pub_sem):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    slot = jax.lax.rem(t, 2)
+    nxt = jax.lax.rem(t + 1, 2)
+
+    def publish(s):
+        return pltpu.make_async_copy(
+            pg_stage.at[s], pg_pool.at[s], pub_sem.at[s])
+
+    # License slot reuse: the publish started two steps ago from this
+    # slot must have fully retired before the payload is rewritten.
+    @pl.when(t >= 2)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+    pg_stage[slot] = page_ref[...]
+    publish(slot).start()
+
+    o_ref[...] = page_ref[...]
+
+    # Drain: the last two publishes are still in flight at exit.
+    @pl.when(t == T - 1)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+        @pl.when(T > 1)
+        def _():
+            pltpu.semaphore_wait(pub_sem.at[nxt], 2)
+
+
+def publish_adapter_pages(pages, n_steps):
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, 128), jnp.float32),
+            pltpu.VMEM((2, 8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(pages)

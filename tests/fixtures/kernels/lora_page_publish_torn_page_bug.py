@@ -1,0 +1,67 @@
+"""Adapter-page publish protocol — BUG fixture (torn page publish).
+
+The one-moved-statement mutation of ``lora_page_publish_golden.py``:
+the staging write that re-fills a slot with the next adapter page
+payload has been hoisted ABOVE the semaphore wait that licenses slot
+reuse.  The publish DMA started two steps ago may still be reading the
+slot when it is overwritten, so the page that lands in the
+device-visible pool can interleave old and new payload rows — a decode
+step whose LoRA block-table row already names that page gathers torn
+adapter weights.  graftlint's APX2xx bounded model checker must flag
+exactly this line as APX202 (write to a buffer a DMA is still reading
+it) at ring size 3.
+
+Fixture only — never imported by the library; exercised from
+``tests/test_lint_kernels.py::TestLoraPagePublishFixtures``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(page_ref, o_ref, pg_stage, pg_pool, pub_sem):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    slot = jax.lax.rem(t, 2)
+    nxt = jax.lax.rem(t + 1, 2)
+
+    def publish(s):
+        return pltpu.make_async_copy(
+            pg_stage.at[s], pg_pool.at[s], pub_sem.at[s])
+
+    pg_stage[slot] = page_ref[...]   # BUG: torn adapter-page publish —
+    #                                  the publish from two steps ago
+    #                                  may still be reading this slot
+
+    @pl.when(t >= 2)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+    publish(slot).start()
+
+    o_ref[...] = page_ref[...]
+
+    @pl.when(t == T - 1)
+    def _():
+        pltpu.semaphore_wait(pub_sem.at[slot], 2)
+
+        @pl.when(T > 1)
+        def _():
+            pltpu.semaphore_wait(pub_sem.at[nxt], 2)
+
+
+def publish_adapter_pages(pages, n_steps):
+    return pl.pallas_call(
+        _kernel,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, 128), jnp.float32),
+            pltpu.VMEM((2, 8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(pages)

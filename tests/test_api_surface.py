@@ -20,7 +20,12 @@ SURFACE = {
         "apply_rotary_pos_emb", "rope_tables", "set_impl", "force_impl"],
     "apex1_tpu.ops.fused_dense": [
         "FusedDense", "FusedDenseGeluDense", "MLP", "fused_dense",
-        "fused_dense_gelu_dense"],
+        "fused_dense_gelu_dense", "fused_glu", "check_glu_geometry"],
+    "apex1_tpu.ops.chunked_loss": [
+        "chunked_logprob", "chunked_dpo_loss", "chunked_orpo_loss",
+        "chunked_kl_loss", "check_chunk_geometry"],
+    "apex1_tpu.ops.lora_epilogue": ["lora_delta", "check_lora_geometry"],
+    "apex1_tpu.serving.lora": ["LoraAdapterStore"],
     "apex1_tpu.ops.attention": ["flash_attention", "fmha"],
     "apex1_tpu.ops.stochastic": [
         "fused_bias_dropout_add", "fused_dropout_add_layer_norm",
@@ -157,7 +162,9 @@ SURFACE = {
         "CHECKS", "budget_bytes", "flash_check", "row_check",
         "linear_xent_check", "cm_check", "agf_check", "int8_check",
         "rdma_check", "rdma_slot_bytes", "static_frame_bytes",
-        "paged_decode_check", "fused_sample_check"],
+        "paged_decode_check", "fused_sample_check",
+        "chunked_loss_check", "fused_swiglu_check",
+        "lora_epilogue_check"],
     "apex1_tpu.perf_model": [
         "roofline", "kernel_cases", "flash_flops_bytes",
         "linear_xent_flops", "ring_attention_comms",

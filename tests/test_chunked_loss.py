@@ -1,0 +1,367 @@
+"""Chunked preference/distill losses (``ops/chunked_loss.py``) — parity
+vs naive fp32 references that DO materialize the (T, V) logits tensor,
+plus the memory contract the op exists for: AOT-compiled grads never
+allocate a full-logits buffer (asserted on the lowered HLO and on
+``memory_analysis``), while the naive formulation provably does.
+Reference capability lineage: Liger Kernel's chunked fused-linear losses
+(arXiv 2410.10989), rebuilt on ``linear_xent``'s online-softmax stats."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.ops import _common
+from apex1_tpu.ops.chunked_loss import (
+    check_chunk_geometry, chunked_dpo_loss, chunked_kl_loss,
+    chunked_logprob, chunked_orpo_loss)
+
+FP32_TOL = dict(rtol=2e-5, atol=2e-5)
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Naive references — materialized logits, fp32 throughout
+# ---------------------------------------------------------------------------
+
+
+def _naive_logprob(x, w, targets, num_classes=None):
+    logits = jnp.einsum("...h,vh->...v", x.astype(jnp.float32),
+                        w.astype(jnp.float32))
+    if num_classes is not None:
+        valid = jnp.arange(w.shape[0]) < num_classes
+        logits = jnp.where(valid, logits, _NEG)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        lp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def _naive_seq_logp(h, w, t, padding_idx=None, num_classes=None):
+    lp = _naive_logprob(h, w, t, num_classes)
+    mask = (jnp.ones(t.shape, jnp.float32) if padding_idx is None
+            else (t != padding_idx).astype(jnp.float32))
+    return jnp.sum(lp * mask, axis=-1), jnp.sum(mask, axis=-1)
+
+
+def _naive_dpo(hc, hr, w, tc, tr, rc, rr, beta=0.1, padding_idx=None):
+    sc, _ = _naive_seq_logp(hc, w, tc, padding_idx)
+    sr, _ = _naive_seq_logp(hr, w, tr, padding_idx)
+    return -jnp.mean(jax.nn.log_sigmoid(beta * ((sc - sr) - (rc - rr))))
+
+
+def _naive_orpo(hc, hr, w, tc, tr, lam=0.1, padding_idx=None):
+    sc, lc = _naive_seq_logp(hc, w, tc, padding_idx)
+    sr, lr = _naive_seq_logp(hr, w, tr, padding_idx)
+    lc, lr = jnp.maximum(lc, 1.0), jnp.maximum(lr, 1.0)
+
+    def odds(avg):
+        p = jnp.clip(jnp.exp(avg), None, 1.0 - 1e-6)
+        return avg - jnp.log1p(-p)
+
+    ratio = odds(sc / lc) - odds(sr / lr)
+    return (jnp.mean(-sc / lc)
+            + lam * jnp.mean(-jax.nn.log_sigmoid(ratio)))
+
+
+def _naive_kl(xs, ws, xt, wt, temperature=1.0, num_classes=None):
+    ls = jnp.einsum("...h,vh->...v", xs.astype(jnp.float32),
+                    ws.astype(jnp.float32)) / temperature
+    lt = jnp.einsum("...h,vh->...v", xt.astype(jnp.float32),
+                    wt.astype(jnp.float32)) / temperature
+    if num_classes is not None:
+        valid = jnp.arange(ws.shape[0]) < num_classes
+        ls = jnp.where(valid, ls, _NEG)
+        lt = jnp.where(valid, lt, _NEG)
+    pt = jax.nn.softmax(lt, axis=-1)
+    return jnp.sum(pt * (jax.nn.log_softmax(lt, axis=-1)
+                         - jax.nn.log_softmax(ls, axis=-1)), axis=-1)
+
+
+def _mk(rng, *shape):
+    return jnp.asarray(rng.normal(size=shape) * 0.3, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# chunked_logprob
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedLogprob:
+    @pytest.mark.parametrize("chunk_v", [128, 256])
+    def test_parity_and_grads(self, rng, chunk_v):
+        B, S, H, V = 2, 12, 64, 517  # ragged V exercises tail masking
+        x = _mk(rng, B, S, H)
+        w = _mk(rng, V, H)
+        t = jnp.asarray(rng.integers(0, V, size=(B, S)), jnp.int32)
+
+        lp = chunked_logprob(x, w, t, chunk_v=chunk_v)
+        ref = _naive_logprob(x, w, t)
+        assert lp.shape == (B, S) and lp.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ref),
+                                   **FP32_TOL)
+
+        gp = jax.grad(lambda x, w: jnp.sum(
+            chunked_logprob(x, w, t, chunk_v=chunk_v)), argnums=(0, 1))(
+            x, w)
+        gg = jax.grad(lambda x, w: jnp.sum(
+            _naive_logprob(x, w, t)), argnums=(0, 1))(x, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_chunk_size_invariance(self, rng):
+        T, H, V = 16, 32, 512
+        x, w = _mk(rng, T, H), _mk(rng, V, H)
+        t = jnp.asarray(rng.integers(0, V, size=(T,)), jnp.int32)
+        base = chunked_logprob(x, w, t, chunk_v=512)
+        for cv in (128, 256):
+            np.testing.assert_allclose(
+                np.asarray(chunked_logprob(x, w, t, chunk_v=cv)),
+                np.asarray(base), rtol=1e-6, atol=1e-6)
+
+    def test_num_classes_masks_pad_vocab(self, rng):
+        T, H, V, k = 8, 32, 384, 300
+        x, w = _mk(rng, T, H), _mk(rng, V, H)
+        t = jnp.asarray(rng.integers(0, k, size=(T,)), jnp.int32)
+        lp = chunked_logprob(x, w, t, chunk_v=128, num_classes=k)
+        ref = _naive_logprob(x, w, t, num_classes=k)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ref),
+                                   **FP32_TOL)
+
+    def test_pallas_path_matches_xla_path(self, rng):
+        T, H, V = 16, 64, 512
+        x, w = _mk(rng, T, H), _mk(rng, V, H)
+        t = jnp.asarray(rng.integers(0, V, size=(T,)), jnp.int32)
+        def loss(x, w, impl):
+            with _common.force_impl(impl):
+                return chunked_logprob(x, w, t, chunk_v=256,
+                                       block_t=8, block_v=128)
+
+        np.testing.assert_allclose(
+            np.asarray(loss(x, w, "pallas")),
+            np.asarray(loss(x, w, "xla")), **FP32_TOL)
+        gp = jax.grad(lambda x, w: jnp.sum(loss(x, w, "pallas")),
+                      argnums=(0, 1))(x, w)
+        gg = jax.grad(lambda x, w: jnp.sum(loss(x, w, "xla")),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_geometry_negatives_raise(self):
+        with pytest.raises(ValueError, match="multiple"):
+            check_chunk_geometry(100, 64)
+        with pytest.raises(ValueError, match="VMEM"):
+            check_chunk_geometry(1 << 24, 8192)
+        # and through the public entry point
+        with pytest.raises(ValueError, match="multiple"):
+            chunked_logprob(jnp.zeros((4, 32)), jnp.zeros((256, 32)),
+                            jnp.zeros((4,), jnp.int32), chunk_v=100)
+
+
+# ---------------------------------------------------------------------------
+# DPO / ORPO
+# ---------------------------------------------------------------------------
+
+
+class TestPreferenceLosses:
+    @pytest.mark.parametrize("padding_idx", [None, 0])
+    def test_dpo_parity_and_grads(self, rng, padding_idx):
+        B, S, H, V = 3, 10, 48, 389
+        hc, hr = _mk(rng, B, S, H), _mk(rng, B, S, H)
+        w = _mk(rng, V, H)
+        tc = np.asarray(rng.integers(1, V, size=(B, S)), np.int32)
+        tr = np.asarray(rng.integers(1, V, size=(B, S)), np.int32)
+        if padding_idx is not None:
+            tc[:, -3:] = padding_idx
+            tr[:, -2:] = padding_idx
+        tc, tr = jnp.asarray(tc), jnp.asarray(tr)
+        rc = jnp.asarray(rng.normal(size=(B,)) * 2.0, jnp.float32)
+        rr = jnp.asarray(rng.normal(size=(B,)) * 2.0, jnp.float32)
+
+        def fused(hc, hr, w):
+            return chunked_dpo_loss(hc, hr, w, tc, tr, rc, rr, beta=0.25,
+                                    padding_idx=padding_idx, chunk_v=128)
+
+        def gold(hc, hr, w):
+            return _naive_dpo(hc, hr, w, tc, tr, rc, rr, beta=0.25,
+                              padding_idx=padding_idx)
+
+        np.testing.assert_allclose(np.asarray(fused(hc, hr, w)),
+                                   np.asarray(gold(hc, hr, w)), **FP32_TOL)
+        gp = jax.grad(fused, argnums=(0, 1, 2))(hc, hr, w)
+        gg = jax.grad(gold, argnums=(0, 1, 2))(hc, hr, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_orpo_parity_and_grads(self, rng):
+        B, S, H, V = 2, 8, 32, 261
+        hc, hr = _mk(rng, B, S, H), _mk(rng, B, S, H)
+        w = _mk(rng, V, H)
+        tc = jnp.asarray(rng.integers(1, V, size=(B, S)), jnp.int32)
+        tr = jnp.asarray(rng.integers(1, V, size=(B, S)), jnp.int32)
+
+        def fused(hc, hr, w):
+            return chunked_orpo_loss(hc, hr, w, tc, tr, lam=0.3,
+                                     chunk_v=128)
+
+        def gold(hc, hr, w):
+            return _naive_orpo(hc, hr, w, tc, tr, lam=0.3)
+
+        np.testing.assert_allclose(np.asarray(fused(hc, hr, w)),
+                                   np.asarray(gold(hc, hr, w)), **FP32_TOL)
+        gp = jax.grad(fused, argnums=(0, 1, 2))(hc, hr, w)
+        gg = jax.grad(gold, argnums=(0, 1, 2))(hc, hr, w)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+
+# ---------------------------------------------------------------------------
+# KL distillation
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedKL:
+    @pytest.mark.parametrize("temperature", [1.0, 2.5])
+    def test_parity_and_student_grads(self, rng, temperature):
+        B, S, H, V = 2, 6, 40, 453
+        xs, xt = _mk(rng, B, S, H), _mk(rng, B, S, H)
+        ws, wt = _mk(rng, V, H), _mk(rng, V, H)
+
+        kl = chunked_kl_loss(xs, ws, xt, wt, temperature=temperature,
+                             chunk_v=128)
+        ref = _naive_kl(xs, ws, xt, wt, temperature=temperature)
+        assert kl.shape == (B, S) and kl.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(kl), np.asarray(ref),
+                                   **FP32_TOL)
+
+        gp = jax.grad(lambda xs, ws: jnp.sum(chunked_kl_loss(
+            xs, ws, xt, wt, temperature=temperature, chunk_v=128)),
+            argnums=(0, 1))(xs, ws)
+        gg = jax.grad(lambda xs, ws: jnp.sum(_naive_kl(
+            xs, ws, xt, wt, temperature=temperature)),
+            argnums=(0, 1))(xs, ws)
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_teacher_is_stop_grad(self, rng):
+        T, H, V = 8, 32, 256
+        xs, xt = _mk(rng, T, H), _mk(rng, T, H)
+        ws, wt = _mk(rng, V, H), _mk(rng, V, H)
+        gt = jax.grad(lambda xt, wt: jnp.sum(chunked_kl_loss(
+            xs, ws, xt, wt, chunk_v=128)), argnums=(0, 1))(xt, wt)
+        for g in gt:
+            assert not np.any(np.asarray(g))
+
+    def test_vocab_mismatch_raises(self):
+        with pytest.raises(ValueError, match="one vocab"):
+            chunked_kl_loss(jnp.zeros((4, 32)), jnp.zeros((256, 32)),
+                            jnp.zeros((4, 32)), jnp.zeros((384, 32)),
+                            chunk_v=128)
+
+
+# ---------------------------------------------------------------------------
+# The memory contract — AOT proof that logits are never materialized
+# ---------------------------------------------------------------------------
+
+_B, _S, _H, _V, _CV = 4, 64, 32, 4096, 256
+_BT = _B * _S  # 256 tokens → full logits = 1,048,576 f32 elements
+
+
+def _f32_buffer_elems(hlo_text):
+    """Element counts of every f32 buffer shape in the lowered HLO."""
+    out = []
+    for dims in re.findall(r"f32\[([0-9,]+)\]", hlo_text):
+        n = 1
+        for d in dims.split(","):
+            n *= int(d)
+        out.append(n)
+    return out
+
+
+def _compile_grad(loss_fn, *args):
+    return jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2))).lower(
+        *args).compile()
+
+
+class TestNoLogitsMaterialization:
+    """The acceptance criterion: AOT analysis proves chunked DPO's
+    compiled grad never allocates a [B·S, V]-sized fp32 buffer, while
+    the naive formulation (positive control) provably does.  Runs on
+    the CPU backend — buffer shapes in lowered HLO are backend-agnostic
+    facts about the program."""
+
+    def _inputs(self, rng):
+        hc = _mk(rng, _B, _S, _H)
+        hr = _mk(rng, _B, _S, _H)
+        w = _mk(rng, _V, _H)
+        tc = jnp.asarray(rng.integers(0, _V, size=(_B, _S)), jnp.int32)
+        tr = jnp.asarray(rng.integers(0, _V, size=(_B, _S)), jnp.int32)
+        rc = jnp.zeros((_B,), jnp.float32)
+        rr = jnp.zeros((_B,), jnp.float32)
+        return hc, hr, w, tc, tr, rc, rr
+
+    def test_chunked_dpo_never_materializes_logits(self, rng):
+        hc, hr, w, tc, tr, rc, rr = self._inputs(rng)
+
+        def loss(hc, hr, w):
+            return chunked_dpo_loss(hc, hr, w, tc, tr, rc, rr,
+                                    chunk_v=_CV)
+
+        compiled = _compile_grad(loss, hc, hr, w)
+        big = [n for n in _f32_buffer_elems(compiled.as_text())
+               if n >= _BT * _V]
+        assert not big, (
+            f"chunked DPO grad allocates full-logits-sized f32 buffers: "
+            f"{big} (≥ {_BT * _V} elements)")
+        mem = compiled.memory_analysis()
+        if mem is not None and mem.temp_size_in_bytes:
+            assert mem.temp_size_in_bytes < _BT * _V * 4, (
+                f"temp {mem.temp_size_in_bytes} B ≥ one full logits "
+                f"tensor ({_BT * _V * 4} B)")
+
+    def test_naive_dpo_does_materialize(self, rng):
+        """Positive control: the same geometry through materialized
+        logits shows a ≥ [B·S, V] f32 buffer — proving the scan above
+        actually detects what it claims to rule out."""
+        hc, hr, w, tc, tr, rc, rr = self._inputs(rng)
+
+        def loss(hc, hr, w):
+            return _naive_dpo(hc, hr, w, tc, tr, rc, rr)
+
+        compiled = _compile_grad(loss, hc, hr, w)
+        big = [n for n in _f32_buffer_elems(compiled.as_text())
+               if n >= _BT * _V]
+        assert big, "positive control failed: no full-logits buffer found"
+
+    def test_chunked_logprob_grad_never_materializes(self, rng):
+        x = _mk(rng, _BT, _H)
+        w = _mk(rng, _V, _H)
+        t = jnp.asarray(rng.integers(0, _V, size=(_BT,)), jnp.int32)
+
+        def loss(x, w, _unused):
+            return jnp.sum(chunked_logprob(x, w, t, chunk_v=_CV))
+
+        compiled = _compile_grad(loss, x, w, jnp.zeros(()))
+        big = [n for n in _f32_buffer_elems(compiled.as_text())
+               if n >= _BT * _V]
+        assert not big, f"full-logits-sized buffers: {big}"
+
+    def test_chunked_kl_grad_never_materializes(self, rng):
+        xs = _mk(rng, _BT, _H)
+        xt = _mk(rng, _BT, _H)
+        ws = _mk(rng, _V, _H)
+        wt = _mk(rng, _V, _H)
+
+        def loss(xs, ws, _unused):
+            return jnp.sum(chunked_kl_loss(xs, ws, xt, wt, chunk_v=_CV))
+
+        compiled = _compile_grad(loss, xs, ws, jnp.zeros(()))
+        big = [n for n in _f32_buffer_elems(compiled.as_text())
+               if n >= _BT * _V]
+        assert not big, f"full-logits-sized buffers: {big}"

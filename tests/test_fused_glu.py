@@ -1,0 +1,94 @@
+"""Fused SwiGLU/GeGLU (``ops.fused_dense.fused_glu``) — the contract the
+``LlamaConfig.fused_mlp`` flag rides on: the XLA path is BITWISE the
+inline gate/up expression (flag flip is a no-op off-TPU), the Pallas
+path matches within fp32 tile tolerance, grads recompute (activations
+never saved), and geometry negatives raise loudly at trace time."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex1_tpu.ops import _common
+from apex1_tpu.ops.fused_dense import check_glu_geometry, fused_glu
+
+FP32_TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def _inline(x, wg, wu, activation):
+    act = (jax.nn.silu if activation == "silu"
+           else lambda v: jax.nn.gelu(v, approximate=True))
+    return (act(x @ wg) * (x @ wu)).astype(x.dtype)
+
+
+def _mk(rng, *shape, dtype=jnp.float32):
+    return jnp.asarray(rng.normal(size=shape) * 0.3, dtype)
+
+
+class TestFusedGLU:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("activation", ["silu", "gelu"])
+    def test_xla_path_bitwise_vs_inline(self, rng, dtype, activation):
+        B, S, H, F = 2, 9, 48, 112
+        x = _mk(rng, B, S, H, dtype=dtype)
+        wg = _mk(rng, H, F, dtype=dtype)
+        wu = _mk(rng, H, F, dtype=dtype)
+        with _common.force_impl("xla"):
+            out = fused_glu(x, wg, wu, activation=activation)
+        ref = _inline(x, wg, wu, activation)
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_pallas_path_matches(self, rng):
+        T, H, F = 24, 64, 256
+        x, wg, wu = _mk(rng, T, H), _mk(rng, H, F), _mk(rng, H, F)
+        with _common.force_impl("pallas"):
+            out = fused_glu(x, wg, wu, block_t=8, block_f=128)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_inline(x, wg, wu, "silu")),
+            **FP32_TOL)
+
+    def test_pallas_grads_match_xla(self, rng):
+        T, H, F = 16, 32, 128
+
+        def run(x, wg, wu, impl):
+            with _common.force_impl(impl):
+                return jnp.sum(fused_glu(x, wg, wu, block_t=8,
+                                         block_f=128) ** 2)
+
+        x, wg, wu = _mk(rng, T, H), _mk(rng, H, F), _mk(rng, H, F)
+        gp = jax.grad(run, argnums=(0, 1, 2))(x, wg, wu, "pallas")
+        gg = jax.grad(run, argnums=(0, 1, 2))(x, wg, wu, "xla")
+        for a, b in zip(gp, gg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **FP32_TOL)
+
+    def test_bad_activation_raises(self):
+        with pytest.raises(ValueError, match="activation"):
+            fused_glu(jnp.zeros((4, 8)), jnp.zeros((8, 16)),
+                      jnp.zeros((8, 16)), activation="relu")
+
+    def test_geometry_negatives_raise(self):
+        with pytest.raises(ValueError, match="multiple of 8"):
+            check_glu_geometry(7, 128, 64)
+        with pytest.raises(ValueError, match="multiple of 128"):
+            check_glu_geometry(8, 100, 64)
+        with pytest.raises(ValueError, match="VMEM"):
+            check_glu_geometry(512, 1 << 16, 8192)
+
+
+class TestLlamaFusedMlpFlag:
+    def test_flag_is_bitwise_neutral_off_tpu(self, rng):
+        from apex1_tpu.models.llama import Llama, LlamaConfig
+
+        tokens = jnp.asarray(rng.integers(0, 97, size=(2, 8)), jnp.int32)
+
+        def logits(fused):
+            cfg = LlamaConfig.tiny(vocab_size=97, fused_mlp=fused)
+            model = Llama(cfg)
+            params = model.init(jax.random.PRNGKey(0), tokens)
+            return model.apply(params, tokens)
+
+        a = np.asarray(logits(False))
+        b = np.asarray(logits(True))
+        np.testing.assert_array_equal(a, b)

@@ -791,6 +791,31 @@ def _orig_fused_sample(blocks, dims, _es, budget):
     return est <= budget, est
 
 
+# frozen as-landed copies of the PR 19 chunked-loss / fused-GLU / LoRA
+# epilogue formulas (same no-silent-drift contract as _orig_paged)
+def _orig_chunked_loss(blocks, dims, es, budget):
+    cv = blocks["chunk_v"]
+    hp = dims["Hp"]
+    est = _D * 4 * 8 * cv + _D * es * 8 * hp + 4 * 8 * _L
+    return est <= budget, est
+
+
+def _orig_fused_swiglu(blocks, dims, es, budget):
+    bt, bf = blocks["block_t"], blocks["block_f"]
+    hp = dims["Hp"]
+    est = (_D * es * (bt * hp + 2 * hp * bf) + _D * es * bt * bf
+           + 2 * 4 * bt * bf)
+    return est <= budget, est
+
+
+def _orig_lora_epilogue(blocks, dims, es, budget):
+    bv = blocks["block_v"]
+    hp = dims["Hp"]
+    est = (_D * es * 8 * hp + _D * es * 8 * bv + _D * es * 8 * hp
+           + _D * es * 8 * bv + 4 * 8 * bv)
+    return est <= budget, est
+
+
 class TestVmemModelShared:
     _GRID = {
         "flash_attention": (_orig_flash,
@@ -845,6 +870,20 @@ class TestVmemModelShared:
                          [{"block_v": v}
                           for v in (128, 1024, 25216, 50432, 1 << 20)],
                          [{"Vp": 50432}]),
+        "chunked_loss": (_orig_chunked_loss,
+                         [{"chunk_v": v}
+                          for v in (128, 1024, 8192, 65536, 1 << 20)],
+                         [{"Hp": h} for h in (128, 768, 4096, 8192)]),
+        "fused_swiglu": (_orig_fused_swiglu,
+                         [{"block_t": t, "block_f": f}
+                          for t in (8, 128, 512)
+                          for f in (128, 512, 2048)],
+                         [{"Hp": h} for h in (128, 4096, 8192)]),
+        "lora_epilogue": (_orig_lora_epilogue,
+                          [{"block_v": v}
+                           for v in (128, 2048, 50432, 1 << 20)],
+                          [{"Hp": h, "Vp": 50432}
+                           for h in (128, 4096, 8192)]),
     }
 
     def test_registry_gating_bit_identical(self):
@@ -1066,5 +1105,52 @@ class TestPagedBtPublishFixtures:
 
         g = code_lines("paged_bt_publish_golden.py")
         b = code_lines("paged_bt_publish_torn_bt_bug.py")
+        assert sorted(g) == sorted(b)
+        assert g != b
+
+
+class TestLoraPagePublishFixtures:
+    """ISSUE 19's protocol pair: the double-buffered adapter-page
+    publish loop behind the multi-tenant LoRA store
+    (serving.lora.LoraAdapterStore.register phase 1), as on-disk
+    fixtures under tests/fixtures/kernels/. Same race class as the
+    block-table pair — a staging slot rewritten while the publish DMA
+    from two steps ago is still reading it — but the torn payload here
+    is adapter weights, not page indices: a decode step whose LoRA
+    block-table row already names the page gathers a half-updated
+    adapter. The golden/bug halves diff as ONE moved statement."""
+
+    def test_golden_publish_clean(self, monkeypatch):
+        import apex1_tpu.lint.kernels as K
+        monkeypatch.setattr(K, "RING_SIZES", (1, 2, 3, 4))
+        src = _load_fixture("lora_page_publish_golden.py")
+        res = run_lint(src)
+        assert not apx2(res), [f.render() for f in res.unsuppressed()]
+
+    def test_torn_page_publish_flagged(self, monkeypatch):
+        import apex1_tpu.lint.kernels as K
+        monkeypatch.setattr(K, "RING_SIZES", (1, 2, 3))
+        src = _load_fixture("lora_page_publish_torn_page_bug.py")
+        res = run_lint(src)
+        assert apx2(res) == {"APX202"}, \
+            [f.render() for f in res.unsuppressed()]
+        wline = line_of(src, "BUG: torn adapter-page publish")
+        torn = [f for f in res.unsuppressed() if f.rule == "APX202"]
+        assert len(torn) == 1, [f.render() for f in torn]
+        assert torn[0].line == wline
+        assert "still reading it" in torn[0].message
+
+    def test_pair_differs_by_one_moved_statement(self):
+        """The pair's contract: identical protocols modulo the write
+        placement — so the flagged defect IS the moved line, not an
+        unrelated drift between the files."""
+        def code_lines(name):
+            body = _load_fixture(name).split('"""', 2)[2]
+            lines = [ln.split("#")[0].rstrip()
+                     for ln in body.splitlines()]
+            return [ln for ln in lines if ln.strip()]
+
+        g = code_lines("lora_page_publish_golden.py")
+        b = code_lines("lora_page_publish_torn_page_bug.py")
         assert sorted(g) == sorted(b)
         assert g != b

@@ -290,6 +290,65 @@ def main():
                 print(f"  FAIL paged geometry gate: page={bad} must "
                       f"raise ValueError", flush=True)
 
+        # chunked preference/distill losses, fused GLU, LoRA epilogue
+        # (ISSUE 19): the chunked-loss VJP recomputes per vocab chunk
+        # through the linear_xent stats kernels; fused_glu is the llama
+        # fused_mlp tile; lora_delta is the multi-tenant serving
+        # epilogue's scalar-prefetched page gather. Both dtypes — the
+        # registry tables price each (kernel, dtype) separately.
+        from apex1_tpu.ops.chunked_loss import (check_chunk_geometry,
+                                                chunked_logprob)
+        from apex1_tpu.ops.fused_dense import (check_glu_geometry,
+                                               fused_glu)
+        from apex1_tpu.ops.lora_epilogue import (check_lora_geometry,
+                                                 lora_delta)
+
+        T_c, H_c, V_c = 8 * 1024, 768, 50432
+        R_l, Hd_l, V_l = 8, 4096, 50432
+        n_lp = 1 + 4 * R_l
+        for dt in (jnp.bfloat16, jnp.float32):
+            tag = jnp.dtype(dt).name
+            check(f"chunked_logprob gpt2 ({T_c},{H_c},{V_c}) cv8192 "
+                  f"{tag} fwd+bwd",
+                  lambda x, w: chunked_logprob(
+                      x, w, jnp.zeros((x.shape[0],), jnp.int32),
+                      chunk_v=8192, num_classes=V_c - 200),
+                  [(T_c, H_c), (V_c, H_c)], dtypes=dt,
+                  in_specs=(P("dp"), P()), grad=True)
+            check(f"fused_glu llama mlp (8192,4096,14336) {tag} "
+                  f"fwd+bwd", fused_glu,
+                  [(8192, 4096), (4096, 14336), (4096, 14336)],
+                  dtypes=dt, in_specs=(P("dp"), P(), P()), grad=True)
+            check(f"lora_delta epilogue (8,H4096,V50432,r8) {tag}",
+                  lora_delta,
+                  [(8, Hd_l), (n_lp, Hd_l), (n_lp, V_l), (8, R_l)],
+                  dtypes=[dt, jnp.float32, jnp.float32, jnp.int32],
+                  in_specs=(P("dp"), P(), P(), P("dp")))
+        # loud-failure half: misaligned and over-budget geometries for
+        # all three new kernels must RAISE at trace time
+        for nm, bad_fn in (
+                ("chunk_v=100 misaligned",
+                 lambda: check_chunk_geometry(100, 768)),
+                ("chunk_v=1<<24 over-budget",
+                 lambda: check_chunk_geometry(1 << 24, 8192)),
+                ("glu block_t=7 misaligned",
+                 lambda: check_glu_geometry(7, 128, 4096)),
+                ("glu block_f=1<<16 over-budget",
+                 lambda: check_glu_geometry(512, 1 << 16, 8192)),
+                ("lora block_v=100 misaligned",
+                 lambda: check_lora_geometry(8, 4096, 50432, 100)),
+                ("lora block_v=1<<20 over-budget",
+                 lambda: check_lora_geometry(8, 8192, 50432, 1 << 20))):
+            try:
+                bad_fn()
+            except ValueError as e:
+                print(f"  OK   geometry {nm} raises: {str(e)[:60]}",
+                      flush=True)
+            else:
+                ok = False
+                print(f"  FAIL geometry gate: {nm} must raise "
+                      f"ValueError", flush=True)
+
     if args.steps:
         print(f"== full bench train steps (single device, exactly what "
               f"bench.py runs), {args.topology} ==", flush=True)

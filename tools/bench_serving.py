@@ -55,6 +55,17 @@ disagg breakdown). CPU-proxy caveat: these rows price the COMPOSITE
 ops; what the proxy cannot measure (kernel fusion wins, HBM page
 streaming) is spelled out in docs/paged_decode.md.
 
+The LoRA A/B (ISSUE 19, ``--lora-tenants N``) prices multi-tenancy at
+the peak load: the same offered load through a LoRA-armed engine with
+every request on ONE adapter (single-tenant) vs round-robin across N
+adapters (multi-tenant), measured adjacent so the ratio isolates the
+cross-tenant page gather; ``lora_vs_dense`` prices the fused adapter
+epilogue itself against the plain head. Token parity is asserted on
+every rep of both rows against per-tenant SOLO runs (each
+(prompt, tenant) pair alone through the same engine config) — the
+tier-1 mixed-batch bitwise criterion re-asserted at bench scale, so
+the A/B prices the epilogue, never correctness.
+
 ``--out FILE`` banks the accumulating record via
 ``manifest.atomic_write_json`` after EVERY sweep point (kill-safe,
 like bench.py --out): an interrupted sweep keeps each completed point.
@@ -121,6 +132,12 @@ def main():
                          "attribution at the peak load")
     ap.add_argument("--phase-reps", type=int, default=5,
                     help="timing reps per attribution phase")
+    ap.add_argument("--lora-tenants", type=int, default=0,
+                    help="multi-tenant LoRA A/B at the peak load: "
+                         "single-tenant vs N adapters round-robin, "
+                         "token parity vs per-tenant solo runs on "
+                         "every rep (0 disables the axis)")
+    ap.add_argument("--lora-rank", type=int, default=4)
     ap.add_argument("--replicas", type=int, nargs="*", default=[],
                     help="multi-replica sweep points (ServingFrontend; "
                          "empty = skip the replica axis)")
@@ -152,6 +169,7 @@ def main():
         args.new, args.loads = 16, [1, 4]
         args.prefix_len = min(args.prefix_len, 12)
         args.num_draft = min(args.num_draft, 3)
+        args.lora_tenants = min(args.lora_tenants, 2)
         if args.replicas:
             args.replicas = args.replicas[:2]
 
@@ -585,6 +603,109 @@ def main():
                     "attention/dequant rows are PER LAYER "
                     f"(x{args.layers} per step); dequant is one "
                     "layer's K lanes (x2 for K+V)"},
+        }
+        _bank(args.out, record)
+
+    # ---- multi-tenant LoRA A/B (ISSUE 19): the peak load through a
+    # LoRA-armed engine, single-tenant vs N tenants round-robin,
+    # measured adjacent so the ratio isolates the cross-tenant page
+    # gather in the fused logits epilogue. Parity on every rep of both
+    # rows is against per-tenant SOLO runs — the tier-1 mixed-batch
+    # bitwise criterion at bench scale, so the A/B prices the
+    # epilogue's wall-clock, never its tokens.
+    if args.lora_tenants > 0:
+        load = max(args.loads)
+        n_req = args.requests_per_slot * load
+        R = args.lora_rank
+        names = [f"tenant-{i}" for i in range(args.lora_tenants)]
+        arng = np.random.default_rng(11)
+        adapters = {nm: (arng.standard_normal((args.hidden, R)) * 0.05,
+                         arng.standard_normal((R, args.vocab)) * 0.05)
+                    for nm in names}
+
+        def lora_engine():
+            eng = Engine(
+                apply_fn, make_cache, params,
+                EngineConfig(max_slots=load, max_len=max_len,
+                             prefill_chunk=args.chunk,
+                             vocab_size=cfg.vocab_size, max_queue=n_req,
+                             lora_rank=R,
+                             lora_max_adapters=args.lora_tenants),
+                lora_head=params["wte"])   # gpt2: weight-tied (V, H)
+            for nm, (A, B) in adapters.items():
+                eng.register_adapter(nm, A, B, scale=2.0)
+            # warmup rides the SAME two executables (LoRA-off slots
+            # share them via the zero page — no retrace)
+            wid = eng.submit(prompts[0], max_new_tokens=2, seed=1)
+            eng.run(max_steps=8)
+            assert eng.results[wid].status == "done"
+            return eng
+
+        # the oracle: every (prompt, tenant) pair either row will
+        # batch, run ALONE through one reusable engine (slot reuse +
+        # page refcounts are tier-1's job; seeds pinned per request so
+        # solo and mixed draw identical sampling streams)
+        oracle = {}
+        solo = lora_engine()
+        for nt in (1, args.lora_tenants):
+            for k in range(n_req):
+                key = (k, names[k % nt])
+                if key in oracle:
+                    continue
+                solo.results.clear()
+                rid = solo.submit(prompts[k], max_new_tokens=args.new,
+                                  tenant=key[1], seed=7000 + k)
+                solo.run(max_steps=args.new + 32)
+                assert solo.results[rid].status == "done"
+                oracle[key] = np.asarray(solo.results[rid].tokens)
+
+        def lora_row(tag, nt):
+            eng = lora_engine()
+            best = float("inf")
+            for _ in range(3):
+                eng.metrics = ServingMetrics()
+                eng.results.clear()
+                t0 = time.perf_counter()
+                ids = []
+                k = 0
+                while k < n_req or eng.scheduler.depth or eng.n_active:
+                    if k < n_req:
+                        ids.append(eng.submit(
+                            prompts[k], max_new_tokens=args.new,
+                            tenant=names[k % nt], seed=7000 + k))
+                        k += 1
+                        for _ in range(args.stagger - 1):
+                            eng.step()
+                    eng.step()
+                rep = time.perf_counter() - t0
+                for i, rid in enumerate(ids):   # mixed == solo, bitwise
+                    np.testing.assert_array_equal(
+                        eng.results[rid].tokens,
+                        oracle[(i, names[i % nt])])
+                best = min(best, rep)
+            assert eng.trace_counts == {"prefill": 1, "decode": 1}, \
+                eng.trace_counts
+            assert not eng._lora._slot_pages   # pages all released
+            return {"config": tag, "tenants": nt,
+                    "tokens_per_sec": round(n_req * args.new / best, 1)}
+
+        single_row = lora_row("lora_single_tenant", 1)
+        multi_row = lora_row("lora_multi_tenant", args.lora_tenants)
+        # dense reference from the main sweep's peak-load point: the
+        # epilogue's cost over the plain head (same offered load; the
+        # sweep ran moments ago on this machine)
+        dense_tps = next(r["tokens_per_sec"] for r in sweep
+                         if r["load"] == load)
+        record["lora_sweep"] = {
+            "rank": R, "adapters": args.lora_tenants, "load": load,
+            "requests": n_req,
+            "rows": [single_row, multi_row],
+            "multi_vs_single": round(
+                multi_row["tokens_per_sec"]
+                / single_row["tokens_per_sec"], 3),
+            "dense_tokens_per_sec": dense_tps,
+            "lora_vs_dense": round(
+                multi_row["tokens_per_sec"] / dense_tps, 3),
         }
         _bank(args.out, record)
 

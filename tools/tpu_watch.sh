@@ -290,6 +290,20 @@ run tune_fsample     900 python tools/tune_kernels.py --kernel fused_sample
 run bench_paged_decode 1800 python tools/bench_serving.py --loads 8 \
                          --prefix-len 24 --num-draft 4 \
                          --out perf_results/bench_paged_decode.json
+# ISSUE 19 chunked losses + fused GLU + LoRA epilogue ON SILICON:
+# sweep the three new kernel tables first (the committed tables carry
+# CPU tiny-mode picks; hardware winners feed the chunk_v / block_t /
+# block_f / block_v auto-pickers), then the single- vs N-tenant LoRA
+# serving A/B at peak load — the first honest timing of the fused
+# adapter epilogue (cross-tenant page gather in the logits matmul),
+# with per-rep token parity vs per-tenant solo runs on both rows so
+# the A/B prices wall-clock, never correctness.
+run tune_chunked    1800 python tools/tune_kernels.py --kernel chunked_loss
+run tune_swiglu     1800 python tools/tune_kernels.py --kernel fused_swiglu
+run tune_lora        900 python tools/tune_kernels.py --kernel lora_epilogue
+run bench_lora_serving 1800 python tools/bench_serving.py --loads 8 \
+                         --prefix-len 0 --lora-tenants 4 \
+                         --out perf_results/bench_lora_serving.json
 # elastic shrink-resume A/B (ISSUE 14) BEHIND the banked-bench
 # backlog: the n -> n/2 mid-run shrink through the planner re-plan +
 # manifest-verified reshard vs the from-checkpoint control, on the

@@ -499,10 +499,112 @@ def case_fused_sample(tiny):
                 nbytes=float(R * V * 4 + R * 4))
 
 
+def case_chunked_loss(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.chunked_loss import chunked_logprob
+    from apex1_tpu.tuning import padded_lanes
+
+    # preference-loss building block at the gpt2 head shape: chunk_v
+    # trades recompute passes (fwd + bwd stream each chunk twice)
+    # against per-chunk VMEM residency. Every split is numerically
+    # identical (online-softmax merge), so the sweep is pure timing.
+    T, H, V = (128, 128, 512) if tiny else (8184, 768, 50432)
+    cands = [256, 512] if tiny else [2048, 4096, 8192, 16384, 25216]
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, H)) * 0.02, jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(V, H)) * 0.02, jnp.bfloat16)
+    t = jnp.asarray(rng.integers(0, V - 100, (T,)), jnp.int32)
+
+    def make(blocks):
+        def f(x, w):
+            return chunked_logprob(x, w, t, num_classes=V - 100,
+                                   chunk_v=blocks["chunk_v"])
+        return _grad_of_sum(f, (0, 1)), (x, w)
+
+    return Case("chunked_loss", {"Hp": padded_lanes(H)}, "bfloat16",
+                [dict(chunk_v=cv) for cv in cands], make, grad=True,
+                flops=float(8 * T * H * V),       # fwd stats + recomputed
+                #                                   bwd chunk + dX + dW
+                nbytes=float(2 * (3 * V * H + 2 * T * H + V * H)))
+
+
+def case_fused_swiglu(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.fused_dense import fused_glu
+    from apex1_tpu.tuning import padded_lanes
+
+    # the llama fused_mlp tile (gate+up in one pass over x): block_t x
+    # block_f tiles the (tokens, ffn) output; both matmuls re-read the
+    # x block, so the trade is x-block reuse vs activation residency.
+    T, H, F = (64, 128, 256) if tiny else (8192, 4096, 14336)
+    cands = ([(8, 128), (16, 128)] if tiny
+             else [(128, 512), (256, 512), (128, 1024), (256, 1024),
+                   (512, 1024)])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, H)) * 0.02, jnp.bfloat16)
+    wg = jnp.asarray(rng.normal(size=(H, F)) * 0.02, jnp.bfloat16)
+    wu = jnp.asarray(rng.normal(size=(H, F)) * 0.02, jnp.bfloat16)
+
+    def make(blocks):
+        def f(x, wg, wu):
+            return fused_glu(x, wg, wu, block_t=blocks["block_t"],
+                             block_f=blocks["block_f"])
+        return _grad_of_sum(f, (0, 1, 2)), (x, wg, wu)
+
+    return Case("fused_swiglu", {"Hp": padded_lanes(H)}, "bfloat16",
+                [dict(block_t=bt, block_f=bf) for bt, bf in cands],
+                make, grad=True,
+                flops=float(3 * 2 * 2 * T * H * F),  # fwd + recompute +
+                #                                      bwd, two GEMMs
+                nbytes=float(2 * (2 * H * F * 2 + 2 * T * H + T * F)))
+
+
+def case_lora_epilogue(tiny):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex1_tpu.ops.lora_epilogue import lora_delta
+    from apex1_tpu.tuning import padded_lanes
+
+    # the multi-tenant serving epilogue at the engine's decode step
+    # shape: N slot rows, rank pages gathered via the scalar-prefetched
+    # block table. block_v tiles the vocab axis of the B pages; every
+    # split is bitwise-identical (fp32 accumulate), pure residency.
+    N, H, V, R = (4, 128, 512, 2) if tiny else (8, 4096, 50432, 8)
+    n_pg = 1 + 4 * R
+    cands = [128, 256] if tiny else [2048, 6400, 12672, 25216]
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(N, H)) * 0.02, jnp.bfloat16)
+    ap = jnp.asarray(rng.normal(size=(n_pg, H)) * 0.02, jnp.float32)
+    bp = jnp.asarray(rng.normal(size=(n_pg, V)) * 0.02, jnp.float32)
+    bt = jnp.asarray(
+        rng.integers(1, n_pg, size=(N, R)), jnp.int32)
+
+    def make(blocks):
+        def f(h):
+            return lora_delta(h, ap, bp, bt,
+                              block_v=blocks["block_v"])
+        return f, (h,)
+
+    return Case("lora_epilogue",
+                {"Hp": padded_lanes(H), "Vp": padded_lanes(V)},
+                "bfloat16", [dict(block_v=bv) for bv in cands],
+                make, grad=False,
+                flops=float(2 * N * R * (H + V)),
+                nbytes=float(N * R * (H + V) * 4 + N * V * 4))
+
+
 CASES = {
     "attention": case_attention,
     "paged_decode": case_paged_decode,
     "fused_sample": case_fused_sample,
+    "chunked_loss": case_chunked_loss,
+    "fused_swiglu": case_fused_swiglu,
+    "lora_epilogue": case_lora_epilogue,
     "linear_xent": case_linear_xent,
     "softmax": case_softmax,
     "layer_norm": case_layer_norm,
